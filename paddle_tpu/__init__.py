@@ -1,0 +1,26 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid v1.6 (reference: /root/reference).
+
+Architecture: Program-as-data IR (fluid/framework.py) -> segment lowering
+to jitted XLA computations (fluid/executor.py) -> JAX/Pallas kernels
+(ops/) -> GSPMD mesh parallelism (parallel/).  See SURVEY.md at the repo
+root for the reference layer map this mirrors.
+"""
+
+__version__ = '0.1.0'
+
+from . import ops  # registers all operators
+from . import fluid  # noqa: F401
+
+# paddle.* compatibility aliases
+from .fluid import layers  # noqa: F401
+
+
+def enable_static():
+    from .fluid.dygraph.base import disable_dygraph
+    disable_dygraph()
+
+
+def disable_static():
+    from .fluid.dygraph.base import enable_dygraph
+    enable_dygraph()

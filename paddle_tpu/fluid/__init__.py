@@ -1,0 +1,54 @@
+"""paddle_tpu.fluid — the user-facing API, mirroring paddle.fluid.
+
+Reference: python/paddle/fluid/__init__.py.  A fluid v1.6 training script
+ports by replacing `import paddle.fluid as fluid` with
+`import paddle_tpu.fluid as fluid` and `fluid.CUDAPlace(0)` with
+`fluid.XLAPlace(0)` (CUDAPlace is aliased to XLAPlace so even that is
+optional).
+"""
+
+from . import core
+from .core import (CPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
+                   LoDTensor, SelectedRows, Scope, global_scope,
+                   scope_guard, is_compiled_with_cuda)
+from . import framework
+from .framework import (Program, Variable, program_guard,
+                        default_main_program, default_startup_program,
+                        name_scope, in_dygraph_mode, cpu_places,
+                        cuda_places, xla_places)
+from . import executor
+from .executor import Executor
+from . import initializer
+from . import layers
+from . import nets
+from . import optimizer
+from . import backward
+from .backward import append_backward, gradients
+from . import regularizer
+from . import clip
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import unique_name
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import compiler
+from .parallel_executor import ParallelExecutor
+from . import io
+from .io import (save_params, save_persistables, load_params,
+                 load_persistables, save_inference_model,
+                 load_inference_model)
+from . import metrics
+from . import profiler
+from . import dygraph
+from .dygraph.base import enable_dygraph, disable_dygraph
+from . import data_feeder
+from .data_feeder import DataFeeder
+from . import reader
+from .reader import DataLoader
+from . import contrib
+
+__all__ = [
+    'CPUPlace', 'CUDAPlace', 'XLAPlace', 'Program', 'Variable',
+    'program_guard', 'default_main_program', 'default_startup_program',
+    'Executor', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
+    'ParamAttr', 'CompiledProgram', 'BuildStrategy', 'io', 'metrics',
+    'dygraph', 'DataFeeder', 'scope_guard', 'global_scope',
+]

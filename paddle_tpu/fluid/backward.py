@@ -1,0 +1,193 @@
+"""append_backward: symbolic reverse-mode autodiff over the Program IR.
+
+Reference: python/paddle/fluid/backward.py:1023 (append_backward) which
+asks C++ per-op GradOpDescMakers (core.get_grad_op_desc, backward.py:876)
+for hand-written grad ops and inserts sum ops for gradient aggregation.
+
+TPU-native re-design: grad ops are synthesized — for forward op `foo`, op
+`foo_grad` takes the same primal inputs plus 'GRAD::<out_slot>' cotangent
+slots and its lowering calls jax.vjp over foo's lowering
+(ops/registry.py grad_op_def).  No per-op gradient code exists anywhere.
+Aggregation (a var consumed by N ops) still inserts an explicit `sum` op,
+matching the reference's semantics; XLA fuses it away.
+"""
+
+from collections import defaultdict
+
+from . import framework
+from .framework import Parameter, grad_var_name
+
+
+def _is_float_dtype(dtype):
+    return str(dtype) in ('float16', 'bfloat16', 'float32', 'float64')
+
+
+def _creates_grad(var):
+    return _is_float_dtype(var.dtype) and not var.stop_gradient
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Returns [(param, grad_var), ...]. Single-block programs for now
+    (control-flow sub-blocks are lowered inside their parent op)."""
+    program = loss.block.program
+    block = program.global_block()
+    no_grad_set = set(no_grad_set or [])
+
+    loss_idx = None
+    for i in range(len(block.ops) - 1, -1, -1):
+        if loss.name in block.ops[i].output_arg_names:
+            loss_idx = i
+            break
+    if loss_idx is None:
+        raise ValueError('loss %s is not produced in this program'
+                         % loss.name)
+
+    # contributions: var name -> list of grad var names
+    contribs = defaultdict(list)
+
+    # seed d(loss) = 1
+    loss_grad = block.create_var(
+        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype,
+        persistable=False)
+    block.append_op(
+        'fill_constant', outputs={'Out': loss_grad},
+        attrs={'shape': list(loss.shape), 'dtype': loss.dtype,
+               'value': 1.0})
+    contribs[loss.name].append(loss_grad.name)
+
+    def resolve_grad(name):
+        """Collapse accumulated contributions into <name>@GRAD."""
+        lst = contribs.get(name)
+        if not lst:
+            return None
+        target = grad_var_name(name)
+        if len(lst) == 1:
+            return lst[0]
+        if not block.has_var(target):
+            src = block._find_var_recursive(name)
+            tv = block.create_var(name=target,
+                                  shape=src.shape if src else (),
+                                  dtype=src.dtype if src else 'float32')
+            tv.stop_gradient = True
+        block.append_op('sum', inputs={'X': list(lst)},
+                        outputs={'Out': target}, infer_shape=False)
+        contribs[name] = [target]
+        return target
+
+    checkpoint_names = set(v.name if isinstance(v, framework.Variable)
+                           else v for v in (checkpoints or []))
+
+    for op in reversed(block.ops[:loss_idx + 1]):
+        if not _op_backward(block, op, contribs, resolve_grad, no_grad_set,
+                            checkpoint_names):
+            continue
+
+    params_grads = []
+    wanted = None
+    if parameter_list is not None:
+        wanted = set(p.name if isinstance(p, framework.Variable) else p
+                     for p in parameter_list)
+    for p in block.all_parameters():
+        if not p.trainable or p.name in no_grad_set:
+            continue
+        if wanted is not None and p.name not in wanted:
+            continue
+        g = resolve_grad(p.name)
+        if g is None:
+            continue
+        gv = block._find_var_recursive(g)
+        params_grads.append((p, gv))
+    return params_grads
+
+
+def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
+                 checkpoint_names=()):
+    from ..ops import registry
+    if op.type in registry.HOST_OPS:
+        return False
+    # gather available output grads
+    grad_in = {}
+    any_grad = False
+    for slot, names in op.outputs.items():
+        row = []
+        need = False
+        for n in names:
+            if contribs.get(n):
+                need = True
+        if not need:
+            continue
+        for n in names:
+            g = resolve_grad(n)
+            if g is None:
+                # sibling output without grad: zeros placeholder keeps
+                # positional alignment within the slot
+                v = block._find_var_recursive(n)
+                z = block.create_var(
+                    name=framework.unique_name.generate(n + '@ZERO'),
+                    shape=v.shape, dtype=v.dtype)
+                block.append_op('fill_zeros_like', inputs={'X': n},
+                                outputs={'Out': z})
+                g = z.name
+            row.append(g)
+        grad_in['GRAD::' + slot] = row
+        any_grad = True
+    if not any_grad:
+        return False
+
+    # does any input need a gradient?
+    in_vars = []
+    for slot, names in op.inputs.items():
+        for n in names:
+            v = block._find_var_recursive(n)
+            in_vars.append((slot, n, v))
+    if not any(v is not None and _creates_grad(v) and n not in no_grad_set
+               for (_, n, v) in in_vars):
+        return False
+
+    grad_inputs = dict(op.inputs)
+    grad_inputs.update(grad_in)
+    grad_outputs = {}
+    for slot, names in op.inputs.items():
+        row = []
+        for n in names:
+            v = block._find_var_recursive(n)
+            gname = framework.unique_name.generate(grad_var_name(n))
+            gv = block.create_var(name=gname,
+                                  shape=v.shape if v else (),
+                                  dtype=v.dtype if v else 'float32')
+            gv.stop_gradient = True
+            row.append(gname)
+            if v is not None and _creates_grad(v) and n not in no_grad_set:
+                contribs[n].append(gname)
+        grad_outputs['GRAD::' + slot] = row
+    attrs = dict(op.attrs)
+    if op.type in ('matmul', 'matmul_v2', 'mul', 'conv2d',
+                   'depthwise_conv2d') or any(
+            n in checkpoint_names for n in op.input_arg_names):
+        pass  # recompute policy hooks (RecomputeOptimizer) land here
+    block.append_op(op.type + '_grad', inputs=grad_inputs,
+                    outputs=grad_outputs, attrs=attrs,
+                    infer_shape=False)
+    return True
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference: backward.py:1407."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError('calc_gradient: single target for now')
+    loss = targets[0]
+    block = loss.block
+    pg = append_backward(loss, no_grad_set=no_grad_set)
+    del pg
+    outs = []
+    for v in inputs:
+        g = block._find_var_recursive(grad_var_name(v.name))
+        outs.append(g)
+    return outs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

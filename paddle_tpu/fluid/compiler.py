@@ -1,0 +1,74 @@
+"""CompiledProgram: multi-device data-parallel compilation.
+
+Reference: python/paddle/fluid/compiler.py:160
+(CompiledProgram.with_data_parallel -> core.ParallelExecutor).
+
+TPU-native re-design: instead of cloning the graph per device and inserting
+NCCL AllReduce op-handles (framework/details/all_reduce_op_handle.cc), the
+SAME jitted segment is compiled under a jax.sharding.Mesh: feed vars are
+sharded along the batch ('dp') axis, parameters/optimizer state replicated,
+and GSPMD inserts the gradient all-reduce over ICI automatically.  This is
+semantically identical to ReduceStrategy::kAllReduce (each device holds
+replicated params and applies the same update) with XLA choosing the
+collective schedule.
+"""
+
+
+class BuildStrategy(object):
+    """Reference: framework/details/build_strategy.h:37."""
+
+    class ReduceStrategy(object):
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy(object):
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy(object):
+    """Reference: framework/details/execution_strategy.h:22."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram(object):
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._places = None
+        self._share_vars_from = None
+        self._exec_cache = {}
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    @property
+    def program(self):
+        return self._program

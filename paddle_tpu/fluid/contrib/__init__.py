@@ -1,0 +1,3 @@
+"""fluid.contrib. Reference: python/paddle/fluid/contrib/."""
+
+from . import mixed_precision

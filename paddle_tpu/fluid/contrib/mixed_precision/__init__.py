@@ -1,0 +1,2 @@
+from .decorator import decorate
+from .fp16_lists import AutoMixedPrecisionLists

@@ -1,0 +1,37 @@
+"""AMP op lists. Reference:
+python/paddle/fluid/contrib/mixed_precision/fp16_lists.py.
+
+On TPU the low-precision dtype is bfloat16 (MXU-native), so the white list
+marks MXU ops; loss-scaling still applies when float16 is forced.
+"""
+
+white_list = {
+    'conv2d', 'depthwise_conv2d', 'conv2d_transpose', 'matmul',
+    'matmul_v2', 'mul', 'bmm',
+}
+
+black_list = {
+    'exp', 'square', 'log', 'mean', 'sum', 'cos_sim',
+    'softmax', 'softmax_with_cross_entropy', 'sigmoid_cross_entropy_'
+    'with_logits', 'cross_entropy', 'cross_entropy2',
+}
+
+gray_list = {
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'relu', 'gelu', 'tanh', 'sigmoid', 'pool2d',
+    'batch_norm', 'layer_norm', 'dropout', 'reshape2', 'transpose2',
+    'concat', 'split', 'slice', 'scale',
+}
+
+
+class AutoMixedPrecisionLists(object):
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)

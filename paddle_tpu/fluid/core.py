@@ -1,0 +1,272 @@
+"""Core runtime objects: places, Scope, dtype conversion, LoDTensor.
+
+TPU-native re-design of the reference framework core:
+  - Place        (reference: paddle/fluid/platform/place.h:26-98)
+  - Scope        (reference: paddle/fluid/framework/scope.h:46-99)
+  - LoDTensor    (reference: paddle/fluid/framework/lod_tensor.h:52-219)
+  - SelectedRows (reference: paddle/fluid/framework/selected_rows.h:32-44)
+
+Unlike the reference (type-erased C++ holders + buddy allocator), values here
+are jax.Array / numpy arrays; device memory management is XLA's job.  The
+Scope keeps the reference's name->Variable contract with parent-chain lookup
+so executors, save/load and the fleet API work unchanged.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Places
+# ---------------------------------------------------------------------------
+
+
+class Place(object):
+    """Device tag. Reference: platform/place.h boost::variant of places."""
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def jax_device(self):
+        raise NotImplementedError
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super(CPUPlace, self).__init__(0)
+
+    def jax_device(self):
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return jax.devices()[0]
+
+
+class XLAPlace(Place):
+    """The accelerator place (TPU when available). Replaces CUDAPlace
+    (reference: platform/place.h:79) as the one-line user-visible swap:
+    fluid.CUDAPlace(0) -> fluid.XLAPlace(0)."""
+
+    def jax_device(self):
+        devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+# Compatibility alias: existing fluid scripts use CUDAPlace.
+CUDAPlace = XLAPlace
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xla():
+    return True
+
+
+# ---------------------------------------------------------------------------
+# dtype conversion
+# ---------------------------------------------------------------------------
+
+# Reference dtype enum: framework/framework.proto:104 (VarType.Type)
+_DTYPE_MAP = {
+    "bool": np.bool_,
+    "int8": np.int8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "uint8": np.uint8,
+    "float16": np.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": np.float32,
+    "float64": np.float64,
+}
+
+# Numeric values of VarType.Type for proto-level compat
+# (framework/framework.proto:104-131).
+VARTYPE_TO_NAME = {
+    0: "bool", 1: "int16", 2: "int32", 3: "int64", 4: "float16",
+    5: "float32", 6: "float64", 20: "uint8", 21: "int8", 22: "bfloat16",
+}
+NAME_TO_VARTYPE = {v: k for k, v in VARTYPE_TO_NAME.items()}
+
+
+def convert_dtype(dtype):
+    """Accept str ('float32'), numpy dtype, jnp dtype, or VarType int."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, int):
+        dtype = VARTYPE_TO_NAME[dtype]
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.dtype(jnp.bfloat16)
+        return np.dtype(_DTYPE_MAP[dtype])
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return jnp.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return convert_dtype(dtype).name
+
+
+# ---------------------------------------------------------------------------
+# LoDTensor / SelectedRows
+# ---------------------------------------------------------------------------
+
+
+class LoDTensor(object):
+    """Dense tensor + level-of-detail offsets for variable-length batches.
+
+    Reference: framework/lod_tensor.h:52 (LoD = vector<Vector<size_t>>).
+    On TPU the data itself is padded/bucketed before compilation; the LoD
+    rides along on the host and drives mask construction in sequence ops.
+    """
+
+    def __init__(self, data, lod=None):
+        self.data = data
+        self.lod = [list(level) for level in (lod or [])]
+
+    def set_lod(self, lod):
+        self.lod = [list(level) for level in lod]
+
+    def recursive_sequence_lengths(self):
+        out = []
+        for level in self.lod:
+            out.append([level[i + 1] - level[i] for i in range(len(level) - 1)])
+        return out
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+
+class SelectedRows(object):
+    """Sparse row-set: int row ids + dense rows value tensor.
+
+    Reference: framework/selected_rows.h:32-44.  Used for sparse gradients
+    of embedding lookups; on TPU the optimizer ops apply it as a
+    segment-sum scatter-update instead of a per-row hash map.
+    """
+
+    def __init__(self, rows, value, height):
+        self.rows = rows          # int array [n]
+        self.value = value        # [n, dim...]
+        self.height = int(height)  # full first-dim size
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                        self.value.dtype)
+        return out.at[self.rows].add(self.value)
+
+
+# ---------------------------------------------------------------------------
+# Scope
+# ---------------------------------------------------------------------------
+
+
+class Scope(object):
+    """name -> value map with parent-chain lookup and child scopes.
+
+    Reference: framework/scope.h:46 (Var/FindVar/kids).  Values are
+    jax.Array, numpy arrays, LoDTensor or SelectedRows.
+    """
+
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+
+    def new_scope(self):
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars and s._vars[name] is not None:
+                return True
+            s = s.parent
+        return False
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def drop_kids(self):
+        self.kids = []
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class _ScopeGuard(object):
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._old = _global_scope
+        _global_scope = self.scope
+
+    def __exit__(self, *a):
+        global _global_scope
+        _global_scope = self._old
+
+
+def scope_guard(scope):
+    return _ScopeGuard(scope)
+
+
+def as_array(value):
+    """Pull the dense array out of whatever the scope holds."""
+    if isinstance(value, LoDTensor):
+        return value.data
+    if isinstance(value, SelectedRows):
+        return value.to_dense()
+    return value

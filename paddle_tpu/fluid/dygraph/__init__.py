@@ -1,0 +1,11 @@
+"""Dygraph (eager) mode. Reference: python/paddle/fluid/dygraph/."""
+
+from . import base
+from .base import guard, enabled, to_variable, enable_dygraph, \
+    disable_dygraph, no_grad
+from .layers import Layer
+from . import nn
+from .nn import (Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
+                 Dropout)
+from .checkpoint import save_dygraph, load_dygraph
+from .parallel import DataParallel, ParallelEnv, prepare_context

@@ -1,0 +1,267 @@
+"""Dygraph core: VarBase, Tracer (eager tape), guard.
+
+Reference: imperative/tracer.h:44 (TraceOp runs the kernel immediately and
+tapes a grad node), imperative/layer.h:59 (VarBase), imperative/engine.cc:179
+(BasicEngine reverse walk), python/paddle/fluid/dygraph/base.py.
+
+TPU-native re-design: eager execution calls the same JAX op lowerings the
+static executor uses (jax dispatches asynchronously to the device), and the
+tape records (opdef, inputs, attrs, outputs); backward() walks the tape in
+reverse calling the synthesized vjp grad lowerings eagerly.  One kernel
+library serves both modes.
+"""
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import core
+from .. import framework
+from .. import unique_name
+from ...ops import registry
+
+
+class VarBase(object):
+    """Eager tensor. Reference: imperative/layer.h:59."""
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        self.value = jnp.asarray(value) if not hasattr(value, 'dtype') \
+            or isinstance(value, np.ndarray) else value
+        self.name = name or unique_name.generate('eager_tmp')
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad = None  # accumulated gradient (jnp array)
+
+    # -- protocol ----------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return core.dtype_name(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def set_value(self, value):
+        self.value = jnp.asarray(value)
+
+    def backward(self, backward_strategy=None):
+        tracer = framework._dygraph_tracer()
+        if tracer is None:
+            raise RuntimeError('backward() outside dygraph guard')
+        tracer.run_backward(self)
+
+    def __repr__(self):
+        return 'VarBase(%s, %s)\n%s' % (self.name, self.shape,
+                                        self.numpy())
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, other, op_type, reverse=False):
+        if isinstance(other, (int, float)):
+            if op_type == 'elementwise_add':
+                return _trace_single('scale', {'X': [self]},
+                                     {'scale': 1.0, 'bias': float(other)})
+            if op_type == 'elementwise_mul':
+                return _trace_single('scale', {'X': [self]},
+                                     {'scale': float(other)})
+            if op_type == 'elementwise_sub' and not reverse:
+                return _trace_single('scale', {'X': [self]},
+                                     {'scale': 1.0, 'bias': -float(other)})
+            if op_type == 'elementwise_div' and not reverse:
+                return _trace_single('scale', {'X': [self]},
+                                     {'scale': 1.0 / float(other)})
+            other = VarBase(jnp.full((1,), other, self.value.dtype),
+                            stop_gradient=True)
+        x, y = (other, self) if reverse else (self, other)
+        return _trace_single(op_type, {'X': [x], 'Y': [y]}, {'axis': -1})
+
+    def __add__(self, o):
+        return self._binary(o, 'elementwise_add')
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, 'elementwise_sub')
+
+    def __rsub__(self, o):
+        return self._binary(o, 'elementwise_sub', reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, 'elementwise_mul')
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, 'elementwise_div')
+
+    def __rtruediv__(self, o):
+        return self._binary(o, 'elementwise_div', reverse=True)
+
+    def astype(self, dtype):
+        return _trace_single('cast', {'X': [self]},
+                             {'out_dtype': core.dtype_name(dtype)})
+
+
+class _TapeEntry(object):
+    __slots__ = ('op_type', 'inputs', 'outputs', 'attrs')
+
+    def __init__(self, op_type, inputs, outputs, attrs):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+
+
+class Tracer(object):
+    """Reference: imperative/tracer.h:44."""
+
+    def __init__(self):
+        self._tape = []
+        self._step = 0
+        self._no_grad = False
+
+    def trace_op(self, op_type, inputs, outputs_spec=None, attrs=None):
+        """inputs: {slot: [VarBase]}; returns {slot: [VarBase]}."""
+        attrs = dict(attrs or {})
+        if '__op_seed__' not in attrs:
+            attrs['__op_seed__'] = np.random.randint(1 << 30)
+        opdef = registry.get(op_type)
+        ins_vals = {s: [v.value for v in vs] for s, vs in inputs.items()}
+        ctx = registry.LowerCtx(self._step, attrs['__op_seed__'])
+        outs_vals = opdef.fn(ctx, ins_vals, attrs)
+        outputs = {s: [VarBase(v) for v in vs]
+                   for s, vs in outs_vals.items()}
+        requires = (not self._no_grad) and any(
+            not v.stop_gradient for vs in inputs.values() for v in vs)
+        if requires:
+            self._tape.append(_TapeEntry(op_type, inputs, outputs, attrs))
+            for vs in outputs.values():
+                for v in vs:
+                    v.stop_gradient = False
+        else:
+            for vs in outputs.values():
+                for v in vs:
+                    v.stop_gradient = True
+        return outputs
+
+    def run_backward(self, loss):
+        grads = {}  # id(VarBase) -> jnp array
+        grads[id(loss)] = jnp.ones_like(loss.value)
+        for entry in reversed(self._tape):
+            out_has = any(id(v) in grads for vs in entry.outputs.values()
+                          for v in vs)
+            if not out_has:
+                continue
+            opdef = registry.get(entry.op_type + '_grad')
+            ins = {s: [v.value for v in vs]
+                   for s, vs in entry.inputs.items()}
+            for s, vs in entry.outputs.items():
+                row = []
+                has = False
+                for v in vs:
+                    g = grads.get(id(v))
+                    if g is not None:
+                        has = True
+                    row.append(g if g is not None
+                               else jnp.zeros_like(v.value))
+                if has:
+                    ins['GRAD::' + s] = row
+            ctx = registry.LowerCtx(self._step,
+                                    entry.attrs.get('__op_seed__', 0))
+            douts = opdef.fn(ctx, ins, entry.attrs)
+            for s, vs in entry.inputs.items():
+                dvs = douts.get('GRAD::' + s, [])
+                for v, dv in zip(vs, dvs):
+                    if v.stop_gradient or dv is None:
+                        continue
+                    prev = grads.get(id(v))
+                    grads[id(v)] = dv if prev is None else prev + dv
+        # publish grads onto leaf VarBases (params) — once per VarBase,
+        # grads[] already holds the fully accumulated value
+        published = set()
+        for entry in self._tape:
+            for vs in entry.inputs.values():
+                for v in vs:
+                    if id(v) in published or v.stop_gradient:
+                        continue
+                    g = grads.get(id(v))
+                    if g is None:
+                        continue
+                    published.add(id(v))
+                    v.grad = g if v.grad is None else v.grad + g
+        self._tape = []
+        self._step += 1
+
+
+def _trace_single(op_type, inputs, attrs):
+    tracer = framework._dygraph_tracer()
+    if tracer is None:
+        raise RuntimeError('eager op outside dygraph guard')
+    out = tracer.trace_op(op_type, inputs, attrs=attrs)
+    first_slot = 'Out' if 'Out' in out else list(out.keys())[0]
+    return out[first_slot][0]
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    framework._dygraph_tracer_ = Tracer()
+
+
+def disable_dygraph():
+    framework._dygraph_tracer_ = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    old = framework._dygraph_tracer_
+    framework._dygraph_tracer_ = Tracer()
+    try:
+        yield
+    finally:
+        framework._dygraph_tracer_ = old
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    tracer = framework._dygraph_tracer()
+    if tracer is None:
+        yield
+        return
+    old = tracer._no_grad
+    tracer._no_grad = True
+    try:
+        yield
+    finally:
+        tracer._no_grad = old
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return no_grad_ctx()
+
+    def wrapper(*a, **k):
+        with no_grad_ctx():
+            return fn(*a, **k)
+    return wrapper
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name, stop_gradient=True)

@@ -1,0 +1,19 @@
+"""Dygraph save/load. Reference: python/paddle/fluid/dygraph/checkpoint.py."""
+
+import os
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path):
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrs = {k: np.asarray(v.numpy() if hasattr(v, 'numpy') else v)
+            for k, v in state_dict.items()}
+    np.savez(model_path + '.pdparams.npz', **arrs)
+
+
+def load_dygraph(model_path):
+    data = np.load(model_path + '.pdparams.npz')
+    return {k: data[k] for k in data.files}, None

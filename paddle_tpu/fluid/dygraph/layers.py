@@ -1,0 +1,127 @@
+"""Layer container. Reference: python/paddle/fluid/dygraph/layers.py."""
+
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import core
+from .. import unique_name
+from .base import VarBase
+
+
+class Layer(object):
+    def __init__(self, name_scope=None, dtype='float32'):
+        self._full_name = unique_name.generate(
+            (name_scope or self.__class__.__name__.lower()))
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    def create_parameter(self, shape, dtype=None, is_bias=False,
+                         attr=None, default_initializer=None):
+        from ..initializer import Constant, Xavier
+        from ..param_attr import ParamAttr
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else Xavier())
+        value = _eager_init(init, shape, dtype)
+        p = VarBase(value, name=attr.name or unique_name.generate(
+            self._full_name + '_w'), stop_gradient=False, persistable=True)
+        p.trainable = attr.trainable
+        p.optimize_attr = {'learning_rate': attr.learning_rate}
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def state_dict(self, include_sublayers=True):
+        out = {}
+        for k, p in self._parameters.items():
+            if p is not None:
+                out[p.name] = p.numpy()
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.update(l.state_dict())
+        return out
+
+    def set_dict(self, state, include_sublayers=True):
+        for p in self.parameters():
+            if p.name in state:
+                p.set_value(state[p.name])
+
+    load_dict = set_dict
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and getattr(value, 'persistable',
+                                                  False):
+            self.__dict__.setdefault('_parameters',
+                                     collections.OrderedDict())
+            self._parameters[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault('_sub_layers',
+                                     collections.OrderedDict())
+            self._sub_layers[name] = value
+        object.__setattr__(self, name, value)
+
+
+def _eager_init(init, shape, dtype):
+    """Run an initializer's op eagerly (no program) to get the array."""
+    from ...ops import registry
+    from .. import framework
+    prog = framework.Program()
+    block = prog.global_block()
+    v = block.create_var(name='p', shape=tuple(shape), dtype=dtype,
+                         persistable=True)
+    init(v, block)
+    op = block.ops[-1]
+    ctx = registry.LowerCtx(step=np.random.randint(1 << 30),
+                            op_seed=op.attrs.get('__op_seed__', 0))
+    outs = registry.get(op.type).fn(ctx, {}, op.attrs)
+    return outs['Out'][0]

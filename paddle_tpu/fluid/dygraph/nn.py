@@ -1,0 +1,192 @@
+"""Dygraph NN layers. Reference: python/paddle/fluid/dygraph/nn.py."""
+
+import numpy as np
+
+from .. import framework
+from .base import VarBase
+from .layers import Layer
+
+
+def _trace(op_type, inputs, attrs=None):
+    return framework._dygraph_tracer().trace_op(op_type, inputs,
+                                                attrs=attrs)
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype='float32'):
+        super(Linear, self).__init__(dtype=dtype)
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            dtype, attr=param_attr)
+        self.bias = self.create_parameter([output_dim], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._act = act
+
+    def forward(self, input):
+        out = _trace('mul', {'X': [input], 'Y': [self.weight]},
+                     {'x_num_col_dims': len(input.shape) - 1,
+                      'y_num_col_dims': 1})['Out'][0]
+        if self.bias is not None:
+            out = _trace('elementwise_add',
+                         {'X': [out], 'Y': [self.bias]},
+                         {'axis': len(out.shape) - 1})['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+FC = Linear
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype='float32'):
+        super(Conv2D, self).__init__(dtype=dtype)
+        groups = groups or 1
+        if isinstance(filter_size, int):
+            filter_size = [filter_size, filter_size]
+        from ..initializer import Normal
+        fan_in = (num_channels // groups) * int(np.prod(filter_size))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + list(filter_size),
+            dtype, attr=param_attr,
+            default_initializer=Normal(0.0, (2.0 / fan_in) ** 0.5))
+        self.bias = self.create_parameter([num_filters], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._attrs = {
+            'strides': [stride, stride] if isinstance(stride, int)
+            else list(stride),
+            'paddings': [padding, padding] if isinstance(padding, int)
+            else list(padding),
+            'dilations': [dilation, dilation]
+            if isinstance(dilation, int) else list(dilation),
+            'groups': groups}
+        self._act = act
+
+    def forward(self, input):
+        out = _trace('conv2d',
+                     {'Input': [input], 'Filter': [self.weight]},
+                     self._attrs)['Output'][0]
+        if self.bias is not None:
+            out = _trace('elementwise_add',
+                         {'X': [out], 'Y': [self.bias]},
+                         {'axis': 1})['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type='max', pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, dtype='float32'):
+        super(Pool2D, self).__init__(dtype=dtype)
+        self._attrs = {
+            'pooling_type': pool_type,
+            'ksize': [pool_size, pool_size]
+            if isinstance(pool_size, int) else list(pool_size),
+            'strides': [pool_stride, pool_stride]
+            if isinstance(pool_stride, int) else list(pool_stride),
+            'paddings': [pool_padding, pool_padding]
+            if isinstance(pool_padding, int) else list(pool_padding),
+            'global_pooling': global_pooling, 'ceil_mode': ceil_mode,
+            'exclusive': exclusive}
+
+    def forward(self, input):
+        return _trace('pool2d', {'X': [input]}, self._attrs)['Out'][0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype='float32', data_layout='NCHW',
+                 use_global_stats=False):
+        super(BatchNorm, self).__init__(dtype=dtype)
+        from ..initializer import Constant
+        self.weight = self.create_parameter(
+            [num_channels], dtype, attr=param_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._mean = VarBase(np.zeros([num_channels], np.float32),
+                             stop_gradient=True, persistable=True)
+        self._variance = VarBase(np.ones([num_channels], np.float32),
+                                 stop_gradient=True, persistable=True)
+        self._attrs = {'momentum': momentum, 'epsilon': epsilon,
+                       'data_layout': data_layout,
+                       'use_global_stats': use_global_stats}
+        self._act = act
+
+    def forward(self, input):
+        attrs = dict(self._attrs)
+        attrs['is_test'] = not self.training
+        outs = _trace('batch_norm',
+                      {'X': [input], 'Scale': [self.weight],
+                       'Bias': [self.bias], 'Mean': [self._mean],
+                       'Variance': [self._variance]}, attrs)
+        self._mean.value = outs['MeanOut'][0].value
+        self._variance.value = outs['VarianceOut'][0].value
+        out = outs['Y'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype='float32'):
+        super(Embedding, self).__init__(dtype=dtype)
+        self.weight = self.create_parameter(list(size), dtype,
+                                            attr=param_attr)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, input):
+        return _trace('lookup_table_v2',
+                      {'W': [self.weight], 'Ids': [input]},
+                      {'padding_idx': self._padding_idx})['Out'][0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype='float32'):
+        super(LayerNorm, self).__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        from ..initializer import Constant
+        self.weight = self.create_parameter(
+            [n], dtype, attr=param_attr,
+            default_initializer=Constant(1.0)) if scale else None
+        self.bias = self.create_parameter([n], dtype, is_bias=True,
+                                          attr=bias_attr) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, input):
+        ins = {'X': [input]}
+        if self.weight is not None:
+            ins['Scale'] = [self.weight]
+        if self.bias is not None:
+            ins['Bias'] = [self.bias]
+        out = _trace('layer_norm', ins,
+                     {'epsilon': self._epsilon,
+                      'begin_norm_axis': len(input.shape) - 1})['Y'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation='downgrade_in_infer'):
+        super(Dropout, self).__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        return _trace('dropout', {'X': [input]},
+                      {'dropout_prob': self._p,
+                       'is_test': not self.training,
+                       'dropout_implementation': self._impl})['Out'][0]

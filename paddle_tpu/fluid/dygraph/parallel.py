@@ -1,0 +1,68 @@
+"""Dygraph data parallel.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:84 (DataParallel scales
+loss and all-reduces grads via NCCLParallelContext,
+imperative/nccl_context.h:61).
+
+TPU-native: single-process SPMD — gradient all-reduce happens by jnp.mean
+over per-device grads when the eager values are sharded.  With one
+process per host (jax.distributed), jax handles the collective; this
+wrapper keeps the reference API (scale_loss / apply_collective_grads).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+
+
+class ParallelEnv(object):
+    def __init__(self):
+        self.nranks = jax.process_count()
+        self.local_rank = jax.process_index()
+        self.dev_id = 0
+        self.current_endpoint = ''
+        self.trainer_endpoints = []
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super(DataParallel, self).__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        n = getattr(self._strategy, 'nranks', 1)
+        if n <= 1:
+            return loss
+        return loss * (1.0 / n)
+
+    def apply_collective_grads(self):
+        n = getattr(self._strategy, 'nranks', 1)
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                # multi-process eager: psum across processes
+                p.grad = jax.experimental.multihost_utils.\
+                    process_allreduce(p.grad) if hasattr(
+                        jax.experimental, 'multihost_utils') else p.grad
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)

@@ -1,0 +1,149 @@
+"""Initializers appending init ops to the startup program.
+
+Reference: python/paddle/fluid/initializer.py — each initializer appends a
+fill_constant / gaussian_random / uniform_random op on the parameter var
+to the startup program, which the TPU executor compiles like any segment.
+"""
+
+import numpy as np
+
+
+class Initializer(object):
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self.value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            'fill_constant', outputs={'Out': var.name},
+            attrs={'shape': list(var.shape), 'dtype': var.dtype,
+                   'value': float(self.value)})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            'uniform_random', outputs={'Out': var.name},
+            attrs={'shape': list(var.shape), 'dtype': var.dtype,
+                   'min': self.low, 'max': self.high})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            'gaussian_random', outputs={'Out': var.name},
+            attrs={'shape': list(var.shape), 'dtype': var.dtype,
+                   'mean': self.loc, 'std': self.scale})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            'truncated_gaussian_random', outputs={'Out': var.name},
+            attrs={'shape': list(var.shape), 'dtype': var.dtype,
+                   'mean': self.loc, 'std': self.scale})
+
+
+def _fan_in_out(var):
+    shape = var.shape
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+    fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class XavierInitializer(Initializer):
+    """Glorot. Reference initializer.py XavierInitializer."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform = uniform
+        self.fan_in = fan_in
+        self.fan_out = fan_out
+
+    def __call__(self, var, block):
+        fi, fo = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return UniformInitializer(-limit, limit)(var, block)
+        std = float(np.sqrt(2.0 / (fi + fo)))
+        return NormalInitializer(0.0, std)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """Kaiming He. Reference initializer.py MSRAInitializer."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform = uniform
+        self.fan_in = fan_in
+
+    def __call__(self, var, block):
+        fi, _ = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return UniformInitializer(-limit, limit)(var, block)
+        std = float(np.sqrt(2.0 / fi))
+        return NormalInitializer(0.0, std)(var, block)
+
+
+class BilinearInitializer(Initializer):
+    def __call__(self, var, block):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError('bilinear init needs 4-D var')
+        c, k = shape[1], shape[3]
+        f = int(np.ceil(k / 2.0))
+        cc = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype=np.float32)
+        for i in range(int(np.prod(shape))):
+            x = i % k
+            y = (i // k) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - cc)) * (1 - abs(y / f - cc))
+        return block.append_op(
+            'assign_value', outputs={'Out': var.name},
+            attrs={'shape': list(shape), 'dtype': var.dtype,
+                   'values': weight.flatten().tolist()})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        return block.append_op(
+            'assign_value', outputs={'Out': var.name},
+            attrs={'shape': list(self.value.shape), 'dtype': var.dtype,
+                   'values': self.value.flatten().tolist()})
+
+
+# Aliases matching fluid's public names
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def force_init_on_cpu():
+    return False

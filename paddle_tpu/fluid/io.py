@@ -1,0 +1,154 @@
+"""Model save/load. Reference: python/paddle/fluid/io.py —
+save_persistables(:544), load_persistables(:822),
+save_inference_model(:1010), load_inference_model(:1214).
+
+Persistables are written as one .npz (the reference's save_combine single
+file format, framework/save_load_util.h); the inference model is the
+serialized program json + params npz.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from . import core
+from . import framework
+from .framework import Program, Parameter
+
+
+def _persistable_vars(program):
+    return [v for v in program.list_vars()
+            if v.persistable and v.type == 'LOD_TENSOR']
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if (predicate is None or predicate(v))]
+    scope = core.global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        filename = '__model_params__'
+    arrs = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError('save: var %s not in scope' % v.name)
+        arrs[v.name] = np.asarray(core.as_array(val))
+    np.savez(os.path.join(dirname, filename + '.npz'), **arrs)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if (predicate is None or predicate(v))]
+    if filename is None:
+        filename = '__model_params__'
+    data = np.load(os.path.join(dirname, filename + '.npz'))
+    scope = core.global_scope()
+    for v in vars:
+        if v.name not in data:
+            raise RuntimeError('load: var %s missing in checkpoint'
+                               % v.name)
+        scope.set_var(v.name, data[v.name])
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    save_vars(executor, dirname, main_program,
+              vars=main_program.all_parameters(), filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    load_vars(executor, dirname, main_program,
+              vars=main_program.all_parameters(), filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    save_vars(executor, dirname, main_program,
+              vars=_persistable_vars(main_program), filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    load_vars(executor, dirname, main_program,
+              vars=_persistable_vars(main_program), filename=filename)
+
+
+def _prune_for_inference(program, feeded_var_names, target_vars):
+    """Backward slice from targets. Reference: framework/prune.h."""
+    p = program.clone(for_test=True)
+    block = p.global_block()
+    needed = set(v.name if isinstance(v, framework.Variable) else v
+                 for v in target_vars)
+    keep = []
+    for op in reversed(block.ops):
+        if set(op.output_arg_names) & needed:
+            keep.append(op)
+            for n in op.input_arg_names:
+                needed.add(n)
+    block.ops = list(reversed(keep))
+    return p
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None,
+                         export_for_deployment=True,
+                         program_only=False):
+    main_program = main_program or framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = _prune_for_inference(main_program, feeded_var_names,
+                                  target_vars)
+    model = {
+        'program': pruned.to_dict(),
+        'feed_names': list(feeded_var_names),
+        'fetch_names': [v.name if isinstance(v, framework.Variable) else v
+                        for v in target_vars],
+    }
+    model_filename = model_filename or '__model__'
+    with open(os.path.join(dirname, model_filename + '.json'), 'w') as f:
+        json.dump(model, f)
+    if not program_only:
+        save_persistables(executor, dirname, main_program,
+                          filename=params_filename)
+    return model['fetch_names']
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_filename = model_filename or '__model__'
+    with open(os.path.join(dirname, model_filename + '.json')) as f:
+        model = json.load(f)
+    program = Program.from_dict(model['program'])
+    load_persistables(executor, dirname, program,
+                      filename=params_filename)
+    fetch_vars = [program.global_block().var(n)
+                  for n in model['fetch_names']]
+    return program, model['feed_names'], fetch_vars
+
+
+def get_program_parameter(program):
+    return program.all_parameters()
+
+
+def save(program, model_path):
+    """New-style single-file save (reference io.py:1492)."""
+    save_persistables(None, os.path.dirname(model_path) or '.', program,
+                      filename=os.path.basename(model_path))
+
+
+def load(program, model_path, executor=None):
+    load_persistables(executor, os.path.dirname(model_path) or '.',
+                      program, filename=os.path.basename(model_path))

@@ -1,0 +1,110 @@
+"""LayerHelper: the layers' op/param appending utility.
+
+Reference: python/paddle/fluid/layer_helper.py (used by every layer, e.g.
+layers/nn.py:207 fc).  Parameters are created in BOTH the startup program
+(with their initializer op) and the main program, mirroring the reference's
+two-program contract.
+"""
+
+from . import core
+from . import framework
+from . import unique_name
+from .initializer import Constant, Xavier
+from .param_attr import ParamAttr
+
+
+class LayerHelper(object):
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get('name')
+        if name is None:
+            self.name = unique_name.generate(layer_type)
+        else:
+            self.name = name
+
+    @property
+    def main_program(self):
+        return framework.default_main_program()
+
+    @property
+    def startup_program(self):
+        return framework.default_startup_program()
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        return self.main_program.current_block().append_op(
+            type, inputs=inputs, outputs=outputs, attrs=attrs)
+
+    def create_variable_for_type_inference(self, dtype,
+                                           stop_gradient=False):
+        return self.main_program.current_block().create_var(
+            name=unique_name.generate('.'.join([self.name, 'tmp'])),
+            dtype=dtype, shape=(), stop_gradient=stop_gradient,
+            persistable=False)
+
+    def create_variable(self, *args, **kwargs):
+        return self.main_program.current_block().create_var(*args, **kwargs)
+
+    def create_global_variable(self, persistable=False, *args, **kwargs):
+        return self.main_program.global_block().create_var(
+            *args, persistable=persistable, **kwargs)
+
+    def create_parameter(self, attr, shape, dtype='float32', is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if attr.name is None:
+            attr.name = unique_name.generate('.'.join([self.name, 'w']))
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else Xavier()
+        shape = [int(s) for s in shape]
+        startup_block = self.startup_program.global_block()
+        sp = startup_block.create_parameter(
+            shape=shape, dtype=dtype, **attr._to_kwargs())
+        init(sp, startup_block)
+        return self.main_program.global_block().create_parameter(
+            shape=shape, dtype=dtype, **attr._to_kwargs())
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None,
+                       bias_attr=None):
+        bias_attr = bias_attr if bias_attr is not None else \
+            self.kwargs.get('bias_attr')
+        if bias_attr is False:
+            return input_var
+        size = list(input_var.shape[dim_start:dim_end])
+        b = self.create_parameter(bias_attr, shape=size,
+                                  dtype=input_var.dtype, is_bias=True)
+        if b is None:
+            return input_var
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op('elementwise_add',
+                       inputs={'X': input_var, 'Y': b},
+                       outputs={'Out': out},
+                       attrs={'axis': dim_start})
+        return out
+
+    def append_activation(self, input_var, act=None):
+        act = act if act is not None else self.kwargs.get('act')
+        if act is None:
+            return input_var
+        if isinstance(act, str):
+            act = {'type': act}
+        act = dict(act)
+        act_type = act.pop('type')
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(act_type, inputs={'X': input_var},
+                       outputs={'Out': out}, attrs=act)
+        return out
+
+    def input(self, name='input'):
+        return self.kwargs[name]
+
+    @property
+    def param_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get('param_attr'))
+
+    @property
+    def bias_attr(self):
+        return ParamAttr._to_attr(self.kwargs.get('bias_attr'))

@@ -1,0 +1,12 @@
+"""fluid.layers namespace. Reference: python/paddle/fluid/layers/."""
+
+from . import nn
+from . import ops
+from . import tensor
+from . import io
+from . import math_op_patch  # noqa: F401
+
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .io import data  # noqa: F401

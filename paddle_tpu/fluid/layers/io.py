@@ -1,0 +1,15 @@
+"""Data layer. Reference: python/paddle/fluid/layers/io.py (data)."""
+
+from ..layer_helper import LayerHelper
+
+
+def data(name, shape, dtype='float32', lod_level=0, type=None,
+         append_batch_size=True, stop_gradient=True):
+    """Reference layers/io.py data: prepends -1 batch dim by default."""
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.create_global_variable(
+        name=name, shape=tuple(shape), dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, is_data=True, persistable=False)

@@ -1,0 +1,48 @@
+"""Operator overloading for Variable (+-*/ with scalars and Variables).
+
+Reference: python/paddle/fluid/layers/math_op_patch.py.
+"""
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+
+def _create_scalar_like(ref_var, value):
+    from . import tensor as t
+    shape = [1]
+    return t.fill_constant(shape, ref_var.dtype, float(value))
+
+
+def binary(self, other, op_type, reverse=False):
+    from . import ops as _ops
+    if isinstance(other, (int, float)):
+        # scalar fast paths lowered to the scale op
+        if op_type == 'elementwise_add':
+            return _ops.scale(self, scale=1.0, bias=float(other))
+        if op_type == 'elementwise_sub':
+            if reverse:
+                return _ops.scale(self, scale=-1.0, bias=float(other))
+            return _ops.scale(self, scale=1.0, bias=-float(other))
+        if op_type == 'elementwise_mul':
+            return _ops.scale(self, scale=float(other))
+        if op_type == 'elementwise_div' and not reverse:
+            return _ops.scale(self, scale=1.0 / float(other))
+        other = _create_scalar_like(self, other)
+    elif isinstance(other, np.ndarray):
+        from . import tensor as t
+        other = t.assign(other)
+    if not isinstance(other, Variable):
+        raise TypeError('cannot apply %s to %r' % (op_type, other))
+    x, y = (other, self) if reverse else (self, other)
+    helper = LayerHelper(op_type)
+    if op_type in ('less_than', 'less_equal', 'greater_than',
+                   'greater_equal', 'equal', 'not_equal'):
+        out = helper.create_variable_for_type_inference(
+            'bool', stop_gradient=True)
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={'axis': -1})
+    return out

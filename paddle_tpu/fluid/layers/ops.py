@@ -1,0 +1,174 @@
+"""Auto-generated-style unary layers. Reference:
+python/paddle/fluid/layers/ops.py (generated from OpProto via
+layer_function_generator.py) — here generated from the registry."""
+
+from ..layer_helper import LayerHelper
+
+_UNARY = [
+    'sigmoid', 'tanh', 'exp', 'relu', 'sqrt', 'rsqrt', 'abs', 'ceil',
+    'floor', 'cos', 'sin', 'tan', 'acos', 'asin', 'atan', 'sinh', 'cosh',
+    'round', 'reciprocal', 'square', 'softplus', 'softsign', 'log',
+    'log2', 'log10', 'log1p', 'erf', 'sign', 'silu',
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, inputs={'X': x}, outputs={'Out': out})
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = 'elementwise %s (TPU lowering in ops/activation_ops.py)' \
+        % op_type
+    return layer
+
+
+for _op in _UNARY:
+    globals()[_op] = _make_unary(_op)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper('scale', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('scale', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'scale': float(scale), 'bias': float(bias),
+                            'bias_after_scale': bias_after_scale})
+    return helper.append_activation(out, act)
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper('pow', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('pow', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'factor': float(factor)})
+    return out
+
+
+def gelu(x, approximate=False, name=None):
+    helper = LayerHelper('gelu', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('gelu', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'approximate': approximate})
+    return out
+
+
+def elu(x, alpha=1.0, name=None):
+    helper = LayerHelper('elu', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('elu', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'alpha': alpha})
+    return out
+
+
+def relu6(x, threshold=6.0, name=None):
+    helper = LayerHelper('relu6', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('relu6', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'threshold': threshold})
+    return out
+
+
+def swish(x, beta=1.0, name=None):
+    helper = LayerHelper('swish', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('swish', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'beta': beta})
+    return out
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    helper = LayerHelper('hard_sigmoid', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('hard_sigmoid', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'slope': slope, 'offset': offset})
+    return out
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    helper = LayerHelper('hard_swish', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('hard_swish', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'threshold': threshold, 'scale': scale,
+                            'offset': offset})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical('logical_and', x, y, out, name)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical('logical_or', x, y, out, name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical('logical_xor', x, y, out, name)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper('logical_not', name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            'bool', stop_gradient=True)
+    helper.append_op('logical_not', inputs={'X': x}, outputs={'Out': out})
+    return out
+
+
+def _logical(op, x, y, out=None, name=None):
+    helper = LayerHelper(op, name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            'bool', stop_gradient=True)
+    helper.append_op(op, inputs={'X': x, 'Y': y}, outputs={'Out': out})
+    return out
+
+
+def _compare(op, x, y, cond=None):
+    helper = LayerHelper(op)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            'bool', stop_gradient=True)
+    helper.append_op(op, inputs={'X': x, 'Y': y}, outputs={'Out': cond})
+    return cond
+
+
+def equal(x, y, cond=None):
+    return _compare('equal', x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare('not_equal', x, y, cond)
+
+
+def less_than(x, y, cond=None, force_cpu=None):
+    return _compare('less_than', x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare('less_equal', x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare('greater_than', x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare('greater_equal', x, y, cond)
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None):
+    helper = LayerHelper('cumsum')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if axis is not None:
+        attrs['axis'] = axis
+    if exclusive is not None:
+        attrs['exclusive'] = exclusive
+    if reverse is not None:
+        attrs['reverse'] = reverse
+    helper.append_op('cumsum', inputs={'X': x}, outputs={'Out': out},
+                     attrs=attrs)
+    return out

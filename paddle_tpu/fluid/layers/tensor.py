@@ -1,0 +1,214 @@
+"""Tensor layers. Reference: python/paddle/fluid/layers/tensor.py."""
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper('create_tensor', name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable, shape=())
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper('global_var', name=name)
+    var = helper.create_global_variable(
+        dtype=dtype, shape=tuple(shape), persistable=persistable,
+        name=name or helper.name)
+    from ..framework import default_startup_program
+    sb = default_startup_program().global_block()
+    sb.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                  persistable=persistable)
+    sb.append_op('fill_constant', outputs={'Out': var.name},
+                 attrs={'shape': list(shape), 'dtype': dtype,
+                        'value': float(value)})
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper('cast')
+    from .. import core
+    out = helper.create_variable_for_type_inference(core.dtype_name(dtype))
+    helper.append_op('cast', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'out_dtype': core.dtype_name(dtype)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper('concat', name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op('concat', inputs={'X': list(input)},
+                     outputs={'Out': out}, attrs={'axis': axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper('sum')
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op('sum', inputs={'X': list(input)},
+                     outputs={'Out': out})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper('assign')
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op('assign', inputs={'X': input},
+                         outputs={'Out': output})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(arr.dtype))
+        helper.append_op('assign_value', outputs={'Out': output},
+                         attrs={'shape': list(arr.shape),
+                                'dtype': str(arr.dtype),
+                                'values': arr.flatten().tolist()})
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper('fill_constant')
+    if out is None:
+        from .. import core
+        out = helper.create_variable_for_type_inference(
+            core.dtype_name(dtype))
+    helper.append_op('fill_constant', outputs={'Out': out},
+                     attrs={'shape': list(shape), 'dtype': dtype,
+                            'value': float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper('fill_constant_batch_size_like')
+    from .. import core
+    out = helper.create_variable_for_type_inference(core.dtype_name(dtype))
+    helper.append_op('fill_constant_batch_size_like',
+                     inputs={'Input': input}, outputs={'Out': out},
+                     attrs={'shape': list(shape), 'dtype': dtype,
+                            'value': float(value),
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx})
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype='float32', force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype='float32', force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper('ones_like')
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('fill_any_like', inputs={'X': x},
+                     outputs={'Out': out}, attrs={'value': 1.0})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper('zeros_like')
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('fill_zeros_like', inputs={'X': x},
+                     outputs={'Out': out})
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper('arg_max')
+    out = helper.create_variable_for_type_inference('int64',
+                                                    stop_gradient=True)
+    helper.append_op('arg_max', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper('arg_min')
+    out = helper.create_variable_for_type_inference('int64',
+                                                    stop_gradient=True)
+    helper.append_op('arg_min', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper('argsort', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference('int64',
+                                                    stop_gradient=True)
+    helper.append_op('argsort', inputs={'X': input},
+                     outputs={'Out': out, 'Indices': ids},
+                     attrs={'axis': axis, 'descending': descending})
+    return out, ids
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper('range')
+    from .. import core
+    s = fill_constant([1], dtype, start)
+    e = fill_constant([1], dtype, end)
+    st = fill_constant([1], dtype, step)
+    out = helper.create_variable_for_type_inference(core.dtype_name(dtype))
+    helper.append_op('range',
+                     inputs={'Start': s, 'End': e, 'Step': st},
+                     outputs={'Out': out},
+                     attrs={'__static__': [float(start), float(end),
+                                           float(step)]})
+    out.stop_gradient = True
+    return out
+
+
+def linspace(start, stop, num, dtype='float32'):
+    step = (float(stop) - float(start)) / max(int(num) - 1, 1)
+    return range(start, float(stop) + step / 2, step, dtype)
+
+
+def diag(diagonal):
+    raise NotImplementedError
+
+
+def reverse(x, axis):
+    helper = LayerHelper('reverse')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('flip', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': [axis] if isinstance(axis, int)
+                            else list(axis)})
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper('isinf')
+    out = helper.create_variable_for_type_inference('bool',
+                                                    stop_gradient=True)
+    helper.append_op('isinf', inputs={'X': [x]}, outputs={'Out': out})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper('isnan')
+    out = helper.create_variable_for_type_inference('bool',
+                                                    stop_gradient=True)
+    helper.append_op('isnan', inputs={'X': [x]}, outputs={'Out': out})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper('isfinite')
+    out = helper.create_variable_for_type_inference('bool',
+                                                    stop_gradient=True)
+    helper.append_op('isfinite', inputs={'X': [x]}, outputs={'Out': out})
+    return out

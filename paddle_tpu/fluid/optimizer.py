@@ -1,0 +1,562 @@
+"""Optimizers: append update ops to the program.
+
+Reference: python/paddle/fluid/optimizer.py — Optimizer.minimize(:690) =
+append_backward + apply_gradients(:575); per-optimizer _append_optimize_op
+(:293).  The update ops lower to pure XLA functions whose outputs alias the
+parameter vars (ops/optimizer_ops.py), giving donated-buffer in-place
+updates on TPU.
+"""
+
+import numpy as np
+
+from . import core
+from . import framework
+from . import unique_name
+from .backward import append_backward
+from .framework import Variable, default_main_program, \
+    default_startup_program
+from .initializer import Constant
+from .layer_helper import LayerHelper
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 grad_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators = {}  # acc_name -> {param_name: var}
+        self._learning_rate_map = {}
+        self.helper = None
+        self.type = getattr(self, 'type', 'optimizer')
+
+    # -- learning rate ----------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        name = unique_name.generate('learning_rate')
+        lr_var = program.global_block().create_var(
+            name=name, shape=(1,), dtype='float32', persistable=True)
+        lr_var.stop_gradient = True
+        sb = default_startup_program().global_block()
+        sb.create_var(name=name, shape=(1,), dtype='float32',
+                      persistable=True)
+        sb.append_op('fill_constant', outputs={'Out': name},
+                     attrs={'shape': [1], 'dtype': 'float32',
+                            'value': float(self._learning_rate)})
+        self._learning_rate_map[program] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        program = program or default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        base_lr = self._global_learning_rate()
+        param_lr = getattr(param, 'optimize_attr',
+                           {'learning_rate': 1.0}).get('learning_rate', 1.0)
+        if param_lr == 1.0:
+            return base_lr
+        from .layers import ops as _ops
+        return _ops.scale(base_lr, scale=float(param_lr))
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        if name in self._accumulators and \
+                param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or param.dtype
+        var_name = unique_name.generate(param.name + '_' + name)
+        block = default_main_program().global_block()
+        var = block.create_var(name=var_name, shape=tuple(shape),
+                               dtype=dtype, persistable=True)
+        var.stop_gradient = True
+        sb = default_startup_program().global_block()
+        sb.create_var(name=var_name, shape=tuple(shape), dtype=dtype,
+                      persistable=True)
+        sb.append_op('fill_constant', outputs={'Out': var_name},
+                     attrs={'shape': shape, 'dtype': dtype,
+                            'value': float(fill_value)})
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- pipeline ----------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        """Reference: optimizer.py:575."""
+        from .clip import append_gradient_clip_ops
+        from .regularizer import append_regularization_ops
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        else:
+            params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        block = default_main_program().global_block()
+        self._create_global_learning_rate()
+        self._create_accumulators(block, [p for p, g in params_grads])
+        optimize_ops = []
+        for pg in params_grads:
+            if pg[1] is None:
+                continue
+            optimize_ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return optimize_ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        """Reference: optimizer.py:690."""
+        if grad_clip is not None:
+            self._grad_clip = grad_clip
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    type = 'sgd'
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            'sgd',
+            inputs={'Param': p, 'Grad': g,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p}, infer_shape=False)
+
+
+class MomentumOptimizer(Optimizer):
+    type = 'momentum'
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 **kwargs):
+        super(MomentumOptimizer, self).__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('velocity', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        velocity = self._get_accumulator('velocity', p)
+        return block.append_op(
+            'momentum',
+            inputs={'Param': p, 'Grad': g, 'Velocity': velocity,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'VelocityOut': velocity},
+            attrs={'mu': self._momentum,
+                   'use_nesterov': self._use_nesterov},
+            infer_shape=False)
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = 'lars_momentum'
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kwargs):
+        super(LarsMomentumOptimizer, self).__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('velocity', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        velocity = self._get_accumulator('velocity', p)
+        return block.append_op(
+            'lars_momentum',
+            inputs={'Param': p, 'Grad': g, 'Velocity': velocity,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'VelocityOut': velocity},
+            attrs={'mu': self._momentum, 'lars_coeff': self._lars_coeff,
+                   'lars_weight_decay': self._lars_weight_decay},
+            infer_shape=False)
+
+
+class AdamOptimizer(Optimizer):
+    type = 'adam'
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super(AdamOptimizer, self).__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('moment1', p)
+            self._add_accumulator('moment2', p)
+            self._add_accumulator('beta1_pow_acc', p, fill_value=1.0,
+                                  shape=[1])
+            self._add_accumulator('beta2_pow_acc', p, fill_value=1.0,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator('moment1', p)
+        m2 = self._get_accumulator('moment2', p)
+        b1p = self._get_accumulator('beta1_pow_acc', p)
+        b2p = self._get_accumulator('beta2_pow_acc', p)
+        return block.append_op(
+            'adam',
+            inputs={'Param': p, 'Grad': g, 'Moment1': m1, 'Moment2': m2,
+                    'Beta1Pow': b1p, 'Beta2Pow': b2p,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2,
+                     'Beta1PowOut': b1p, 'Beta2PowOut': b2p},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon},
+            infer_shape=False)
+
+
+class AdamWOptimizer(AdamOptimizer):
+    type = 'adamw'
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kwargs):
+        super(AdamWOptimizer, self).__init__(learning_rate, **kwargs)
+        self._coeff = weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator('moment1', p)
+        m2 = self._get_accumulator('moment2', p)
+        b1p = self._get_accumulator('beta1_pow_acc', p)
+        b2p = self._get_accumulator('beta2_pow_acc', p)
+        return block.append_op(
+            'adamw',
+            inputs={'Param': p, 'Grad': g, 'Moment1': m1, 'Moment2': m2,
+                    'Beta1Pow': b1p, 'Beta2Pow': b2p,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2,
+                     'Beta1PowOut': b1p, 'Beta2PowOut': b2p},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon, 'coeff': self._coeff},
+            infer_shape=False)
+
+
+class AdagradOptimizer(Optimizer):
+    type = 'adagrad'
+
+    def __init__(self, learning_rate, epsilon=1e-6,
+                 initial_accumulator_value=0.0, **kwargs):
+        super(AdagradOptimizer, self).__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('moment', p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator('moment', p)
+        return block.append_op(
+            'adagrad',
+            inputs={'Param': p, 'Grad': g, 'Moment': moment,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'MomentOut': moment},
+            attrs={'epsilon': self._epsilon}, infer_shape=False)
+
+
+class AdamaxOptimizer(Optimizer):
+    type = 'adamax'
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super(AdamaxOptimizer, self).__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('moment', p)
+            self._add_accumulator('inf_norm', p)
+            self._add_accumulator('beta1_pow_acc', p,
+                                  fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            'adamax',
+            inputs={'Param': p, 'Grad': g,
+                    'Moment': self._get_accumulator('moment', p),
+                    'InfNorm': self._get_accumulator('inf_norm', p),
+                    'Beta1Pow': self._get_accumulator('beta1_pow_acc', p),
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p,
+                     'MomentOut': self._get_accumulator('moment', p),
+                     'InfNormOut': self._get_accumulator('inf_norm', p)},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon}, infer_shape=False)
+
+    def _finish_update(self, block, params_grads):
+        for p, g in params_grads:
+            b1p = self._get_accumulator('beta1_pow_acc', p)
+            block.append_op('scale', inputs={'X': b1p},
+                            outputs={'Out': b1p},
+                            attrs={'scale': self._beta1},
+                            infer_shape=False)
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = 'adadelta'
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super(AdadeltaOptimizer, self).__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('avg_squared_grad', p)
+            self._add_accumulator('avg_squared_update', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator('avg_squared_grad', p)
+        asu = self._get_accumulator('avg_squared_update', p)
+        return block.append_op(
+            'adadelta',
+            inputs={'Param': p, 'Grad': g, 'AvgSquaredGrad': asg,
+                    'AvgSquaredUpdate': asu},
+            outputs={'ParamOut': p, 'AvgSquaredGradOut': asg,
+                     'AvgSquaredUpdateOut': asu},
+            attrs={'epsilon': self._epsilon, 'rho': self._rho},
+            infer_shape=False)
+
+
+class RMSPropOptimizer(Optimizer):
+    type = 'rmsprop'
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super(RMSPropOptimizer, self).__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('momentum', p)
+            self._add_accumulator('mean_square', p)
+            self._add_accumulator('mean_grad', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        mom = self._get_accumulator('momentum', p)
+        ms = self._get_accumulator('mean_square', p)
+        mg = self._get_accumulator('mean_grad', p)
+        return block.append_op(
+            'rmsprop',
+            inputs={'Param': p, 'Grad': g, 'Moment': mom,
+                    'MeanSquare': ms, 'MeanGrad': mg,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'MomentOut': mom, 'MeanSquareOut': ms,
+                     'MeanGradOut': mg},
+            attrs={'decay': self._rho, 'epsilon': self._epsilon,
+                   'momentum': self._momentum, 'centered': self._centered},
+            infer_shape=False)
+
+
+class FtrlOptimizer(Optimizer):
+    type = 'ftrl'
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super(FtrlOptimizer, self).__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('squared', p)
+            self._add_accumulator('linear', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator('squared', p)
+        lin = self._get_accumulator('linear', p)
+        return block.append_op(
+            'ftrl',
+            inputs={'Param': p, 'Grad': g, 'SquaredAccumulator': sq,
+                    'LinearAccumulator': lin,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'SquaredAccumOut': sq,
+                     'LinearAccumOut': lin},
+            attrs={'l1': self._l1, 'l2': self._l2,
+                   'lr_power': self._lr_power}, infer_shape=False)
+
+
+class LambOptimizer(AdamOptimizer):
+    type = 'lamb'
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kwargs):
+        super(LambOptimizer, self).__init__(learning_rate, beta1=beta1,
+                                            beta2=beta2, epsilon=epsilon,
+                                            **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m1 = self._get_accumulator('moment1', p)
+        m2 = self._get_accumulator('moment2', p)
+        b1p = self._get_accumulator('beta1_pow_acc', p)
+        b2p = self._get_accumulator('beta2_pow_acc', p)
+        return block.append_op(
+            'lamb',
+            inputs={'Param': p, 'Grad': g, 'Moment1': m1, 'Moment2': m2,
+                    'Beta1Pow': b1p, 'Beta2Pow': b2p,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2,
+                     'Beta1PowOut': b1p, 'Beta2PowOut': b2p},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon, 'weight_decay': wd},
+            infer_shape=False)
+
+
+class DpsgdOptimizer(Optimizer):
+    type = 'dpsgd'
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kwargs):
+        super(DpsgdOptimizer, self).__init__(learning_rate, **kwargs)
+        self._clip, self._sigma = clip, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            'dpsgd',
+            inputs={'Param': p, 'Grad': g,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p},
+            attrs={'clip': self._clip, 'sigma': self._sigma},
+            infer_shape=False)
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation checkpointing. Reference: optimizer.py:3611 +
+    backward.py:618 (_append_backward_ops_with_checkpoints_).
+
+    On TPU the vjp-grad design already recomputes forward inside each grad
+    op; whether XLA CSE dedupes (memory-heavy) or rematerializes is
+    controlled by wrapping checkpoint spans in jax.checkpoint at segment
+    lowering time.
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set,
+                               callbacks, checkpoints=self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        return self.apply_gradients(params_grads), params_grads
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        raise NotImplementedError('ModelAverage: planned')
+
+
+class ExponentialMovingAverage(object):
+    """Reference: optimizer.py:3063."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or 'ema'
+        self._ema_vars = {}
+
+    def update(self):
+        block = default_main_program().global_block()
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            name = p.name + '.' + self._name
+            ema = block.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                                   persistable=True)
+            ema.stop_gradient = True
+            sb = default_startup_program().global_block()
+            sb.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                          persistable=True)
+            sb.append_op('fill_constant', outputs={'Out': name},
+                         attrs={'shape': list(p.shape), 'dtype': p.dtype,
+                                'value': 0.0})
+            self._ema_vars[p.name] = ema
+            # ema = decay*ema + (1-decay)*p
+            tmp = block.create_var(
+                name=unique_name.generate(name + '_tmp'),
+                shape=p.shape, dtype=p.dtype)
+            block.append_op('scale', inputs={'X': ema},
+                            outputs={'Out': tmp},
+                            attrs={'scale': self._decay})
+            block.append_op('scale', inputs={'X': p},
+                            outputs={'Out': name},
+                            attrs={'scale': 1 - self._decay},
+                            infer_shape=False)
+            block.append_op('elementwise_add',
+                            inputs={'X': tmp, 'Y': name},
+                            outputs={'Out': name}, infer_shape=False)
+
+
+# Short aliases matching fluid.optimizer namespace
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adagrad = AdagradOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer

@@ -1,0 +1,45 @@
+"""Profiler over jax.profiler (XPlane/Perfetto).
+
+Reference: python/paddle/fluid/profiler.py:129 (profiler context manager)
+over platform/profiler.h RecordEvent + CUPTI DeviceTracer.  The TPU
+equivalent captures an XLA trace viewable in TensorBoard/Perfetto.
+"""
+
+import contextlib
+import os
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile'):
+    os.makedirs(profile_path, exist_ok=True)
+    jax.profiler.start_trace(profile_path)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        print('[profiler] %.3fs traced -> %s' % (time.time() - t0,
+                                                 profile_path))
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    yield
+
+
+def start_profiler(state='All'):
+    jax.profiler.start_trace('/tmp/profile')
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    pass
+
+
+record_event = jax.profiler.TraceAnnotation

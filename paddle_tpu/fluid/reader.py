@@ -1,0 +1,86 @@
+"""DataLoader. Reference: python/paddle/fluid/reader.py —
+DataLoader.from_generator(:75) feeding a LoDTensorBlockingQueue(:298).
+
+Round-1 implementation is a synchronous host iterator; the C++
+double-buffered feeder (operators/reader/buffered_reader.cc analog)
+lands with the native runtime components.
+"""
+
+import numpy as np
+
+from . import core
+
+
+class DataLoader(object):
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False):
+        return GeneratorLoader(feed_list, capacity, iterable)
+
+    @staticmethod
+    def from_dataset(dataset, places, drop_last=True):
+        raise NotImplementedError('from_dataset: Dataset runtime lands '
+                                  'with the trainer subsystem')
+
+
+class GeneratorLoader(object):
+    def __init__(self, feed_list, capacity=64, iterable=True):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._generator = None
+        self._places = None
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batched():
+            batch = []
+            for sample in reader():
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    yield batch
+                    batch = []
+            if batch and not drop_last:
+                yield batch
+        return self.set_sample_list_generator(batched, places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        from .data_feeder import DataFeeder
+        place = places[0] if isinstance(places, (list, tuple)) else \
+            (places or core.XLAPlace(0))
+        feeder = DataFeeder(self._feed_list, place)
+
+        def gen():
+            for batch in reader():
+                yield feeder.feed(batch)
+        self._generator = gen
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def gen():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield {v.name: np.asarray(a)
+                           for v, a in zip(self._feed_list, batch)}
+        self._generator = gen
+        return self
+
+    def __iter__(self):
+        if self._generator is None:
+            raise RuntimeError('DataLoader: call set_*_generator first')
+        return iter(self._generator())
+
+    def start(self):
+        self._iter = iter(self._generator())
+
+    def next(self):
+        return next(self._iter)
+
+    def reset(self):
+        self._iter = iter(self._generator())
+
+
+PyReader = GeneratorLoader

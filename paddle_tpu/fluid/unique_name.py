@@ -1,0 +1,46 @@
+"""Unique name generator. Reference: python/paddle/fluid/unique_name.py."""
+
+import contextlib
+
+
+class UniqueNameGenerator(object):
+    def __init__(self, prefix=""):
+        self.ids = {}
+        self.prefix = prefix
+
+    def __call__(self, key):
+        if key not in self.ids:
+            self.ids[key] = 0
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global generator
+    old = generator
+    if new_generator is None:
+        generator = UniqueNameGenerator()
+    elif isinstance(new_generator, str):
+        generator = UniqueNameGenerator(new_generator)
+    else:
+        generator = new_generator
+    try:
+        yield
+    finally:
+        generator = old
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator or UniqueNameGenerator()
+    return old

@@ -1,0 +1,18 @@
+"""Operator registry + JAX lowerings (the kernel library).
+
+Importing this package registers all ops.  Reference scale:
+paddle/fluid/operators/ has 364 REGISTER_OPERATOR ops across ~96k LoC of
+C++/CUDA; here each op is a traceable JAX lowering and gradients are
+synthesized with jax.vjp, so the whole library is a few files.
+"""
+
+from . import registry  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import activation_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import host_ops  # noqa: F401
+from . import amp_ops  # noqa: F401
+
+from .registry import register, register_host, get, is_registered  # noqa

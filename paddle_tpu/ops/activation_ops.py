@@ -1,0 +1,160 @@
+"""Activation op lowerings.
+
+Reference: paddle/fluid/operators/activation_op.cc|.cu|.h — one file of
+dozens of functors with hand-written grads.  Here each activation is its
+jnp expression; XLA fuses them into neighbouring matmuls and jax.vjp
+supplies gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _unary(name, fn):
+    @register(name)
+    def op(ctx, ins, attrs, _fn=fn):
+        return {'Out': [_fn(ins['X'][0])]}
+    return op
+
+
+_unary('relu', jax.nn.relu)
+_unary('sigmoid', jax.nn.sigmoid)
+_unary('tanh', jnp.tanh)
+_unary('sqrt', jnp.sqrt)
+_unary('rsqrt', jax.lax.rsqrt)
+_unary('abs', jnp.abs)
+_unary('ceil', jnp.ceil)
+_unary('floor', jnp.floor)
+_unary('round', jnp.round)
+_unary('cos', jnp.cos)
+_unary('sin', jnp.sin)
+_unary('tan', jnp.tan)
+_unary('acos', jnp.arccos)
+_unary('asin', jnp.arcsin)
+_unary('atan', jnp.arctan)
+_unary('sinh', jnp.sinh)
+_unary('cosh', jnp.cosh)
+_unary('exp', jnp.exp)
+_unary('log', jnp.log)
+_unary('log2', jnp.log2)
+_unary('log10', jnp.log10)
+_unary('log1p', jnp.log1p)
+_unary('square', jnp.square)
+_unary('reciprocal', lambda x: 1.0 / x)
+_unary('softplus', jax.nn.softplus)
+_unary('softsign', jax.nn.soft_sign)
+_unary('erf', jax.lax.erf)
+_unary('sign', jnp.sign)
+_unary('silu', jax.nn.silu)
+
+
+@register('gelu')
+def gelu(ctx, ins, attrs):
+    return {'Out': [jax.nn.gelu(ins['X'][0],
+                                approximate=attrs.get('approximate', False))]}
+
+
+@register('leaky_relu')
+def leaky_relu(ctx, ins, attrs):
+    a = attrs.get('alpha', 0.02)
+    x = ins['X'][0]
+    return {'Out': [jnp.where(x > 0, x, a * x)]}
+
+
+@register('elu')
+def elu(ctx, ins, attrs):
+    return {'Out': [jax.nn.elu(ins['X'][0], attrs.get('alpha', 1.0))]}
+
+
+@register('relu6')
+def relu6(ctx, ins, attrs):
+    return {'Out': [jnp.clip(ins['X'][0], 0.0, attrs.get('threshold', 6.0))]}
+
+
+@register('pow')
+def pow_op(ctx, ins, attrs):
+    return {'Out': [jnp.power(ins['X'][0], attrs.get('factor', 1.0))]}
+
+
+@register('hard_sigmoid')
+def hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get('slope', 0.2)
+    offset = attrs.get('offset', 0.5)
+    return {'Out': [jnp.clip(slope * ins['X'][0] + offset, 0.0, 1.0)]}
+
+
+@register('hard_swish')
+def hard_swish(ctx, ins, attrs):
+    x = ins['X'][0]
+    t = attrs.get('threshold', 6.0)
+    s = attrs.get('scale', 6.0)
+    o = attrs.get('offset', 3.0)
+    return {'Out': [x * jnp.clip(x + o, 0.0, t) / s]}
+
+
+@register('swish')
+def swish(ctx, ins, attrs):
+    x = ins['X'][0]
+    beta = attrs.get('beta', 1.0)
+    return {'Out': [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register('mish')
+def mish(ctx, ins, attrs):
+    x = ins['X'][0]
+    return {'Out': [x * jnp.tanh(jax.nn.softplus(x))]}
+
+
+@register('thresholded_relu')
+def thresholded_relu(ctx, ins, attrs):
+    x = ins['X'][0]
+    t = attrs.get('threshold', 1.0)
+    return {'Out': [jnp.where(x > t, x, jnp.zeros_like(x))]}
+
+
+@register('hard_shrink')
+def hard_shrink(ctx, ins, attrs):
+    x = ins['X'][0]
+    t = attrs.get('threshold', 0.5)
+    return {'Out': [jnp.where(jnp.abs(x) > t, x, jnp.zeros_like(x))]}
+
+
+@register('soft_shrink')
+def soft_shrink(ctx, ins, attrs):
+    x = ins['X'][0]
+    lam = attrs.get('lambda', 0.5)
+    return {'Out': [jnp.where(x > lam, x - lam,
+                              jnp.where(x < -lam, x + lam,
+                                        jnp.zeros_like(x)))]}
+
+
+@register('softmax')
+def softmax(ctx, ins, attrs):
+    return {'Out': [jax.nn.softmax(ins['X'][0],
+                                   axis=attrs.get('axis', -1))]}
+
+
+@register('log_softmax')
+def log_softmax(ctx, ins, attrs):
+    return {'Out': [jax.nn.log_softmax(ins['X'][0],
+                                       axis=attrs.get('axis', -1))]}
+
+
+@register('prelu')
+def prelu(ctx, ins, attrs):
+    x = ins['X'][0]
+    alpha = ins['Alpha'][0]
+    mode = attrs.get('mode', 'all')
+    if mode == 'channel':
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {'Out': [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register('maxout')
+def maxout(ctx, ins, attrs):
+    x = ins['X'][0]
+    groups = attrs['groups']
+    n, c, h, w = x.shape
+    return {'Out': [x.reshape(n, c // groups, groups, h, w).max(axis=2)]}

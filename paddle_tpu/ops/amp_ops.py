@@ -1,0 +1,53 @@
+"""Mixed-precision support ops.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/decorator.py:27
+(OptimizerWithMixedPrecision with dynamic loss scaling).  These two ops are
+the kernel side of that rewrite, lowered as pure XLA so the whole
+loss-scaling state machine stays on-device (no host sync per step).
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('check_finite_and_unscale',
+          no_grad_out_slots=('FoundInfinite',))
+def check_finite_and_unscale(ctx, ins, attrs):
+    scale = ins['Scale'][0].reshape(())
+    found_inf = jnp.array(False)
+    outs = []
+    for g in ins['X']:
+        found_inf = jnp.logical_or(found_inf,
+                                   jnp.logical_not(jnp.all(
+                                       jnp.isfinite(g))))
+    for g in ins['X']:
+        u = g / scale
+        outs.append(jnp.where(found_inf, jnp.zeros_like(u), u))
+    return {'Out': outs, 'FoundInfinite': [found_inf]}
+
+
+@register('update_loss_scaling',
+          no_grad_out_slots=('LossScaling', 'OutGoodSteps', 'OutBadSteps'))
+def update_loss_scaling(ctx, ins, attrs):
+    found_inf = ins['FoundInfinite'][0].reshape(())
+    scale = ins['PrevLossScaling'][0].reshape(())
+    good = ins['InGoodSteps'][0].reshape(())
+    bad = ins['InBadSteps'][0].reshape(())
+    incr_every = attrs.get('incr_every_n_steps', 1000)
+    decr_every = attrs.get('decr_every_n_nan_or_inf', 2)
+    incr_ratio = attrs.get('incr_ratio', 2.0)
+    decr_ratio = attrs.get('decr_ratio', 0.5)
+
+    good_new = jnp.where(found_inf, 0, good + 1)
+    bad_new = jnp.where(found_inf, bad + 1, 0)
+    do_incr = good_new >= incr_every
+    do_decr = bad_new >= decr_every
+    scale_new = jnp.where(do_incr, scale * incr_ratio,
+                          jnp.where(do_decr, scale * decr_ratio, scale))
+    scale_new = jnp.maximum(scale_new, attrs.get('min_loss_scaling', 1.0))
+    good_new = jnp.where(do_incr, 0, good_new)
+    bad_new = jnp.where(do_decr, 0, bad_new)
+    return {'LossScaling': [scale_new.reshape(1)],
+            'OutGoodSteps': [good_new.reshape(1).astype(jnp.int32)],
+            'OutBadSteps': [bad_new.reshape(1).astype(jnp.int32)]}

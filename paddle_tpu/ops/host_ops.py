@@ -1,0 +1,75 @@
+"""Host-level ops: feed/fetch/save/load/print.
+
+Reference: operators/controlflow/feed_op.cc, fetch_op.cc, operators/save_op.cc,
+load_op.cc, print_op.cc.  These never enter an XLA computation; the executor
+runs them on the host at segment boundaries.
+"""
+
+import os
+
+import numpy as np
+
+from .registry import register_host
+
+
+@register_host('feed')
+def feed(executor, scope, op):
+    pass  # handled by Executor.run feed dict
+
+
+@register_host('fetch')
+def fetch(executor, scope, op):
+    pass  # handled by Executor.run fetch_list
+
+
+@register_host('print')
+def print_op(executor, scope, op):
+    from ..fluid import core
+    name = op.input('In')[0]
+    val = scope.find_var(name)
+    msg = op.attr('message', '')
+    print('%s %s %s' % (msg, name, np.asarray(core.as_array(val))))
+
+
+def _save_path(op):
+    return op.attr('file_path')
+
+
+@register_host('save')
+def save(executor, scope, op):
+    from ..fluid import core
+    path = _save_path(op)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    name = op.input('X')[0]
+    val = core.as_array(scope.find_var(name))
+    np.save(path + '.npy', np.asarray(val), allow_pickle=False)
+
+
+@register_host('load')
+def load(executor, scope, op):
+    path = _save_path(op)
+    name = op.output('Out')[0]
+    scope.set_var(name, np.load(path + '.npy'))
+
+
+@register_host('save_combine')
+def save_combine(executor, scope, op):
+    from ..fluid import core
+    path = _save_path(op)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrs = {}
+    for name in op.input('X'):
+        arrs[name] = np.asarray(core.as_array(scope.find_var(name)))
+    np.savez(path + '.npz', **arrs)
+
+
+@register_host('load_combine')
+def load_combine(executor, scope, op):
+    path = _save_path(op)
+    data = np.load(path + '.npz')
+    for name in op.output('Out'):
+        scope.set_var(name, data[name])

@@ -1,0 +1,495 @@
+"""NN op lowerings: conv, pool, norm, dropout, losses, metrics.
+
+Reference kernels: operators/conv_cudnn_op.cu, pool_op.*, batch_norm_op.*,
+layer_norm_op.*, dropout_op.*, softmax_with_cross_entropy_op.*,
+cross_entropy_op.*, metrics/accuracy_op.* — re-designed on
+lax.conv_general_dilated / reduce_window so XLA tiles them onto the MXU.
+Gradients come from jax.vjp over these lowerings (registry.grad_op_def).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+def _conv_padding(paddings, algo, ksize, strides, dilations):
+    if algo == 'VALID':
+        return [(0, 0), (0, 0)]
+    if algo == 'SAME':
+        return 'SAME'
+    p = _pair(paddings)
+    if len(p) == 2:
+        return [(p[0], p[0]), (p[1], p[1])]
+    if len(p) == 4:
+        return [(p[0], p[1]), (p[2], p[3])]
+    raise ValueError('bad paddings %s' % (paddings,))
+
+
+@register('conv2d')
+def conv2d(ctx, ins, attrs):
+    x = ins['Input'][0]
+    w = ins['Filter'][0]
+    strides = _pair(attrs.get('strides', [1, 1]))
+    dilations = _pair(attrs.get('dilations', [1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    data_format = attrs.get('data_format', 'NCHW')
+    if data_format in ('NCHW', 'AnyLayout'):
+        dn = ('NCHW', 'OIHW', 'NCHW')
+    else:
+        dn = ('NHWC', 'HWIO', 'NHWC')
+        if w.ndim == 4 and w.shape[1] != x.shape[-1] // groups:
+            # weights stored OIHW: convert
+            w = jnp.transpose(w, (2, 3, 1, 0))
+    pad = _conv_padding(attrs.get('paddings', [0, 0]),
+                        attrs.get('padding_algorithm', 'EXPLICIT'),
+                        w.shape[-2:], strides, dilations)
+    if attrs.get('__amp__') and x.dtype == jnp.float32:
+        x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=jnp.float32 if x.dtype != jnp.float64
+        else None)
+    return {'Output': [out.astype(ins['Input'][0].dtype)]}
+
+
+@register('depthwise_conv2d')
+def depthwise_conv2d(ctx, ins, attrs):
+    return conv2d(ctx, ins, attrs)
+
+
+@register('conv2d_transpose')
+def conv2d_transpose(ctx, ins, attrs):
+    x = ins['Input'][0]
+    w = ins['Filter'][0]  # [in_c, out_c/groups, kh, kw]
+    strides = _pair(attrs.get('strides', [1, 1]))
+    dilations = _pair(attrs.get('dilations', [1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    p = _pair(attrs.get('paddings', [0, 0]))
+    pad = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else [
+        (p[0], p[1]), (p[2], p[3])]
+    out = jax.lax.conv_transpose(
+        x, jnp.transpose(w, (2, 3, 0, 1)),  # -> HWIO with I=in_c
+        strides=strides, padding=[(ph[0], ph[1]) for ph in pad],
+        rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'HWIO', 'NCHW'),
+        transpose_kernel=True)
+    return {'Output': [out]}
+
+
+@register('pool2d')
+def pool2d(ctx, ins, attrs):
+    x = ins['X'][0]
+    ptype = attrs.get('pooling_type', 'max')
+    ksize = _pair(attrs.get('ksize', [2, 2]))
+    strides = _pair(attrs.get('strides', [2, 2]))
+    p = _pair(attrs.get('paddings', [0, 0]))
+    data_format = attrs.get('data_format', 'NCHW')
+    nchw = data_format in ('NCHW', 'AnyLayout')
+    hw = (2, 3) if nchw else (1, 2)
+    if attrs.get('global_pooling', False) or attrs.get('adaptive', False) \
+            and list(attrs.get('ksize')) == [1, 1]:
+        if ptype == 'max':
+            out = jnp.max(x, axis=hw, keepdims=True)
+        else:
+            out = jnp.mean(x, axis=hw, keepdims=True)
+        return {'Out': [out]}
+    window = [1, 1, 1, 1]
+    stride4 = [1, 1, 1, 1]
+    pad4 = [(0, 0)] * 4
+    for i, d in enumerate(hw):
+        window[d] = ksize[i]
+        stride4[d] = strides[i]
+        pad4[d] = (p[i], p[i]) if len(p) == 2 else (p[2 * i], p[2 * i + 1])
+    if attrs.get('padding_algorithm') == 'SAME':
+        pad4 = 'SAME'
+    if ptype == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride4,
+                                    pad4)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride4, pad4)
+        if attrs.get('exclusive', True) and pad4 != 'SAME' and \
+                any(ph != (0, 0) for ph in (pad4 if pad4 != 'SAME' else [])):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        stride4, pad4)
+            out = s / cnt
+        else:
+            out = s / float(np.prod([window[d] for d in hw]))
+    return {'Out': [out]}
+
+
+@register('batch_norm', no_grad_out_slots=('MeanOut', 'VarianceOut',
+                                           'SavedMean', 'SavedVariance'))
+def batch_norm(ctx, ins, attrs):
+    """Reference operators/batch_norm_op.cc. In-place running-stat update:
+    MeanOut/VarianceOut alias the Mean/Variance input vars in the program."""
+    x = ins['X'][0]
+    scale = ins['Scale'][0]
+    bias = ins['Bias'][0]
+    mean = ins['Mean'][0]
+    var = ins['Variance'][0]
+    eps = attrs.get('epsilon', 1e-5)
+    momentum = attrs.get('momentum', 0.9)
+    is_test = attrs.get('is_test', False)
+    use_global = attrs.get('use_global_stats', False) or is_test
+    layout = attrs.get('data_layout', 'NCHW')
+    caxis = 1 if layout in ('NCHW', 'AnyLayout') else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = tuple(x.shape[caxis] if i == caxis else 1
+                   for i in range(x.ndim))
+
+    xf = x.astype(jnp.float32)
+    if use_global:
+        m, v = mean, var
+        saved_m, saved_v = mean, var
+    else:
+        m = jnp.mean(xf, axis=red)
+        v = jnp.var(xf, axis=red)
+        saved_m, saved_v = m, v
+    inv = jax.lax.rsqrt(v.astype(jnp.float32) + eps)
+    y = (xf - m.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    if use_global:
+        mean_out, var_out = mean, var
+    else:
+        n = np.prod([x.shape[i] for i in red])
+        unbiased = v * (n / max(n - 1.0, 1.0))
+        mean_out = momentum * mean + (1.0 - momentum) * m
+        var_out = momentum * var + (1.0 - momentum) * unbiased
+    return {'Y': [y.astype(x.dtype)],
+            'MeanOut': [mean_out], 'VarianceOut': [var_out],
+            'SavedMean': [saved_m], 'SavedVariance': [inv]}
+
+
+@register('layer_norm', no_grad_out_slots=('Mean', 'Variance'))
+def layer_norm(ctx, ins, attrs):
+    x = ins['X'][0]
+    eps = attrs.get('epsilon', 1e-5)
+    begin = attrs.get('begin_norm_axis', 1)
+    shape = x.shape
+    lead = int(np.prod(shape[:begin]))
+    x2 = x.reshape(lead, -1).astype(jnp.float32)
+    m = jnp.mean(x2, axis=1, keepdims=True)
+    v = jnp.var(x2, axis=1, keepdims=True)
+    y = (x2 - m) * jax.lax.rsqrt(v + eps)
+    y = y.reshape(shape)
+    if 'Scale' in ins and ins['Scale']:
+        y = y * ins['Scale'][0].reshape((1,) * begin + shape[begin:])
+    if 'Bias' in ins and ins['Bias']:
+        y = y + ins['Bias'][0].reshape((1,) * begin + shape[begin:])
+    return {'Y': [y.astype(x.dtype)],
+            'Mean': [m.reshape(lead)], 'Variance': [v.reshape(lead)]}
+
+
+@register('instance_norm', no_grad_out_slots=('SavedMean', 'SavedVariance'))
+def instance_norm(ctx, ins, attrs):
+    x = ins['X'][0]
+    eps = attrs.get('epsilon', 1e-5)
+    red = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    if 'Scale' in ins and ins['Scale']:
+        c = x.shape[1]
+        y = y * ins['Scale'][0].reshape(1, c, *([1] * (x.ndim - 2)))
+        y = y + ins['Bias'][0].reshape(1, c, *([1] * (x.ndim - 2)))
+    return {'Y': [y], 'SavedMean': [m.reshape(x.shape[0], x.shape[1])],
+            'SavedVariance': [v.reshape(x.shape[0], x.shape[1])]}
+
+
+@register('group_norm', no_grad_out_slots=('Mean', 'Variance'))
+def group_norm(ctx, ins, attrs):
+    x = ins['X'][0]
+    g = attrs['groups']
+    eps = attrs.get('epsilon', 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xs = x.reshape(n, g, c // g, *x.shape[2:])
+    red = tuple(range(2, xs.ndim))
+    m = jnp.mean(xs, axis=red, keepdims=True)
+    v = jnp.var(xs, axis=red, keepdims=True)
+    y = ((xs - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    if 'Scale' in ins and ins['Scale']:
+        y = y * ins['Scale'][0].reshape(1, c, *([1] * (x.ndim - 2)))
+    if 'Bias' in ins and ins['Bias']:
+        y = y + ins['Bias'][0].reshape(1, c, *([1] * (x.ndim - 2)))
+    return {'Y': [y], 'Mean': [m.reshape(n, g)],
+            'Variance': [v.reshape(n, g)]}
+
+
+@register('dropout', no_grad_out_slots=('Mask',))
+def dropout(ctx, ins, attrs):
+    x = ins['X'][0]
+    p = attrs.get('dropout_prob', 0.5)
+    is_test = attrs.get('is_test', False)
+    impl = attrs.get('dropout_implementation', 'downgrade_in_infer')
+    if is_test:
+        if impl == 'upscale_in_train':
+            return {'Out': [x], 'Mask': [jnp.ones_like(x)]}
+        return {'Out': [x * (1.0 - p)], 'Mask': [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == 'upscale_in_train':
+        out = jnp.where(keep, x / max(1.0 - p, 1e-8), jnp.zeros_like(x))
+    else:
+        out = x * mask
+    return {'Out': [out], 'Mask': [mask]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register('softmax_with_cross_entropy')
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = ins['Logits'][0]
+    label = ins['Label'][0]
+    axis = attrs.get('axis', -1)
+    soft_label = attrs.get('soft_label', False)
+    ignore_index = attrs.get('ignore_index', -100)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis)
+        lab_safe = jnp.where(lab == ignore_index, 0, lab)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lab_safe, axis).astype(jnp.int32),
+            axis=axis)
+        loss = -picked
+        loss = jnp.where(jnp.expand_dims(lab, axis) == ignore_index,
+                         jnp.zeros_like(loss), loss)
+    return {'Softmax': [softmax.astype(logits.dtype)],
+            'Loss': [loss.astype(logits.dtype)]}
+
+
+@register('cross_entropy')
+def cross_entropy(ctx, ins, attrs):
+    x = ins['X'][0]  # probabilities
+    label = ins['Label'][0]
+    soft_label = attrs.get('soft_label', False)
+    ignore_index = attrs.get('ignore_index', -100)
+    logx = jnp.log(jnp.clip(x, 1e-20, None))
+    if soft_label:
+        loss = -jnp.sum(label * logx, axis=-1, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == x.ndim and lab.shape[-1] == 1:
+            lab = jnp.squeeze(lab, -1)
+        lab_safe = jnp.where(lab == ignore_index, 0, lab)
+        picked = jnp.take_along_axis(
+            logx, jnp.expand_dims(lab_safe, -1).astype(jnp.int32), axis=-1)
+        loss = -picked
+        loss = jnp.where(jnp.expand_dims(lab, -1) == ignore_index,
+                         jnp.zeros_like(loss), loss)
+    return {'Y': [loss]}
+
+
+@register('cross_entropy2', no_grad_out_slots=('XShape', 'MatchX'))
+def cross_entropy2(ctx, ins, attrs):
+    out = cross_entropy(ctx, ins, attrs)
+    return {'Y': out['Y'], 'MatchX': [out['Y'][0]], 'XShape': [out['Y'][0]]}
+
+
+@register('sigmoid_cross_entropy_with_logits')
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x = ins['X'][0]
+    label = ins['Label'][0]
+    ignore_index = attrs.get('ignore_index', -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index)
+    loss = jnp.where(mask, loss, jnp.zeros_like(loss))
+    if attrs.get('normalize', False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+    return {'Out': [loss]}
+
+
+@register('square_error_cost')
+def square_error_cost(ctx, ins, attrs):
+    d = ins['X'][0] - ins['Y'][0]
+    return {'Out': [d * d]}
+
+
+@register('huber_loss', no_grad_out_slots=('Residual',))
+def huber_loss(ctx, ins, attrs):
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    delta = attrs.get('delta', 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r,
+                     delta * (a - 0.5 * delta))
+    return {'Out': [loss], 'Residual': [r]}
+
+
+@register('smooth_l1_loss', no_grad_out_slots=('Diff',))
+def smooth_l1_loss(ctx, ins, attrs):
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    sigma = attrs.get('sigma', 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    return {'Out': [jnp.sum(loss, axis=tuple(range(1, x.ndim)),
+                            keepdims=True)],
+            'Diff': [d]}
+
+
+@register('log_loss')
+def log_loss(ctx, ins, attrs):
+    p = ins['Predicted'][0]
+    l = ins['Labels'][0]
+    eps = attrs.get('epsilon', 1e-4)
+    out = -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)
+    return {'Loss': [out]}
+
+
+@register('kldiv_loss')
+def kldiv_loss(ctx, ins, attrs):
+    x = ins['X'][0]
+    target = ins['Target'][0]
+    out = target * (jnp.log(jnp.clip(target, 1e-20, None)) - x)
+    out = jnp.where(target > 0, out, jnp.zeros_like(out))
+    red = attrs.get('reduction', 'mean')
+    if red == 'mean':
+        out = jnp.mean(out)
+    elif red == 'sum':
+        out = jnp.sum(out)
+    elif red == 'batchmean':
+        out = jnp.sum(out) / x.shape[0]
+    return {'Loss': [out]}
+
+
+@register('mse_loss')
+def mse_loss(ctx, ins, attrs):
+    d = ins['X'][0] - ins['Y'][0]
+    return {'Out': [jnp.mean(d * d)]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference operators/metrics/)
+# ---------------------------------------------------------------------------
+
+
+@register('accuracy', no_grad_out_slots=('Accuracy', 'Correct', 'Total'))
+def accuracy(ctx, ins, attrs):
+    idx = ins['Indices'][0]  # [N, k] from top_k
+    label = ins['Label'][0]  # [N, 1]
+    if label.ndim == 1:
+        label = label[:, None]
+    correct_k = jnp.any(idx == label, axis=1)
+    num_correct = jnp.sum(correct_k.astype(jnp.float32))
+    total = idx.shape[0]
+    return {'Accuracy': [num_correct / total],
+            'Correct': [num_correct.astype(jnp.int32)],
+            'Total': [jnp.asarray(total, jnp.int32)]}
+
+
+@register('auc', no_grad_out_slots=('AUC', 'StatPosOut', 'StatNegOut'))
+def auc(ctx, ins, attrs):
+    """Streaming AUC via threshold-bucketed confusion counts
+    (reference operators/metrics/auc_op.h)."""
+    preds = ins['Predict'][0]  # [N, 2]
+    label = ins['Label'][0].reshape(-1)
+    stat_pos = ins['StatPos'][0]
+    stat_neg = ins['StatNeg'][0]
+    num_thresholds = attrs.get('num_thresholds', 4095)
+    p = preds[:, 1]
+    bucket = jnp.clip((p * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(pos)
+    stat_neg = stat_neg.at[bucket].add(1 - pos)
+    # trapezoid area over descending thresholds
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1)
+    fpr = fp / jnp.maximum(tot_neg, 1)
+    area = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) * 0.5)
+    return {'AUC': [area], 'StatPosOut': [stat_pos],
+            'StatNegOut': [stat_neg]}
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+
+
+@register('label_smooth')
+def label_smooth(ctx, ins, attrs):
+    x = ins['X'][0]
+    eps = attrs.get('epsilon', 0.1)
+    k = x.shape[-1]
+    if 'PriorDist' in ins and ins['PriorDist']:
+        prior = ins['PriorDist'][0]
+        return {'Out': [(1 - eps) * x + eps * prior]}
+    return {'Out': [(1 - eps) * x + eps / k]}
+
+
+@register('interp_nearest')
+@register('nearest_interp')
+def nearest_interp(ctx, ins, attrs):
+    x = ins['X'][0]
+    n, c, h, w = x.shape
+    oh = attrs.get('out_h', h)
+    ow = attrs.get('out_w', w)
+    scale = attrs.get('scale', 0)
+    if scale:
+        oh, ow = int(h * scale), int(w * scale)
+    out = jax.image.resize(x, (n, c, oh, ow), method='nearest')
+    return {'Out': [out]}
+
+
+@register('bilinear_interp')
+def bilinear_interp(ctx, ins, attrs):
+    x = ins['X'][0]
+    n, c, h, w = x.shape
+    oh = attrs.get('out_h', h)
+    ow = attrs.get('out_w', w)
+    scale = attrs.get('scale', 0)
+    if scale:
+        oh, ow = int(h * scale), int(w * scale)
+    out = jax.image.resize(x, (n, c, oh, ow), method='bilinear')
+    return {'Out': [out]}
+
+
+@register('grid_sampler')
+def grid_sampler(ctx, ins, attrs):
+    raise NotImplementedError('grid_sampler: planned Pallas kernel')
+
+
+@register('temporal_shift')
+def temporal_shift(ctx, ins, attrs):
+    x = ins['X'][0]
+    seg = attrs['seg_num']
+    ratio = attrs.get('shift_ratio', 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pre = jnp.concatenate([jnp.zeros_like(xr[:, :1, :c1]),
+                           xr[:, :-1, :c1]], axis=1)
+    post = jnp.concatenate([xr[:, 1:, c1:c2],
+                            jnp.zeros_like(xr[:, :1, c1:c2])], axis=1)
+    rest = xr[:, :, c2:]
+    return {'Out': [jnp.concatenate([pre, post, rest],
+                                    axis=2).reshape(nt, c, h, w)]}

@@ -1,0 +1,208 @@
+"""Operator registry: op type -> JAX lowering rule.
+
+Reference design: REGISTER_OPERATOR / REGISTER_OP_*_KERNEL macros
+(framework/op_registry.h:223,265,268) + OpInfoMap (framework/op_info.h:124)
++ per-op GradOpDescMaker (framework/grad_op_desc_maker.h:39).
+
+TPU-native re-design: an op is ONE pure function
+    fn(ctx, ins: {slot: [jnp.Array,...]}, attrs: dict) -> {slot: [jnp.Array,...]}
+that is traceable by JAX.  This single definition replaces the reference's
+four artifacts per op (proto maker, shape inference, CPU kernel, CUDA
+kernel): shape/dtype inference is `jax.eval_shape` over the lowering, and
+the gradient op is synthesized automatically with `jax.vjp` over the same
+lowering (see `grad_op_def`), so no hand-written grad kernels exist at all.
+When a whole program segment is jitted, XLA CSE merges the vjp's forward
+re-computation with the original forward ops, and fusion does the rest —
+the per-op granularity costs nothing at runtime.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class LowerCtx(object):
+    """Per-op lowering context: deterministic per-(op, step) RNG.
+
+    `step` is a traced scalar fed by the executor each run, so stochastic
+    ops (dropout, random init) are pure functions of (seed, step) — the
+    XLA-friendly replacement for the reference's stateful curand
+    generators (platform/device_context.h).
+    """
+
+    def __init__(self, step, op_seed=0, prefer_test=False):
+        self.step = step
+        self.op_seed = int(op_seed)
+        self.prefer_test = prefer_test
+
+    def rng(self, salt=0):
+        key = jax.random.PRNGKey(self.op_seed + 7919 * salt)
+        return jax.random.fold_in(key, self.step)
+
+
+class OpDef(object):
+    __slots__ = ("type", "fn", "in_slots", "out_slots", "no_grad_out_slots",
+                 "host_only")
+
+    def __init__(self, type, fn, in_slots=None, out_slots=None,
+                 no_grad_out_slots=(), host_only=False):
+        self.type = type
+        self.fn = fn
+        self.in_slots = in_slots
+        self.out_slots = out_slots
+        self.no_grad_out_slots = tuple(no_grad_out_slots)
+        self.host_only = host_only
+
+
+_REGISTRY = {}
+# Op types executed by the host runtime, never traced into XLA.
+HOST_OPS = set()
+
+
+def register(type, in_slots=None, out_slots=None, no_grad_out_slots=()):
+    """Decorator: register `fn(ctx, ins, attrs) -> outs` as op `type`."""
+
+    def deco(fn):
+        _REGISTRY[type] = OpDef(type, fn, in_slots, out_slots,
+                                no_grad_out_slots)
+        return fn
+
+    return deco
+
+
+def register_host(type):
+    """Register a host-level op (feed/fetch/save/load/print...)."""
+
+    def deco(fn):
+        _REGISTRY[type] = OpDef(type, fn, host_only=True)
+        HOST_OPS.add(type)
+        return fn
+
+    return deco
+
+
+def is_registered(type):
+    return type in _REGISTRY or (
+        type.endswith("_grad") and type[:-5] in _REGISTRY)
+
+
+def get(type):
+    if type in _REGISTRY:
+        return _REGISTRY[type]
+    if type.endswith("_grad") and type[:-5] in _REGISTRY:
+        d = grad_op_def(_REGISTRY[type[:-5]])
+        _REGISTRY[type] = d
+        return d
+    raise KeyError("Operator '%s' is not registered" % type)
+
+
+def registered_ops():
+    return sorted(_REGISTRY.keys())
+
+
+# ---------------------------------------------------------------------------
+# Generic gradient synthesis
+# ---------------------------------------------------------------------------
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def grad_op_def(fwd):
+    """Build the grad OpDef for a forward OpDef via jax.vjp.
+
+    Grad-op calling convention (mirrors the reference's GradOpDescMaker
+    outputs, framework/grad_op_desc_maker.h:39):
+      inputs : every forward input slot (primal values) +
+               'GRAD::<out_slot>' for each available output gradient
+      outputs: 'GRAD::<in_slot>' for each requested input gradient
+    """
+
+    def fn(ctx, ins, attrs):
+        primal_slots = sorted(
+            s for s in ins.keys() if not s.startswith("GRAD::"))
+        primals = {s: ins[s] for s in primal_slots}
+
+        def f(p):
+            outs = fwd.fn(ctx, p, attrs)
+            # Only float outputs participate in differentiation.
+            return {
+                s: [v for v in vs]
+                for s, vs in outs.items()
+                if s not in fwd.no_grad_out_slots
+            }
+
+        outs, vjp_fn = jax.vjp(f, primals)
+        # Build cotangents matching `outs` structure.
+        cts = {}
+        for s, vs in outs.items():
+            g_in = ins.get("GRAD::" + s)
+            row = []
+            for i, v in enumerate(vs):
+                if g_in is not None and i < len(g_in) and g_in[i] is not None:
+                    row.append(jnp.asarray(g_in[i], v.dtype))
+                elif _is_float(v):
+                    row.append(jnp.zeros_like(v))
+                else:
+                    row.append(np.zeros(v.shape, jax.dtypes.float0))
+            cts[s] = row
+        (d_primals,) = vjp_fn(cts)
+        result = {}
+        for s, vs in d_primals.items():
+            row = []
+            for v, p in zip(vs, primals[s]):
+                if v is None or (hasattr(v, "dtype")
+                                 and v.dtype == jax.dtypes.float0):
+                    row.append(jnp.zeros_like(p))
+                else:
+                    row.append(v)
+            result["GRAD::" + s] = row
+        return result
+
+    return OpDef(fwd.type + "_grad", fn)
+
+
+# ---------------------------------------------------------------------------
+# Shape inference (jax.eval_shape over the lowering)
+# ---------------------------------------------------------------------------
+
+# Sentinel concrete size substituted for -1 (dynamic batch) dims during
+# graph-build-time shape inference; output dims equal to it map back to -1.
+# A large prime so it never collides with a real layer width.
+_DYN_SENTINEL = 86243
+
+
+def infer_shapes(op_type, in_specs, attrs, prefer_test=True):
+    """in_specs: {slot: [(shape, dtype), ...]} with -1 allowed in shapes.
+    Returns {slot: [(shape, dtype), ...]} for outputs, -1 restored."""
+    opdef = get(op_type)
+    has_dyn = False
+    abstract = {}
+    for slot, specs in in_specs.items():
+        row = []
+        for shape, dtype in specs:
+            shape = tuple(shape)
+            if -1 in shape:
+                has_dyn = True
+                shape = tuple(_DYN_SENTINEL if d == -1 else d for d in shape)
+            row.append(jax.ShapeDtypeStruct(shape, dtype))
+        abstract[slot] = row
+
+    ctx = LowerCtx(step=0, op_seed=int(attrs.get("__op_seed__", 0)),
+                   prefer_test=True)
+
+    def f(ins):
+        return opdef.fn(ctx, ins, attrs)
+
+    out = jax.eval_shape(f, abstract)
+    result = {}
+    for slot, vs in out.items():
+        row = []
+        for v in vs:
+            shape = tuple(v.shape)
+            if has_dyn:
+                shape = tuple(-1 if d == _DYN_SENTINEL else d for d in shape)
+            row.append((shape, v.dtype))
+        result[slot] = row
+    return result

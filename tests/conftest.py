@@ -1,0 +1,14 @@
+"""Test config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): single-host
+"cluster-in-a-box" — here an 8-device XLA host platform so sharding /
+collective paths compile and execute without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()

@@ -1,0 +1,88 @@
+"""MNIST LeNet end-to-end (BASELINE.json config[0]).
+
+Mirrors the reference book test
+python/paddle/fluid/tests/book/test_recognize_digits.py: build LeNet with
+fluid.layers, train with Adam, assert the loss decreases and accuracy
+rises on synthetic data.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def lenet(img, label):
+    conv1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act='relu')
+    conv2 = fluid.nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act='relu')
+    prediction = fluid.layers.fc(input=conv2, size=10, act='softmax')
+    loss = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_loss, acc
+
+
+def _synthetic_batch(batch_size, rng):
+    """Classifiable synthetic digits: class k lights up a distinct patch."""
+    label = rng.randint(0, 10, size=(batch_size, 1)).astype('int64')
+    img = rng.randn(batch_size, 1, 28, 28).astype('float32') * 0.1
+    for i, l in enumerate(label[:, 0]):
+        r, c = divmod(int(l), 4)
+        img[i, 0, 4 + r * 6:10 + r * 6, 2 + c * 6:8 + c * 6] += 1.0
+    return img, label
+
+
+def test_mnist_lenet_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 42
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data('img', shape=[1, 28, 28], dtype='float32')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        pred, avg_loss, acc = lenet(img, label)
+        opt = fluid.optimizer.Adam(learning_rate=0.001)
+        opt.minimize(avg_loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        rng = np.random.RandomState(7)
+        losses, accs = [], []
+        for step in range(60):
+            x, y = _synthetic_batch(32, rng)
+            l, a = exe.run(main, feed={'img': x, 'label': y},
+                           fetch_list=[avg_loss, acc])
+            losses.append(float(l))
+            accs.append(float(a))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    assert np.mean(accs[-10:]) > 0.7, np.mean(accs[-10:])
+
+
+def test_lenet_test_program_clone():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data('img', shape=[1, 28, 28], dtype='float32')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        pred, avg_loss, acc = lenet(img, label)
+        test_program = main.clone(for_test=True)
+        opt = fluid.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(avg_loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        rng = np.random.RandomState(3)
+        x, y = _synthetic_batch(16, rng)
+        l1, = exe.run(test_program, feed={'img': x, 'label': y},
+                      fetch_list=[avg_loss])
+        # eval run must not mutate params: same loss twice
+        l2, = exe.run(test_program, feed={'img': x, 'label': y},
+                      fetch_list=[avg_loss])
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
