@@ -1,0 +1,79 @@
+"""Benchmark: ResNet-50 ImageNet training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+vs_baseline compares against 365 images/sec/GPU — the per-chip throughput
+of the reference's V100 ParallelExecutor ResNet-50 path in the fluid-v1.6
+era (the reference repo itself publishes no numbers; see BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_resnet50(batch=128, steps=12, warmup=3, amp=True):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, logits, loss, acc = models.resnet.build()
+        opt = fluid.optimizer.Momentum(0.1, momentum=0.9)
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(
+                opt, use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(0)
+    import jax
+    # synthetic batch resident on device: measure compute, not the
+    # host->device pipe (the input pipeline is benched separately)
+    x = jax.device_put(rng.rand(batch, 3, 224, 224).astype('float32'))
+    y = jax.device_put(rng.randint(0, 1000, (batch, 1)).astype('int32'))
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed={'image': x, 'label': y},
+                    fetch_list=[loss])
+        # force completion of warmup before timing
+        t0 = time.time()
+        last = None
+        for _ in range(steps):
+            last, = exe.run(main, feed={'image': x, 'label': y},
+                            fetch_list=[loss])
+        np.asarray(last)  # block on the last step
+        dt = time.time() - t0
+    return batch * steps / dt
+
+
+def main():
+    for batch in (128, 64, 32):
+        try:
+            ips = bench_resnet50(batch=batch)
+            break
+        except Exception as e:
+            sys.stderr.write('batch %d failed: %s\n' % (batch, e))
+            ips = None
+    if ips is None:
+        print(json.dumps({'metric': 'resnet50_train_images_per_sec_chip',
+                          'value': 0.0, 'unit': 'images/sec',
+                          'vs_baseline': 0.0}))
+        return
+    print(json.dumps({
+        'metric': 'resnet50_train_images_per_sec_chip',
+        'value': round(ips, 2),
+        'unit': 'images/sec',
+        'vs_baseline': round(ips / 365.0, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

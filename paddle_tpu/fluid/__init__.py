@@ -44,6 +44,7 @@ from .data_feeder import DataFeeder
 from . import reader
 from .reader import DataLoader
 from . import contrib
+from . import incubate
 
 __all__ = [
     'CPUPlace', 'CUDAPlace', 'XLAPlace', 'Program', 'Variable',

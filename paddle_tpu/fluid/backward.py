@@ -83,6 +83,17 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                             checkpoint_names):
             continue
 
+    # resolve every accumulated grad and publish the name map so callers
+    # (OpTest, calc_gradient, AMP) can find grads of arbitrary vars
+    grad_map = {}
+    for name in list(contribs.keys()):
+        g = resolve_grad(name)
+        if g is not None:
+            grad_map[name] = g
+    if not hasattr(program, '_grad_name_map'):
+        program._grad_name_map = {}
+    program._grad_name_map.update(grad_map)
+
     params_grads = []
     wanted = None
     if parameter_list is not None:
@@ -184,8 +195,8 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     del pg
     outs = []
     for v in inputs:
-        g = block._find_var_recursive(grad_var_name(v.name))
-        outs.append(g)
+        gname = loss.block.program._grad_name_map.get(v.name)
+        outs.append(block._find_var_recursive(gname) if gname else None)
     return outs
 
 

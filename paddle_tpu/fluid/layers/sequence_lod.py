@@ -1,0 +1,88 @@
+"""Sequence layers on padded+mask batches.
+
+Reference: python/paddle/fluid/layers/sequence_lod.py over LoD tensors.
+TPU-native: sequences are [B, T, ...] + mask [B, T] (see
+ops/sequence_ops.py); pass `mask=` (from layers.sequence_mask) where the
+reference relied on implicit LoD.
+"""
+
+from ..layer_helper import LayerHelper
+
+
+def sequence_mask(x, maxlen=None, dtype='int64'):
+    helper = LayerHelper('sequence_mask')
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    helper.append_op('sequence_mask', inputs={'X': x},
+                     outputs={'Y': out},
+                     attrs={'maxlen': maxlen, 'out_dtype': dtype})
+    return out
+
+
+def _seq_op(op_type, x, mask, attrs, out_slots=('Out',)):
+    helper = LayerHelper(op_type)
+    inputs = {'X': x}
+    if mask is not None:
+        inputs['Mask'] = mask
+    outs = {}
+    for s in out_slots:
+        outs[s] = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs)
+    return outs[out_slots[0]]
+
+
+def sequence_pool(input, pool_type, mask=None, is_test=False):
+    return _seq_op('sequence_pool', input, mask,
+                   {'pooltype': pool_type.upper()},
+                   out_slots=('Out', 'MaxIndex'))
+
+
+def sequence_softmax(input, mask=None, use_cudnn=False, name=None):
+    return _seq_op('sequence_softmax', input, mask, {})
+
+
+def sequence_first_step(input, mask=None):
+    return _seq_op('sequence_pool', input, mask,
+                   {'pooltype': 'FIRST'}, out_slots=('Out', 'MaxIndex'))
+
+
+def sequence_last_step(input, mask=None):
+    return _seq_op('sequence_pool', input, mask,
+                   {'pooltype': 'LAST'}, out_slots=('Out', 'MaxIndex'))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper('sequence_expand', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('sequence_expand', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={'ref_level': ref_level})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper('sequence_reshape')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op('sequence_reshape', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'new_dim': new_dim})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, mask=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    helper = LayerHelper('sequence_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    d = input.shape[2]
+    w = helper.create_parameter(param_attr,
+                                shape=[filter_size * d, num_filters],
+                                dtype=input.dtype)
+    inputs = {'X': input, 'Filter': w}
+    if mask is not None:
+        inputs['Mask'] = mask
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op('sequence_conv', inputs=inputs,
+                     outputs={'Out': out},
+                     attrs={'contextLength': filter_size,
+                            'contextStart': -(filter_size // 2)})
+    pre_act = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr)
+    return helper.append_activation(pre_act, act)

@@ -53,10 +53,11 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
 
     executor._step += 1
     fetched = {}
+    param_rule = getattr(compiled, '_param_sharding_rule', None)
     for item in plan:
         if isinstance(item, _Segment):
             _run_segment_parallel(executor, item, feed, scope, mesh, ndev,
-                                  fetched)
+                                  fetched, param_rule)
         else:
             from ..ops import registry
             op = item[1]
@@ -70,13 +71,23 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     return results
 
 
-def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched):
+def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
+                          param_rule=None):
     repl = NamedSharding(mesh, P())
+    dp = mesh.axis_names[0]
+    dp_size = mesh.shape[dp]
 
-    def shard_for(name, val):
+    def data_shard(name, val):
         if name in feed and getattr(val, 'ndim', 0) >= 1 \
-                and val.shape[0] % ndev == 0:
-            return NamedSharding(mesh, P('dp'))
+                and val.shape[0] % dp_size == 0:
+            return NamedSharding(mesh, P(dp))
+        return repl
+
+    def state_shard(name, val):
+        if param_rule is not None:
+            spec = param_rule(name, getattr(val, 'shape', ()))
+            if spec is not None:
+                return NamedSharding(mesh, spec)
         return repl
 
     state = {n: executor._lookup_input(n, feed, scope)
@@ -86,8 +97,9 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched):
     if seg.compiled is None or not isinstance(seg.compiled, tuple):
         fn = _make_segment_fn(seg)
         in_shardings = (None,
-                        {n: repl for n in seg.state_names},
-                        {n: shard_for(n, data[n]) for n in
+                        {n: state_shard(n, state[n])
+                         for n in seg.state_names},
+                        {n: data_shard(n, data[n]) for n in
                          seg.input_names})
         seg.compiled = ('parallel', jax.jit(
             fn, in_shardings=in_shardings, donate_argnums=(1,)))
@@ -95,6 +107,69 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched):
     for n, v in out.items():
         scope.set_var(n, v)
         fetched[n] = v
+
+
+def run_collective(executor, program, feed, fetch_list, scope,
+                   return_numpy):
+    """Shard-map execution of a collective-rewritten program (fleet
+    GradAllReduce mode): the program's c_allreduce_* ops lower to
+    jax.lax collectives over the 'dp' mesh axis; each mesh device runs
+    the trainer-local program on its batch shard."""
+    import jax.numpy as jnp
+    from . import core as _core
+    from . import framework
+    scope = scope or _core.global_scope()
+    feed = feed or {}
+    fetch_names = [v.name if isinstance(v, framework.Variable) else v
+                   for v in (fetch_list or [])]
+    if getattr(program, '_mesh', None) is None:
+        program._mesh = _default_mesh()
+    mesh = program._mesh
+    ndev = mesh.devices.size
+
+    key = ('cplan', tuple(sorted(feed.keys())), tuple(fetch_names),
+           id(executor))
+    plan = program._exec_cache.get(key)
+    if plan is None:
+        plan = executor._build_plan(program, tuple(sorted(feed.keys())),
+                                    tuple(fetch_names))
+        program._exec_cache[key] = plan
+
+    executor._step += 1
+    fetched = {}
+    for item in plan:
+        if not isinstance(item, _Segment):
+            from ..ops import registry
+            registry.get(item[1].type).fn(executor, scope, item[1])
+            continue
+        seg = item
+        state = {n: executor._lookup_input(n, feed, scope)
+                 for n in seg.state_names}
+        data = {n: executor._lookup_input(n, feed, scope)
+                for n in seg.input_names}
+        if seg.compiled is None:
+            fn = _make_segment_fn(seg)
+            in_specs = (P(),
+                        {n: P() for n in seg.state_names},
+                        {n: (P('dp') if (n in feed and
+                                         getattr(data[n], 'ndim', 0) >= 1)
+                             else P())
+                         for n in seg.input_names})
+            out_specs = {n: P() for n in seg.output_names}
+            sm = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+            seg.compiled = jax.jit(sm, donate_argnums=(1,))
+        out = seg.compiled(jnp.asarray(executor._step), state, data)
+        for n, v in out.items():
+            scope.set_var(n, v)
+            fetched[n] = v
+    results = []
+    for name in fetch_names:
+        val = fetched.get(name)
+        if val is None:
+            val = _core.as_array(scope.find_var(name))
+        results.append(np.asarray(val) if return_numpy else val)
+    return results
 
 
 class ParallelExecutor(object):

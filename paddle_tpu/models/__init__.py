@@ -1,0 +1,12 @@
+"""Model zoo matching BASELINE.json configs:
+LeNet (MNIST), ResNet-50 (ImageNet), BERT-base, Transformer NMT,
+Wide&Deep CTR, word2vec — all built on the fluid layers API so they run
+unchanged on the reference framework.
+"""
+
+from . import lenet
+from . import resnet
+from . import bert
+from . import transformer
+from . import wide_deep
+from . import word2vec

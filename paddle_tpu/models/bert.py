@@ -1,0 +1,141 @@
+"""BERT-base encoder for pretraining (BASELINE.json config 2).
+
+Reference workload: fused_attention + layer_norm + adam on the reference's
+multihead_matmul fused op (operators/fused/multihead_matmul_op.*).  Built
+here with fluid layers; XLA fuses the attention chain, and the pallas
+flash-attention kernel (ops/pallas/) replaces the naive chain when
+enabled via attrs['__flash__'].
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+class BertConfig(object):
+    def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
+                 intermediate=3072, max_pos=512, type_vocab=2,
+                 dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.heads = heads
+        self.intermediate = intermediate
+        self.max_pos = max_pos
+        self.type_vocab = type_vocab
+        self.dropout = dropout
+
+
+BASE = BertConfig()
+TINY = BertConfig(vocab_size=1000, hidden=64, layers=2, heads=4,
+                  intermediate=128, max_pos=128)
+
+
+def multi_head_attention(x, attn_bias, cfg, is_test):
+    """Self-attention: fused QKV projection -> scaled dot product ->
+    output projection."""
+    h, heads = cfg.hidden, cfg.heads
+    d = h // heads
+    qkv = layers.fc(x, size=3 * h, num_flatten_dims=2)
+    q, k, v = layers.split(qkv, 3, dim=2)
+
+    def to_heads(t):
+        t = layers.reshape(t, [0, 0, heads, d])
+        return layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    scores = layers.matmul(q, k, transpose_y=True, alpha=d ** -0.5)
+    if attn_bias is not None:
+        scores = layers.elementwise_add(scores, attn_bias)
+    probs = layers.softmax(scores)
+    if not is_test and cfg.dropout:
+        probs = layers.dropout(probs, cfg.dropout, is_test=is_test,
+                               dropout_implementation='upscale_in_train')
+    ctx = layers.matmul(probs, v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, h])
+    return layers.fc(ctx, size=h, num_flatten_dims=2)
+
+
+def encoder_layer(x, attn_bias, cfg, is_test):
+    attn = multi_head_attention(x, attn_bias, cfg, is_test)
+    if not is_test and cfg.dropout:
+        attn = layers.dropout(attn, cfg.dropout, is_test=is_test,
+                              dropout_implementation='upscale_in_train')
+    x = layers.layer_norm(layers.elementwise_add(x, attn),
+                          begin_norm_axis=2)
+    ffn = layers.fc(x, size=cfg.intermediate, num_flatten_dims=2,
+                    act='gelu')
+    ffn = layers.fc(ffn, size=cfg.hidden, num_flatten_dims=2)
+    if not is_test and cfg.dropout:
+        ffn = layers.dropout(ffn, cfg.dropout, is_test=is_test,
+                             dropout_implementation='upscale_in_train')
+    return layers.layer_norm(layers.elementwise_add(x, ffn),
+                             begin_norm_axis=2)
+
+
+def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
+                 is_test=False):
+    emb = layers.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden])
+    pos = layers.embedding(pos_ids, size=[cfg.max_pos, cfg.hidden])
+    sent = layers.embedding(sent_ids, size=[cfg.type_vocab, cfg.hidden])
+    x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
+    x = layers.layer_norm(x, begin_norm_axis=2)
+    if not is_test and cfg.dropout:
+        x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                           dropout_implementation='upscale_in_train')
+    # [B, T] mask -> additive bias [B, 1, 1, T]: 0 where attended,
+    # -10000 where padded
+    bias = layers.scale(
+        layers.unsqueeze(layers.unsqueeze(input_mask, [1]), [1]),
+        scale=10000.0, bias=-10000.0)
+    for _ in range(cfg.layers):
+        x = encoder_layer(x, bias, cfg, is_test)
+    return x
+
+
+def build_pretrain(cfg=None, seq_len=128, is_test=False):
+    """Masked-LM + next-sentence pretraining heads (reference BERT
+    pretraining workload)."""
+    cfg = cfg or BASE
+    src = fluid.layers.data('src_ids', shape=[seq_len], dtype='int64')
+    pos = fluid.layers.data('pos_ids', shape=[seq_len], dtype='int64')
+    sent = fluid.layers.data('sent_ids', shape=[seq_len], dtype='int64')
+    mask = fluid.layers.data('input_mask', shape=[seq_len],
+                             dtype='float32')
+    mlm_label = fluid.layers.data('mlm_label', shape=[seq_len],
+                                  dtype='int64')
+    nsp_label = fluid.layers.data('nsp_label', shape=[1], dtype='int64')
+
+    enc = bert_encoder(src, pos, sent, mask, cfg, is_test)
+    # MLM head over all positions (dense path; gather of masked positions
+    # is a host-side optimization)
+    mlm_logits = layers.fc(enc, size=cfg.vocab_size, num_flatten_dims=2)
+    mlm_loss = layers.softmax_with_cross_entropy(
+        mlm_logits, layers.unsqueeze(mlm_label, [2]), ignore_index=-1)
+    mlm_loss = layers.mean(mlm_loss)
+    # NSP head on [CLS] (position 0)
+    cls = layers.slice(enc, axes=[1], starts=[0], ends=[1])
+    cls = layers.reshape(cls, [0, cfg.hidden])
+    nsp_logits = layers.fc(cls, size=2)
+    nsp_loss = layers.mean(
+        layers.softmax_with_cross_entropy(nsp_logits, nsp_label))
+    loss = layers.elementwise_add(mlm_loss, nsp_loss)
+    feeds = {'src_ids': src, 'pos_ids': pos, 'sent_ids': sent,
+             'input_mask': mask, 'mlm_label': mlm_label,
+             'nsp_label': nsp_label}
+    return feeds, enc, loss
+
+
+def synthetic_batch(cfg, batch, seq_len, rng):
+    src = rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype('int64')
+    pos = np.tile(np.arange(seq_len), (batch, 1)).astype('int64')
+    sent = np.zeros((batch, seq_len), 'int64')
+    mask = np.ones((batch, seq_len), 'float32')
+    mlm = np.where(rng.rand(batch, seq_len) < 0.15,
+                   rng.randint(0, cfg.vocab_size, (batch, seq_len)),
+                   -1).astype('int64')
+    nsp = rng.randint(0, 2, (batch, 1)).astype('int64')
+    return {'src_ids': src, 'pos_ids': pos, 'sent_ids': sent,
+            'input_mask': mask, 'mlm_label': mlm, 'nsp_label': nsp}

@@ -1,0 +1,25 @@
+"""LeNet-5 for MNIST (BASELINE.json config 0).
+
+Reference model: python/paddle/fluid/tests/book/test_recognize_digits.py
+(conv-pool x2 + fc-softmax).
+"""
+
+import paddle_tpu.fluid as fluid
+
+
+def build(img=None, label=None):
+    if img is None:
+        img = fluid.layers.data('img', shape=[1, 28, 28], dtype='float32')
+    if label is None:
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+    conv1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act='relu')
+    conv2 = fluid.nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act='relu')
+    prediction = fluid.layers.fc(input=conv2, size=10, act='softmax')
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return {'img': img, 'label': label}, prediction, loss, acc

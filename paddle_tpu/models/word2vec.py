@@ -1,0 +1,39 @@
+"""word2vec CBOW (reference book test:
+python/paddle/fluid/tests/book/test_word2vec.py — embedding concat +
+fc softmax over N-gram context).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+EMB_SIZE = 32
+N_GRAM = 4
+
+
+def build(vocab_size=2000, emb_size=EMB_SIZE):
+    words = [fluid.layers.data('word_%d' % i, shape=[1], dtype='int64')
+             for i in range(N_GRAM)]
+    target = fluid.layers.data('target', shape=[1], dtype='int64')
+    embs = []
+    for i, w in enumerate(words):
+        e = layers.embedding(
+            w, size=[vocab_size, emb_size],
+            param_attr=fluid.ParamAttr(name='shared_w'))
+        embs.append(layers.reshape(e, [0, emb_size]))
+    concat = layers.concat(embs, axis=1)
+    hidden = layers.fc(concat, size=256, act='sigmoid')
+    pred = layers.fc(hidden, size=vocab_size, act='softmax')
+    loss = layers.mean(layers.cross_entropy(pred, target))
+    feeds = {w.name: w for w in words}
+    feeds['target'] = target
+    return feeds, pred, loss
+
+
+def synthetic_batch(vocab_size, batch, rng):
+    ctx = rng.randint(0, vocab_size, (batch, N_GRAM)).astype('int64')
+    target = ((ctx.sum(1) + 1) % vocab_size).astype('int64')[:, None]
+    out = {'word_%d' % i: ctx[:, i:i + 1] for i in range(N_GRAM)}
+    out['target'] = target
+    return out

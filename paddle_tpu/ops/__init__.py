@@ -14,5 +14,7 @@ from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import host_ops  # noqa: F401
 from . import amp_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
 
 from .registry import register, register_host, get, is_registered  # noqa
+from . import sequence_ops  # noqa: F401

@@ -1,0 +1,194 @@
+"""Collective communication ops — ICI/XLA collectives.
+
+Reference: paddle/fluid/operators/collective/ — c_allreduce_{sum,max,min,
+prod} (c_allreduce_op.h:33 calls ncclAllReduce at :105), c_allgather,
+c_reducescatter, c_broadcast, c_comm_init / c_gen_nccl_id
+(c_gen_nccl_id_op.cc:37), c_sync_{calc,comm}_stream.
+
+TPU-native re-design: each op lowers to the matching jax.lax collective
+with a mesh axis name derived from ring_id; the ops execute inside a
+shard_map over the device mesh (see parallel_executor shard-map mode), so
+XLA schedules them on ICI.  Stream-sync ops are identity: XLA's dataflow
+already orders compute and collectives.  Rendezvous ops (c_gen_nccl_id,
+c_comm_init) are no-ops on a single controller; multi-host init happens
+via jax.distributed in fleet.init().
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_host
+
+# ring_id -> mesh axis name. Ring 0 is the data-parallel axis; extra rings
+# map to additional mesh axes (tensor/pipeline) when configured.
+RING_AXES = {0: 'dp'}
+
+
+def ring_axis(ring_id):
+    return RING_AXES.get(int(ring_id or 0), 'dp')
+
+
+def _in_shard_map():
+    """True when tracing inside shard_map (axis name bound)."""
+    try:
+        jax.lax.axis_index(ring_axis(0))
+        return True
+    except NameError:
+        return False
+
+
+def _maybe(axis_fn, x, axis):
+    """Apply collective if the axis is bound; identity on single device
+    (matches reference behavior when nranks == 1)."""
+    try:
+        return axis_fn(x, axis)
+    except NameError:
+        return x
+
+
+@register('c_allreduce_sum')
+def c_allreduce_sum(ctx, ins, attrs):
+    x = ins['X'][0]
+    return {'Out': [_maybe(jax.lax.psum, x,
+                           ring_axis(attrs.get('ring_id', 0)))]}
+
+
+@register('c_allreduce_max')
+def c_allreduce_max(ctx, ins, attrs):
+    return {'Out': [_maybe(jax.lax.pmax, ins['X'][0],
+                           ring_axis(attrs.get('ring_id', 0)))]}
+
+
+@register('c_allreduce_min')
+def c_allreduce_min(ctx, ins, attrs):
+    return {'Out': [_maybe(jax.lax.pmin, ins['X'][0],
+                           ring_axis(attrs.get('ring_id', 0)))]}
+
+
+@register('c_allreduce_prod')
+def c_allreduce_prod(ctx, ins, attrs):
+    axis = ring_axis(attrs.get('ring_id', 0))
+    x = ins['X'][0]
+    try:
+        return {'Out': [jnp.exp(jax.lax.psum(jnp.log(x), axis))]}
+    except NameError:
+        return {'Out': [x]}
+
+
+@register('c_allgather')
+def c_allgather(ctx, ins, attrs):
+    x = ins['X'][0]
+    axis = ring_axis(attrs.get('ring_id', 0))
+    try:
+        g = jax.lax.all_gather(x, axis)  # [nranks, ...]
+        return {'Out': [g.reshape((-1,) + x.shape[1:])]}
+    except NameError:
+        return {'Out': [x]}
+
+
+@register('c_reducescatter')
+def c_reducescatter(ctx, ins, attrs):
+    x = ins['X'][0]
+    axis = ring_axis(attrs.get('ring_id', 0))
+    try:
+        return {'Out': [jax.lax.psum_scatter(x, axis,
+                                             scatter_dimension=0,
+                                             tiled=True)]}
+    except NameError:
+        return {'Out': [x]}
+
+
+@register('c_broadcast')
+def c_broadcast(ctx, ins, attrs):
+    x = ins['X'][0]
+    axis = ring_axis(attrs.get('ring_id', 0))
+    root = attrs.get('root', 0)
+    try:
+        idx = jax.lax.axis_index(axis)
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return {'Out': [jax.lax.psum(masked, axis)]}
+    except NameError:
+        return {'Out': [x]}
+
+
+@register('c_concat')
+def c_concat(ctx, ins, attrs):
+    # all_gather along last dim (tensor-parallel gather)
+    x = ins['X'][0]
+    axis = ring_axis(attrs.get('ring_id', 0))
+    try:
+        g = jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+        return {'Out': [g]}
+    except NameError:
+        return {'Out': [x]}
+
+
+@register('c_split')
+def c_split(ctx, ins, attrs):
+    x = ins['X'][0]
+    axis = ring_axis(attrs.get('ring_id', 0))
+    nranks = attrs.get('nranks', 1)
+    try:
+        idx = jax.lax.axis_index(axis)
+        size = x.shape[-1] // nranks
+        return {'Out': [jax.lax.dynamic_slice_in_dim(
+            x, idx * size, size, axis=x.ndim - 1)]}
+    except NameError:
+        return {'Out': [x]}
+
+
+@register('c_embedding')
+def c_embedding(ctx, ins, attrs):
+    """Vocab-sharded embedding lookup (tensor parallel): each rank holds
+    rows [start, start+n); out-of-range ids contribute zeros, followed by
+    a c_allreduce_sum."""
+    w = ins['W'][0]
+    ids = ins['Ids'][0]
+    start = attrs.get('start_index', 0)
+    n = w.shape[0]
+    local = ids - start
+    in_range = (local >= 0) & (local < n)
+    safe = jnp.clip(local, 0, n - 1)
+    out = jnp.take(w, safe, axis=0)
+    return {'Out': [jnp.where(in_range[..., None], out,
+                              jnp.zeros_like(out))]}
+
+
+@register('c_identity')
+def c_identity(ctx, ins, attrs):
+    return {'Out': [ins['X'][0]]}
+
+
+@register('c_sync_calc_stream')
+def c_sync_calc_stream(ctx, ins, attrs):
+    return {'Out': [ins['X'][0]]}
+
+
+@register('c_sync_comm_stream')
+def c_sync_comm_stream(ctx, ins, attrs):
+    return {'Out': [x for x in ins['X']]}
+
+
+@register('mp_allreduce_sum')
+def mp_allreduce_sum(ctx, ins, attrs):
+    return c_allreduce_sum(ctx, ins, attrs)
+
+
+@register_host('c_gen_nccl_id')
+def c_gen_nccl_id(executor, scope, op):
+    pass  # single-controller: no rendezvous needed
+
+
+@register_host('c_comm_init')
+def c_comm_init(executor, scope, op):
+    pass
+
+
+@register_host('c_comm_init_all')
+def c_comm_init_all(executor, scope, op):
+    pass
+
+
+@register_host('barrier')
+def barrier(executor, scope, op):
+    pass

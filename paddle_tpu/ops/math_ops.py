@@ -34,9 +34,10 @@ def matmul(ctx, ins, attrs):
     if ty:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     if attrs.get('__amp__') and x.dtype == jnp.float32:
-        # AMP: bf16 operands, f32 accumulation on the MXU
-        out = jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
-                         preferred_element_type=jnp.float32)
+        # AMP: uniform bf16 matmul (f32 MXU accumulation internally),
+        # cast back — keeps the dot transpose rule dtype-consistent
+        out = jnp.matmul(x.astype(jnp.bfloat16),
+                         y.astype(jnp.bfloat16)).astype(jnp.float32)
     else:
         out = jnp.matmul(x, y, precision=jax.lax.Precision.HIGHEST
                          if x.dtype == jnp.float32 else None)
@@ -65,8 +66,7 @@ def mul(ctx, ins, attrs):
     y2 = y.reshape(int(np.prod(ys[:yn])), -1)
     if attrs.get('__amp__') and x.dtype == jnp.float32:
         out = jnp.matmul(x2.astype(jnp.bfloat16),
-                         y2.astype(jnp.bfloat16),
-                         preferred_element_type=jnp.float32)
+                         y2.astype(jnp.bfloat16)).astype(jnp.float32)
     else:
         out = jnp.matmul(x2, y2, precision=jax.lax.Precision.HIGHEST
                          if x.dtype == jnp.float32 else None)

@@ -51,14 +51,19 @@ def conv2d(ctx, ins, attrs):
     pad = _conv_padding(attrs.get('paddings', [0, 0]),
                         attrs.get('padding_algorithm', 'EXPLICIT'),
                         w.shape[-2:], strides, dilations)
-    if attrs.get('__amp__') and x.dtype == jnp.float32:
+    amp = attrs.get('__amp__') and x.dtype == jnp.float32
+    if amp:
+        # uniform bf16 in AND out: keeps the conv transpose (vjp) rule
+        # dtype-consistent; the MXU still accumulates in f32 internally
         x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=dn,
-        preferred_element_type=jnp.float32 if x.dtype != jnp.float64
-        else None)
+        precision=(jax.lax.Precision.HIGHEST
+                   if x.dtype == jnp.float32 else None),
+        preferred_element_type=None if amp else (
+            jnp.float32 if x.dtype != jnp.float64 else None))
     return {'Output': [out.astype(ins['Input'][0].dtype)]}
 
 

@@ -1,0 +1,127 @@
+"""Sequence op lowerings on the padded+mask representation.
+
+Reference: paddle/fluid/operators/sequence_ops/ (~5.8k LoC C++/CUDA over
+LoD offsets, framework/lod_tensor.h:52).
+
+TPU-native re-design (SURVEY.md §5 'hard parts'): XLA needs static
+shapes, so variable-length batches are bucket-padded [B, T, ...] with an
+explicit float mask [B, T]; every sequence op becomes a masked dense op
+that XLA fuses.  Lengths live in the mask (mask.sum(-1)), replacing the
+LoD offset vectors.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _mask_of(ins, x):
+    if 'Mask' in ins and ins['Mask']:
+        return ins['Mask'][0]
+    return jnp.ones(x.shape[:2], x.dtype if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.float32)
+
+
+@register('sequence_mask', no_grad_out_slots=('Y',))
+def sequence_mask(ctx, ins, attrs):
+    lengths = ins['X'][0]
+    maxlen = attrs.get('maxlen', -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError('sequence_mask on XLA needs a static maxlen')
+    from ..fluid import core
+    dtype = core.convert_dtype(attrs.get('out_dtype', 'float32'))
+    idx = jnp.arange(maxlen)
+    return {'Y': [(idx[None, :] < lengths.reshape(-1, 1)).astype(dtype)]}
+
+
+@register('sequence_pool', no_grad_out_slots=('MaxIndex',))
+def sequence_pool(ctx, ins, attrs):
+    """X [B,T,D] (+Mask [B,T]) -> Out [B,D]."""
+    x = ins['X'][0]
+    mask = _mask_of(ins, x)
+    ptype = attrs.get('pooltype', 'AVERAGE').upper()
+    m = mask[:, :, None].astype(x.dtype)
+    if ptype == 'SUM':
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == 'AVERAGE':
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1), 1.0)
+    elif ptype == 'SQRT':
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(
+            jnp.sum(m, axis=1), 1.0))
+    elif ptype == 'MAX':
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == 'LAST':
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32)
+                                  * jnp.ones((1, 1, x.shape[2]),
+                                             jnp.int32), axis=1)[:, 0]
+    elif ptype == 'FIRST':
+        out = x[:, 0]
+    else:
+        raise ValueError('sequence_pool: unknown pooltype %s' % ptype)
+    return {'Out': [out], 'MaxIndex': [jnp.zeros(out.shape[:1],
+                                                 jnp.int32)]}
+
+
+@register('sequence_softmax')
+def sequence_softmax(ctx, ins, attrs):
+    x = ins['X'][0]  # [B,T]
+    mask = _mask_of(ins, x)
+    neg = -1e9
+    logits = jnp.where(mask > 0, x, neg)
+    return {'Out': [jax.nn.softmax(logits, axis=-1) *
+                    mask.astype(x.dtype)]}
+
+
+@register('sequence_expand')
+def sequence_expand(ctx, ins, attrs):
+    """Padded semantics: X [B,1,D] or [B,D] broadcast along ref's T."""
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    t = y.shape[1]
+    if x.ndim == 2:
+        return {'Out': [jnp.repeat(x[:, None, :], t, axis=1)]}
+    return {'Out': [jnp.repeat(x, t // x.shape[1], axis=1)]}
+
+
+@register('sequence_reshape')
+def sequence_reshape(ctx, ins, attrs):
+    x = ins['X'][0]
+    new_dim = attrs['new_dim']
+    b = x.shape[0]
+    return {'Out': [x.reshape(b, -1, new_dim)]}
+
+
+@register('sequence_conv')
+def sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time: X [B,T,D], Filter
+    [ctx_len*D, out_dim] (reference operators/sequence_ops/
+    sequence_conv_op.cc im2col-style)."""
+    x = ins['X'][0]
+    w = ins['Filter'][0]
+    ctx_len = attrs.get('contextLength', 3)
+    ctx_start = attrs.get('contextStart', -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        if off < 0:
+            m = (jnp.arange(t) >= -off)
+        else:
+            m = (jnp.arange(t) < t - off)
+        cols.append(shifted * m[None, :, None].astype(x.dtype))
+    stacked = jnp.concatenate(cols, axis=2)  # [B,T,ctx*D]
+    out = jnp.einsum('btc,co->bto', stacked, w)
+    if 'Mask' in ins and ins['Mask']:
+        out = out * ins['Mask'][0][:, :, None].astype(out.dtype)
+    return {'Out': [out]}
+
+
+@register('im2sequence')
+def im2sequence(ctx, ins, attrs):
+    raise NotImplementedError('im2sequence: OCR path planned')

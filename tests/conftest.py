@@ -1,14 +1,24 @@
 """Test config: run on a virtual 8-device CPU mesh.
 
 Mirrors the reference's test strategy (SURVEY.md §4): single-host
-"cluster-in-a-box" — here an 8-device XLA host platform so sharding /
+"cluster-in-a-box" — an 8-device XLA host platform so sharding /
 collective paths compile and execute without TPU hardware.
+
+The ambient environment may pre-register a real TPU backend (axon) via
+sitecustomize and pin jax_platforms programmatically, so setting the env
+var is not enough — override the jax config after import.  Set
+PADDLE_TPU_TEST_PLATFORM to run the suite on another platform.
 """
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_platform = os.environ.get('PADDLE_TPU_TEST_PLATFORM', 'cpu')
+os.environ['JAX_PLATFORMS'] = _platform
 flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', _platform)

@@ -1,0 +1,161 @@
+"""OpTest harness — the per-op validation backbone.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:174 (OpTest):
+check_output runs the single op through the real executor on every place;
+check_grad compares analytic gradients against centered finite differences
+(get_numeric_gradient, op_test.py:57).  Here the 'place' is the XLA
+device and the analytic grads come from the vjp-synthesized grad ops via
+append_backward — so check_grad validates the whole autodiff pipeline,
+not just the kernel.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+class OpTest(object):
+    """Subclass sets: op_type, inputs {slot: array | [(name, array),...]},
+    attrs, and either expected outputs or a numpy reference fn."""
+
+    atol = 1e-5
+    rtol = 1e-4
+    grad_atol = 5e-3
+    grad_rtol = 5e-3
+    fd_eps = 5e-3
+
+    def _build(self, op_type, inputs, attrs, out_slots, stop_gradients=()):
+        main = fluid.Program()
+        startup = fluid.Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            in_vars = {}
+            for slot, val in inputs.items():
+                if isinstance(val, list):
+                    row = []
+                    for name, arr in val:
+                        v = main.global_block().create_var(
+                            name=name, shape=arr.shape,
+                            dtype=str(arr.dtype),
+                            stop_gradient=(slot in stop_gradients or
+                                           not np.issubdtype(
+                                               arr.dtype, np.floating)))
+                        row.append(v)
+                        feed[name] = arr
+                    in_vars[slot] = row
+                else:
+                    name = 'in_' + slot
+                    v = main.global_block().create_var(
+                        name=name, shape=val.shape, dtype=str(val.dtype),
+                        stop_gradient=(slot in stop_gradients or
+                                       not np.issubdtype(val.dtype,
+                                                         np.floating)))
+                    in_vars[slot] = v
+                    feed[name] = val
+            out_vars = {}
+            for slot in out_slots:
+                ov = main.global_block().create_var(
+                    name='out_' + slot, shape=(), dtype='float32')
+                out_vars[slot] = ov
+            main.global_block().append_op(op_type, inputs=in_vars,
+                                          outputs=out_vars, attrs=attrs)
+        return main, startup, feed, in_vars, out_vars
+
+    def run_op(self, op_type, inputs, attrs=None, out_slots=('Out',),
+               stop_gradients=()):
+        main, startup, feed, _, out_vars = self._build(
+            op_type, inputs, attrs or {}, out_slots, stop_gradients)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            fetches = [out_vars[s] for s in out_slots]
+            res = exe.run(main, feed=feed, fetch_list=fetches)
+        return dict(zip(out_slots, res))
+
+    def check_output(self, op_type, inputs, attrs=None, expect=None,
+                     out_slots=None, atol=None, rtol=None):
+        expect = expect or {}
+        out_slots = out_slots or list(expect.keys()) or ['Out']
+        got = self.run_op(op_type, inputs, attrs, tuple(out_slots))
+        for slot, want in expect.items():
+            np.testing.assert_allclose(
+                got[slot], np.asarray(want),
+                atol=atol or self.atol, rtol=rtol or self.rtol,
+                err_msg='%s output %s mismatch' % (op_type, slot))
+        return got
+
+    def check_grad(self, op_type, inputs, attrs=None, out_slot='Out',
+                   grad_slots=None, stop_gradients=(), eps=None,
+                   atol=None, rtol=None):
+        """Compare analytic d(sum(w*out))/d(in) against central
+        finite differences, like reference get_numeric_gradient."""
+        attrs = attrs or {}
+        eps = eps or self.fd_eps
+        grad_slots = grad_slots or [
+            s for s, v in inputs.items()
+            if s not in stop_gradients and np.issubdtype(
+                (v if not isinstance(v, list) else v[0][1]).dtype,
+                np.floating)]
+
+        main, startup, feed, in_vars, out_vars = self._build(
+            op_type, inputs, attrs, (out_slot,), stop_gradients)
+        out_var = out_vars[out_slot]
+        rng = np.random.RandomState(123)
+
+        with fluid.program_guard(main, startup):
+            w = rng.uniform(0.5, 1.5,
+                            size=out_var.shape or ()).astype('float32')
+            wv = fluid.layers.assign(w.astype('float32'))
+            prod = fluid.layers.elementwise_mul(
+                out_var, wv) if out_var.shape else out_var
+            loss = fluid.layers.reduce_sum(prod)
+            grads = {}
+            pg = fluid.backward.append_backward(
+                loss, parameter_list=None)
+            del pg
+            for slot in grad_slots:
+                v = in_vars[slot]
+                assert not isinstance(v, list), \
+                    'check_grad on multi-var slots unsupported'
+                gname = main._grad_name_map.get(v.name)
+                assert gname, 'no grad var for %s' % v.name
+                grads[slot] = gname
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            analytic = exe.run(main, feed=feed,
+                               fetch_list=[grads[s] for s in grad_slots])
+            analytic = dict(zip(grad_slots, analytic))
+
+            def eval_loss(fd):
+                out, = exe.run(main, feed=fd, fetch_list=[loss])
+                return float(out)
+
+            for slot in grad_slots:
+                name = 'in_' + slot
+                base = feed[name].astype(np.float64)
+                numeric = np.zeros_like(base)
+                flat = base.reshape(-1)
+                num_flat = numeric.reshape(-1)
+                for i in range(flat.size):
+                    orig = flat[i]
+                    fd = dict(feed)
+                    pert = base.copy().reshape(-1)
+                    pert[i] = orig + eps
+                    fd[name] = pert.reshape(base.shape).astype(
+                        feed[name].dtype)
+                    lp = eval_loss(fd)
+                    pert[i] = orig - eps
+                    fd[name] = pert.reshape(base.shape).astype(
+                        feed[name].dtype)
+                    lm = eval_loss(fd)
+                    num_flat[i] = (lp - lm) / (2 * eps)
+                np.testing.assert_allclose(
+                    analytic[slot], numeric,
+                    atol=atol or self.grad_atol,
+                    rtol=rtol or self.grad_rtol,
+                    err_msg='%s grad wrt %s mismatch' % (op_type, slot))

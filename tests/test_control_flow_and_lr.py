@@ -1,0 +1,96 @@
+"""While loop, LR schedulers, sequence ops."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_while_loop_sum():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], 'float32', 0.0)
+        i.stop_gradient = True
+        limit = fluid.layers.fill_constant([1], 'float32', 10.0)
+        acc = fluid.layers.fill_constant([1], 'float32', 0.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            fluid.layers.increment(i, value=1.0, in_place=True)
+            fluid.layers.sums([acc, i], out=acc)
+            fluid.layers.less_than(i, limit, cond=cond)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        out, = exe.run(main, fetch_list=[acc])
+    assert float(out) == 55.0, out
+
+
+@pytest.mark.parametrize('name,fn,expect0,expect5', [
+    ('exp', lambda: fluid.layers.exponential_decay(0.1, 10, 0.5),
+     0.1, 0.1 * 0.5 ** 0.5),
+    ('piecewise', lambda: fluid.layers.piecewise_decay([3, 6],
+                                                       [0.1, 0.01, 0.001]),
+     0.1, 0.01),
+])
+def test_lr_schedulers(name, fn, expect0, expect5):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        lr = fn()
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(pred)
+        opt = fluid.optimizer.SGD(lr)
+        opt.minimize(loss)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        lrs = []
+        for _ in range(6):
+            v, = exe.run(main, feed={'x': np.ones((4, 2), 'float32')},
+                         fetch_list=[lr])
+            lrs.append(float(np.asarray(v).ravel()[0]))
+    np.testing.assert_allclose(lrs[0], expect0, rtol=1e-5)
+    np.testing.assert_allclose(lrs[5], expect5, rtol=1e-5)
+
+
+def test_noam_warmup_rises_then_falls():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        lr = fluid.layers.noam_decay(64, warmup_steps=5)
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(lr).minimize(loss)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        lrs = [float(np.asarray(exe.run(
+            main, feed={'x': np.ones((2, 2), 'float32')},
+            fetch_list=[lr])[0]).ravel()[0]) for _ in range(10)]
+    assert lrs[0] < lrs[4] and lrs[9] < lrs[4] * 1.01, lrs
+
+
+def test_sequence_ops():
+    from paddle_tpu.ops import registry
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], np.float32)
+    ctx = registry.LowerCtx(0)
+    out = registry.get('sequence_pool').fn(
+        ctx, {'X': [x], 'Mask': [mask]}, {'pooltype': 'AVERAGE'})
+    np.testing.assert_allclose(out['Out'][0][0], x[0, :3].mean(0))
+    np.testing.assert_allclose(out['Out'][0][1], x[1, :2].mean(0))
+    out = registry.get('sequence_pool').fn(
+        ctx, {'X': [x], 'Mask': [mask]}, {'pooltype': 'MAX'})
+    np.testing.assert_allclose(out['Out'][0][1], x[1, :2].max(0))
+    out = registry.get('sequence_pool').fn(
+        ctx, {'X': [x], 'Mask': [mask]}, {'pooltype': 'LAST'})
+    np.testing.assert_allclose(out['Out'][0][0], x[0, 2])
+    sm = registry.get('sequence_softmax').fn(
+        ctx, {'X': [x[:, :, 0]], 'Mask': [mask]}, {})['Out'][0]
+    np.testing.assert_allclose(np.asarray(sm).sum(-1), [1.0, 1.0],
+                               rtol=1e-5)
+    assert sm[0, 3] == 0 and sm[1, 2] == 0
+    m = registry.get('sequence_mask').fn(
+        ctx, {'X': [np.array([3, 2])]}, {'maxlen': 4,
+                                         'out_dtype': 'float32'})
+    np.testing.assert_allclose(m['Y'][0], mask)

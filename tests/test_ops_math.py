@@ -1,0 +1,180 @@
+"""Per-op tests: math / elementwise / reduction ops.
+
+Mirrors reference tests test_matmul_op.py, test_elementwise_*_op.py,
+test_reduce_op.py etc. (python/paddle/fluid/tests/unittests/).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+rng = np.random.RandomState(42)
+
+
+class TestMatmul(OpTest):
+    def test_basic(self):
+        x = rng.randn(4, 5).astype('float32')
+        y = rng.randn(5, 3).astype('float32')
+        self.check_output('matmul', {'X': x, 'Y': y},
+                          expect={'Out': x @ y})
+
+    def test_transpose(self):
+        x = rng.randn(5, 4).astype('float32')
+        y = rng.randn(3, 5).astype('float32')
+        self.check_output('matmul', {'X': x, 'Y': y},
+                          attrs={'transpose_X': True, 'transpose_Y': True},
+                          expect={'Out': x.T @ y.T})
+
+    def test_batched(self):
+        x = rng.randn(2, 4, 5).astype('float32')
+        y = rng.randn(2, 5, 3).astype('float32')
+        self.check_output('matmul', {'X': x, 'Y': y},
+                          expect={'Out': np.matmul(x, y)})
+
+    def test_grad(self):
+        x = rng.randn(3, 4).astype('float32')
+        y = rng.randn(4, 2).astype('float32')
+        self.check_grad('matmul', {'X': x, 'Y': y})
+
+
+class TestMul(OpTest):
+    def test_flatten(self):
+        x = rng.randn(2, 3, 4).astype('float32')
+        y = rng.randn(12, 5).astype('float32')
+        self.check_output('mul', {'X': x, 'Y': y},
+                          attrs={'x_num_col_dims': 1, 'y_num_col_dims': 1},
+                          expect={'Out': x.reshape(2, 12) @ y})
+
+    def test_grad(self):
+        x = rng.randn(3, 4).astype('float32')
+        y = rng.randn(4, 2).astype('float32')
+        self.check_grad('mul', {'X': x, 'Y': y})
+
+
+class TestElementwise(OpTest):
+    def test_add_broadcast_axis(self):
+        x = rng.randn(2, 3, 4).astype('float32')
+        y = rng.randn(3,).astype('float32')
+        self.check_output('elementwise_add', {'X': x, 'Y': y},
+                          attrs={'axis': 1},
+                          expect={'Out': x + y.reshape(1, 3, 1)})
+
+    def test_ops(self):
+        x = rng.randn(3, 4).astype('float32')
+        y = rng.rand(3, 4).astype('float32') + 0.5
+        for op, fn in [('elementwise_add', np.add),
+                       ('elementwise_sub', np.subtract),
+                       ('elementwise_mul', np.multiply),
+                       ('elementwise_div', np.divide),
+                       ('elementwise_min', np.minimum),
+                       ('elementwise_max', np.maximum)]:
+            self.check_output(op, {'X': x, 'Y': y},
+                              expect={'Out': fn(x, y)})
+
+    def test_grad_broadcast(self):
+        x = rng.randn(2, 3).astype('float32')
+        y = rng.randn(3,).astype('float32')
+        self.check_grad('elementwise_add', {'X': x, 'Y': y},
+                        attrs={'axis': -1})
+        self.check_grad('elementwise_mul', {'X': x, 'Y': y},
+                        attrs={'axis': -1})
+
+
+class TestReduce(OpTest):
+    def test_all(self):
+        x = rng.randn(3, 4, 5).astype('float32')
+        self.check_output('reduce_sum', {'X': x},
+                          attrs={'reduce_all': True},
+                          expect={'Out': x.sum()})
+        self.check_output('reduce_mean', {'X': x},
+                          attrs={'dim': [1], 'keep_dim': True},
+                          expect={'Out': x.mean(1, keepdims=True)})
+        self.check_output('reduce_max', {'X': x}, attrs={'dim': [-1]},
+                          expect={'Out': x.max(-1)})
+
+    def test_grad(self):
+        x = rng.randn(3, 4).astype('float32')
+        self.check_grad('reduce_sum', {'X': x}, attrs={'dim': [1]})
+        self.check_grad('reduce_mean', {'X': x},
+                        attrs={'reduce_all': True})
+
+
+class TestActivations(OpTest):
+    def test_forward(self):
+        x = rng.randn(3, 4).astype('float32')
+        self.check_output('relu', {'X': x},
+                          expect={'Out': np.maximum(x, 0)})
+        self.check_output('sigmoid', {'X': x},
+                          expect={'Out': 1 / (1 + np.exp(-x))})
+        self.check_output('tanh', {'X': x}, expect={'Out': np.tanh(x)})
+        self.check_output('square', {'X': x}, expect={'Out': x * x})
+        self.check_output('leaky_relu', {'X': x}, attrs={'alpha': 0.1},
+                          expect={'Out': np.where(x > 0, x, 0.1 * x)})
+
+    def test_grad(self):
+        x = (rng.randn(3, 4) + 2.0).astype('float32')  # keep off kinks
+        for op in ('sigmoid', 'tanh', 'exp', 'square', 'softplus'):
+            self.check_grad(op, {'X': x})
+
+
+class TestSoftmax(OpTest):
+    def test_forward(self):
+        x = rng.randn(3, 5).astype('float32')
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.check_output('softmax', {'X': x},
+                          expect={'Out': e / e.sum(-1, keepdims=True)})
+
+    def test_grad(self):
+        x = rng.randn(2, 4).astype('float32')
+        self.check_grad('softmax', {'X': x})
+
+
+class TestScaleClip(OpTest):
+    def test_scale(self):
+        x = rng.randn(3, 4).astype('float32')
+        self.check_output('scale', {'X': x},
+                          attrs={'scale': 2.5, 'bias': 1.0},
+                          expect={'Out': x * 2.5 + 1.0})
+
+    def test_clip(self):
+        x = rng.randn(3, 4).astype('float32')
+        self.check_output('clip', {'X': x},
+                          attrs={'min': -0.5, 'max': 0.5},
+                          expect={'Out': np.clip(x, -0.5, 0.5)})
+
+
+class TestCompare(OpTest):
+    def test_cmp(self):
+        x = rng.randn(3, 4).astype('float32')
+        y = rng.randn(3, 4).astype('float32')
+        self.check_output('less_than', {'X': x, 'Y': y},
+                          expect={'Out': x < y})
+        self.check_output('equal', {'X': x, 'Y': x},
+                          expect={'Out': np.ones_like(x, bool)})
+
+
+class TestTopK(OpTest):
+    def test_topk(self):
+        x = rng.randn(4, 10).astype('float32')
+        got = self.run_op('top_k', {'X': x}, attrs={'k': 3},
+                          out_slots=('Out', 'Indices'))
+        expect = np.sort(x, axis=-1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(got['Out'], expect, rtol=1e-5)
+
+
+class TestArgMax(OpTest):
+    def test_argmax(self):
+        x = rng.randn(4, 7).astype('float32')
+        self.check_output('arg_max', {'X': x}, attrs={'axis': 1},
+                          expect={'Out': x.argmax(1)})
+
+
+class TestSum(OpTest):
+    def test_sum_n(self):
+        xs = [('a', rng.randn(3, 4).astype('float32')),
+              ('b', rng.randn(3, 4).astype('float32')),
+              ('c', rng.randn(3, 4).astype('float32'))]
+        self.check_output('sum', {'X': xs},
+                          expect={'Out': sum(a for _, a in xs)})

@@ -1,0 +1,291 @@
+"""Per-op tests: NN ops (conv/pool/norm/dropout/losses/tensor manip).
+
+Mirrors reference tests test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_softmax_with_cross_entropy_op.py, etc.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def ref_conv2d(x, w, stride, pad):
+    n, c, h, wdt = x.shape
+    oc, ic, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wdt + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, oc, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, ([1, 2, 3],
+                                                      [1, 2, 3]))
+    return out
+
+
+class TestConv2D(OpTest):
+    def test_forward(self):
+        x = rng.randn(2, 3, 8, 8).astype('float32')
+        w = rng.randn(4, 3, 3, 3).astype('float32')
+        self.check_output('conv2d', {'Input': x, 'Filter': w},
+                          attrs={'strides': [1, 1], 'paddings': [1, 1]},
+                          expect={'Output': ref_conv2d(x, w, 1, 1)},
+                          atol=1e-3, rtol=1e-3)
+
+    def test_stride2(self):
+        x = rng.randn(1, 2, 9, 9).astype('float32')
+        w = rng.randn(3, 2, 3, 3).astype('float32')
+        self.check_output('conv2d', {'Input': x, 'Filter': w},
+                          attrs={'strides': [2, 2], 'paddings': [0, 0]},
+                          expect={'Output': ref_conv2d(x, w, 2, 0)},
+                          atol=1e-3, rtol=1e-3)
+
+    def test_grad(self):
+        x = rng.randn(1, 2, 5, 5).astype('float32')
+        w = rng.randn(2, 2, 3, 3).astype('float32')
+        self.check_grad('conv2d', {'Input': x, 'Filter': w},
+                        attrs={'strides': [1, 1], 'paddings': [1, 1]},
+                        out_slot='Output', atol=2e-2, rtol=2e-2)
+
+
+class TestPool2D(OpTest):
+    def test_maxpool(self):
+        x = rng.randn(2, 3, 4, 4).astype('float32')
+        expect = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.check_output('pool2d', {'X': x},
+                          attrs={'pooling_type': 'max', 'ksize': [2, 2],
+                                 'strides': [2, 2], 'paddings': [0, 0]},
+                          expect={'Out': expect})
+
+    def test_avgpool(self):
+        x = rng.randn(2, 3, 4, 4).astype('float32')
+        expect = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.check_output('pool2d', {'X': x},
+                          attrs={'pooling_type': 'avg', 'ksize': [2, 2],
+                                 'strides': [2, 2], 'paddings': [0, 0]},
+                          expect={'Out': expect})
+
+    def test_global(self):
+        x = rng.randn(2, 3, 4, 4).astype('float32')
+        self.check_output('pool2d', {'X': x},
+                          attrs={'pooling_type': 'avg',
+                                 'global_pooling': True, 'ksize': [1, 1]},
+                          expect={'Out': x.mean((2, 3), keepdims=True)})
+
+    def test_grad(self):
+        x = rng.randn(1, 2, 4, 4).astype('float32')
+        self.check_grad('pool2d', {'X': x},
+                        attrs={'pooling_type': 'avg', 'ksize': [2, 2],
+                               'strides': [2, 2], 'paddings': [0, 0]})
+
+
+class TestBatchNorm(OpTest):
+    def _inputs(self, c=4):
+        x = rng.randn(3, c, 5, 5).astype('float32')
+        return {'X': x,
+                'Scale': rng.rand(c).astype('float32') + 0.5,
+                'Bias': rng.randn(c).astype('float32'),
+                'Mean': np.zeros(c, 'float32'),
+                'Variance': np.ones(c, 'float32')}
+
+    def test_train_forward(self):
+        ins = self._inputs()
+        x = ins['X']
+        m = x.mean((0, 2, 3))
+        v = x.var((0, 2, 3))
+        y = (x - m.reshape(1, -1, 1, 1)) / np.sqrt(
+            v.reshape(1, -1, 1, 1) + 1e-5)
+        y = y * ins['Scale'].reshape(1, -1, 1, 1) + \
+            ins['Bias'].reshape(1, -1, 1, 1)
+        got = self.run_op('batch_norm', ins,
+                          attrs={'is_test': False, 'epsilon': 1e-5,
+                                 'momentum': 0.9},
+                          out_slots=('Y', 'MeanOut', 'VarianceOut',
+                                     'SavedMean', 'SavedVariance'))
+        np.testing.assert_allclose(got['Y'], y, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(got['MeanOut'], 0.1 * m, atol=1e-5)
+
+    def test_eval_forward(self):
+        ins = self._inputs()
+        ins['Mean'] = rng.randn(4).astype('float32') * 0.1
+        ins['Variance'] = rng.rand(4).astype('float32') + 0.5
+        x = ins['X']
+        y = (x - ins['Mean'].reshape(1, -1, 1, 1)) / np.sqrt(
+            ins['Variance'].reshape(1, -1, 1, 1) + 1e-5)
+        y = y * ins['Scale'].reshape(1, -1, 1, 1) + \
+            ins['Bias'].reshape(1, -1, 1, 1)
+        got = self.run_op('batch_norm', ins,
+                          attrs={'is_test': True, 'epsilon': 1e-5},
+                          out_slots=('Y',))
+        np.testing.assert_allclose(got['Y'], y, atol=1e-4, rtol=1e-4)
+
+
+class TestLayerNorm(OpTest):
+    def test_forward(self):
+        x = rng.randn(4, 10).astype('float32')
+        scale = rng.rand(10).astype('float32') + 0.5
+        bias = rng.randn(10).astype('float32')
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        y = (x - m) / np.sqrt(v + 1e-5) * scale + bias
+        self.check_output('layer_norm',
+                          {'X': x, 'Scale': scale, 'Bias': bias},
+                          attrs={'epsilon': 1e-5, 'begin_norm_axis': 1},
+                          expect={'Y': y}, atol=1e-4, rtol=1e-4,
+                          out_slots=['Y'])
+
+    def test_grad(self):
+        x = rng.randn(3, 6).astype('float32')
+        scale = rng.rand(6).astype('float32') + 0.5
+        bias = rng.randn(6).astype('float32')
+        self.check_grad('layer_norm',
+                        {'X': x, 'Scale': scale, 'Bias': bias},
+                        attrs={'epsilon': 1e-5, 'begin_norm_axis': 1},
+                        out_slot='Y', atol=2e-2, rtol=2e-2)
+
+
+class TestDropout(OpTest):
+    def test_train_stats(self):
+        x = np.ones((100, 100), 'float32')
+        got = self.run_op('dropout', {'X': x},
+                          attrs={'dropout_prob': 0.3, 'is_test': False,
+                                 'dropout_implementation':
+                                     'upscale_in_train'})
+        keep_rate = (np.asarray(got['Out']) != 0).mean()
+        assert abs(keep_rate - 0.7) < 0.03
+        # kept values upscaled by 1/0.7
+        kept = np.asarray(got['Out'])[np.asarray(got['Out']) != 0]
+        np.testing.assert_allclose(kept, 1.0 / 0.7, rtol=1e-5)
+
+    def test_eval_identity(self):
+        x = rng.randn(5, 5).astype('float32')
+        self.check_output('dropout', {'X': x},
+                          attrs={'dropout_prob': 0.3, 'is_test': True,
+                                 'dropout_implementation':
+                                     'upscale_in_train'},
+                          expect={'Out': x})
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def test_forward(self):
+        logits = rng.randn(4, 6).astype('float32')
+        label = rng.randint(0, 6, (4, 1)).astype('int64')
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label[:, 0]])[:, None]
+        got = self.run_op('softmax_with_cross_entropy',
+                          {'Logits': logits, 'Label': label},
+                          out_slots=('Softmax', 'Loss'))
+        np.testing.assert_allclose(got['Softmax'], sm, atol=1e-5,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(got['Loss'], loss, atol=1e-5,
+                                   rtol=1e-4)
+
+    def test_grad(self):
+        logits = rng.randn(3, 5).astype('float32')
+        label = rng.randint(0, 5, (3, 1)).astype('int64')
+        self.check_grad('softmax_with_cross_entropy',
+                        {'Logits': logits, 'Label': label},
+                        out_slot='Loss', grad_slots=['Logits'])
+
+
+class TestCrossEntropy(OpTest):
+    def test_forward(self):
+        probs = rng.dirichlet(np.ones(5), 4).astype('float32')
+        label = rng.randint(0, 5, (4, 1)).astype('int64')
+        loss = -np.log(probs[np.arange(4), label[:, 0]])[:, None]
+        self.check_output('cross_entropy',
+                          {'X': probs, 'Label': label},
+                          expect={'Y': loss}, out_slots=['Y'],
+                          atol=1e-5)
+
+
+class TestLookupTable(OpTest):
+    def test_forward(self):
+        w = rng.randn(10, 4).astype('float32')
+        ids = rng.randint(0, 10, (3, 5)).astype('int64')
+        self.check_output('lookup_table_v2', {'W': w, 'Ids': ids},
+                          expect={'Out': w[ids]})
+
+    def test_padding_idx(self):
+        w = rng.randn(10, 4).astype('float32')
+        ids = np.array([[0, 2, 0], [1, 0, 3]], 'int64')
+        out = w[ids].copy()
+        out[ids == 0] = 0
+        self.check_output('lookup_table_v2', {'W': w, 'Ids': ids},
+                          attrs={'padding_idx': 0}, expect={'Out': out})
+
+    def test_grad_scatter(self):
+        """Embedding grad = scatter-add of output grads into rows."""
+        w = rng.randn(6, 3).astype('float32')
+        ids = np.array([1, 1, 4], 'int64')
+        self.check_grad('lookup_table_v2',
+                        {'W': w, 'Ids': ids}, grad_slots=['W'])
+
+
+class TestTensorManip(OpTest):
+    def test_reshape_transpose_concat(self):
+        x = rng.randn(2, 6).astype('float32')
+        self.check_output('reshape2', {'X': x}, attrs={'shape': [3, 4]},
+                          expect={'Out': x.reshape(3, 4)})
+        self.check_output('reshape2', {'X': x}, attrs={'shape': [0, -1]},
+                          expect={'Out': x})
+        self.check_output('transpose2', {'X': x}, attrs={'axis': [1, 0]},
+                          expect={'Out': x.T})
+        ys = [('p', rng.randn(2, 3).astype('float32')),
+              ('q', rng.randn(2, 2).astype('float32'))]
+        self.check_output('concat', {'X': ys}, attrs={'axis': 1},
+                          expect={'Out': np.concatenate(
+                              [a for _, a in ys], 1)})
+
+    def test_split_sections(self):
+        x = rng.randn(2, 10).astype('float32')
+        got = self.run_op('split', {'X': x},
+                          attrs={'axis': 1, 'sections': [2, -1, 3]},
+                          out_slots=('Out',))
+        # only first returned through Out[0]; use full runner instead
+        # -> validate via direct lowering
+        from paddle_tpu.ops import registry
+        outs = registry.get('split').fn(
+            registry.LowerCtx(0), {'X': [x]},
+            {'axis': 1, 'sections': [2, -1, 3]})['Out']
+        np.testing.assert_allclose(outs[0], x[:, :2])
+        np.testing.assert_allclose(outs[1], x[:, 2:7])
+        np.testing.assert_allclose(outs[2], x[:, 7:])
+
+    def test_slice_gather(self):
+        x = rng.randn(5, 6).astype('float32')
+        self.check_output('slice', {'Input': x},
+                          attrs={'axes': [0, 1], 'starts': [1, 2],
+                                 'ends': [4, 6]},
+                          expect={'Out': x[1:4, 2:6]})
+        idx = np.array([3, 0, 1], 'int64')
+        self.check_output('gather', {'X': x, 'Index': idx},
+                          expect={'Out': x[idx]})
+
+    def test_onehot_cast(self):
+        ids = np.array([[1], [3]], 'int64')
+        oh = np.zeros((2, 5), 'float32')
+        oh[0, 1] = oh[1, 3] = 1
+        self.check_output('one_hot', {'X': ids}, attrs={'depth': 5},
+                          expect={'Out': oh})
+        x = rng.randn(3, 3).astype('float32')
+        self.check_output('cast', {'X': x},
+                          attrs={'out_dtype': 'int32'},
+                          expect={'Out': x.astype(np.int32)})
+
+
+class TestAccuracyOp(OpTest):
+    def test_accuracy(self):
+        idx = np.array([[0, 1], [2, 3], [4, 5]], 'int64')
+        label = np.array([[1], [0], [4]], 'int64')
+        got = self.run_op('accuracy',
+                          {'Out': rng.rand(3, 2).astype('float32'),
+                           'Indices': idx, 'Label': label},
+                          out_slots=('Accuracy',))
+        np.testing.assert_allclose(got['Accuracy'], 2.0 / 3.0, rtol=1e-6)

@@ -1,0 +1,125 @@
+"""Optimizer op math vs numpy references.
+
+Mirrors reference tests test_sgd_op.py, test_momentum_op.py,
+test_adam_op.py (python/paddle/fluid/tests/unittests/), plus whole-loop
+convergence checks through the Python optimizer classes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import registry
+
+rng = np.random.RandomState(11)
+
+
+def run_lowering(op, ins, attrs=None):
+    return registry.get(op).fn(registry.LowerCtx(0),
+                               {k: [v] for k, v in ins.items()},
+                               attrs or {})
+
+
+def test_sgd_op():
+    p = rng.randn(4, 3).astype('float32')
+    g = rng.randn(4, 3).astype('float32')
+    lr = np.array([0.1], 'float32')
+    out = run_lowering('sgd', {'Param': p, 'Grad': g,
+                               'LearningRate': lr})
+    np.testing.assert_allclose(out['ParamOut'][0], p - 0.1 * g,
+                               rtol=1e-6)
+
+
+def test_momentum_op():
+    p = rng.randn(4).astype('float32')
+    g = rng.randn(4).astype('float32')
+    v = rng.randn(4).astype('float32')
+    lr = np.array([0.01], 'float32')
+    out = run_lowering('momentum',
+                       {'Param': p, 'Grad': g, 'Velocity': v,
+                        'LearningRate': lr}, {'mu': 0.9})
+    v2 = 0.9 * v + g
+    np.testing.assert_allclose(out['VelocityOut'][0], v2, rtol=1e-6)
+    np.testing.assert_allclose(out['ParamOut'][0], p - 0.01 * v2,
+                               rtol=1e-6)
+
+
+def test_adam_op():
+    p = rng.randn(6).astype('float32')
+    g = rng.randn(6).astype('float32')
+    m1 = rng.randn(6).astype('float32') * 0.1
+    m2 = np.abs(rng.randn(6)).astype('float32') * 0.1
+    b1p = np.array([0.9], 'float32')
+    b2p = np.array([0.999], 'float32')
+    lr = np.array([0.001], 'float32')
+    out = run_lowering('adam',
+                       {'Param': p, 'Grad': g, 'Moment1': m1,
+                        'Moment2': m2, 'Beta1Pow': b1p, 'Beta2Pow': b2p,
+                        'LearningRate': lr},
+                       {'beta1': 0.9, 'beta2': 0.999, 'epsilon': 1e-8})
+    m1n = 0.9 * m1 + 0.1 * g
+    m2n = 0.999 * m2 + 0.001 * g * g
+    lr_t = 0.001 * np.sqrt(1 - b2p * 0.999) / (1 - b1p * 0.9)
+    pn = p - lr_t * m1n / (np.sqrt(m2n) + 1e-8)
+    np.testing.assert_allclose(out['ParamOut'][0], pn, rtol=1e-5)
+    np.testing.assert_allclose(out['Beta1PowOut'][0], b1p * 0.9,
+                               rtol=1e-6)
+
+
+def _train_quadratic(optimizer, steps=100):
+    """Minimize ||Wx - y||^2; returns final loss."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.data('y', shape=[2], dtype='float32')
+        pred = fluid.layers.fc(x, 2, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        optimizer.minimize(loss)
+    scope = fluid.Scope()
+    r = np.random.RandomState(0)
+    W = r.randn(4, 2).astype('float32')
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        final = None
+        for _ in range(steps):
+            xs = r.randn(16, 4).astype('float32')
+            ys = xs @ W
+            final, = exe.run(main, feed={'x': xs, 'y': ys},
+                             fetch_list=[loss])
+    return float(final)
+
+
+@pytest.mark.parametrize('opt_fn,steps,tol', [
+    (lambda: fluid.optimizer.SGD(0.1), 100, 0.05),
+    (lambda: fluid.optimizer.Momentum(0.05, momentum=0.9), 100, 0.05),
+    (lambda: fluid.optimizer.Momentum(0.05, momentum=0.9,
+                                      use_nesterov=True), 100, 0.05),
+    (lambda: fluid.optimizer.Adam(0.05), 100, 0.05),
+    (lambda: fluid.optimizer.AdamW(0.05, weight_decay=0.001), 100, 0.05),
+    (lambda: fluid.optimizer.Adagrad(0.3), 100, 0.05),
+    (lambda: fluid.optimizer.RMSProp(0.05), 100, 0.05),
+    (lambda: fluid.optimizer.Lamb(0.05), 100, 0.05),
+    # adamax / adadelta ramp up slowly by construction
+    (lambda: fluid.optimizer.Adamax(0.1), 400, 0.1),
+    (lambda: fluid.optimizer.Adadelta(1.0), 900, 0.5),
+    (lambda: fluid.optimizer.Ftrl(0.5), 100, 0.05),
+])
+def test_optimizer_converges(opt_fn, steps, tol):
+    final = _train_quadratic(opt_fn(), steps=steps)
+    assert final < tol, final
+
+
+def test_weight_decay_regularizer():
+    opt = fluid.optimizer.SGD(
+        0.1, regularization=fluid.regularizer.L2Decay(0.01))
+    final = _train_quadratic(opt)
+    assert final < 0.1
+
+
+def test_global_norm_clip():
+    opt = fluid.optimizer.SGD(
+        0.1, grad_clip=fluid.clip.GradientClipByGlobalNorm(0.5))
+    final = _train_quadratic(opt, steps=200)
+    assert final < 0.1, final
