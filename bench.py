@@ -15,7 +15,7 @@ import time
 import numpy as np
 
 
-def bench_resnet50(batch=128, steps=12, warmup=3, amp=True):
+def bench_resnet50(batch=128, steps=30, warmup=5, amp=True):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
@@ -41,14 +41,15 @@ def bench_resnet50(batch=128, steps=12, warmup=3, amp=True):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
         for _ in range(warmup):
-            exe.run(main, feed={'image': x, 'label': y},
-                    fetch_list=[loss])
-        # force completion of warmup before timing
+            l, = exe.run(main, feed={'image': x, 'label': y},
+                         fetch_list=[loss])
+        np.asarray(l)  # force completion of warmup before timing
         t0 = time.time()
-        last = None
-        for _ in range(steps):
-            last, = exe.run(main, feed={'image': x, 'label': y},
-                            fetch_list=[loss])
+        # steady-state steps: no per-step fetch, dispatch stays async
+        for _ in range(steps - 1):
+            exe.run(main, feed={'image': x, 'label': y}, fetch_list=[])
+        last, = exe.run(main, feed={'image': x, 'label': y},
+                        fetch_list=[loss])
         np.asarray(last)  # block on the last step
         dt = time.time() - t0
     return batch * steps / dt

@@ -18,3 +18,4 @@ from . import collective_ops  # noqa: F401
 
 from .registry import register, register_host, get, is_registered  # noqa
 from . import sequence_ops  # noqa: F401
+from . import fused_ops  # noqa: F401

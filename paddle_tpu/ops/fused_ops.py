@@ -1,0 +1,48 @@
+"""Fused op lowerings.
+
+Reference: paddle/fluid/operators/fused/ (~7.6k LoC CUDA:
+multihead_matmul, fused_elemwise_activation, fused_fc_elementwise_
+layernorm, fusion_group NVRTC JIT).  On TPU most of these ARE XLA's
+automatic fusions; the ones kept here either use a Pallas kernel
+(attention) or encode a pattern XLA cannot see (none yet).
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('fused_multihead_attention')
+def fused_multihead_attention(ctx, ins, attrs):
+    """Q,K,V: [B, T, H, D] -> Out [B, T, H, D] via the Pallas flash
+    attention kernel (interpret mode off-TPU)."""
+    from .pallas.flash_attention import flash_attention
+    q = ins['Q'][0]
+    k = ins['K'][0]
+    v = ins['V'][0]
+    return {'Out': [flash_attention(q, k, v,
+                                    causal=attrs.get('causal', False))]}
+
+
+@register('fused_elemwise_activation')
+def fused_elemwise_activation(ctx, ins, attrs):
+    """Reference operators/fused/fused_elemwise_activation_op.cc:
+    functor_list like ['elementwise_add', 'relu'].  XLA fuses anyway;
+    provided for program-level parity."""
+    import jax
+    x, y = ins['X'][0], ins['Y'][0]
+    functors = attrs.get('functor_list', ['elementwise_add', 'relu'])
+    from .math_ops import _bcast
+    x, y = _bcast(x, y, attrs.get('axis', -1))
+    binary, unary = functors[0], functors[1] if len(functors) > 1 else None
+    vals = {'elementwise_add': x + y, 'elementwise_mul': x * y}
+    out = vals[binary]
+    if unary == 'relu':
+        out = jax.nn.relu(out)
+    elif unary == 'tanh':
+        out = jnp.tanh(out)
+    elif unary in (None, 'identity'):
+        pass
+    else:
+        raise NotImplementedError(unary)
+    return {'Out': [out], 'IntermediateOut': [vals[binary]]}

@@ -1,0 +1,153 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+Replaces the reference's fused attention chain
+(operators/fused/multihead_matmul_op.cu: QK^T -> softmax -> PV as cuBLAS
++ custom softmax kernels) with one online-softmax kernel: Q blocks ride
+the MXU against K/V blocks streamed through VMEM; no [T, T] score matrix
+ever materializes in HBM.
+
+Backward uses custom_vjp with recomputation lowered to XLA (flash-bwd
+Pallas kernel is a follow-up); on non-TPU platforms the kernel runs in
+interpreter mode so tests cover it everywhere.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                      block_k):
+    # q_ref: [1, bq, d]; k_ref/v_ref: [1, T, d]; o_ref: [1, bq, d]
+    q = q_ref[0].astype(jnp.float32)
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    q_off = pl.program_id(1) * bq
+
+    nk = t // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(
+            jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale
+        if causal:
+            qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq,
+                                                                block_k),
+                                                    0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # skip fully-masked K blocks beyond the diagonal
+        last = (q_off + bq + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk, last)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-20)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform.startswith('tpu') or \
+            'TPU' in str(jax.devices()[0])
+    except Exception:
+        return False
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D]."""
+    bh, t, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    while t % block_q:
+        block_q //= 2
+    while t % block_k:
+        block_k //= 2
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale,
+                               causal=causal, block_k=block_k)
+    grid = (bh, t // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_reference(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum('btd,bsd->bts', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (d ** 0.5)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bts,bsd->btd', p, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal):
+    interpret = not _on_tpu()
+    return _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                      interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal):
+    out = _flash(q, k, v, causal)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False):
+    """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+    b, t, h, d = q.shape
+
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal)
+    return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))

@@ -1,0 +1,14 @@
+"""Parallelism beyond data parallel — NEW capability vs the reference
+(SURVEY.md §2.4 'NOT present': TP/SP/ring attention).
+
+- mesh.py:           mesh construction (dp/mp/pp/sp axes) + registry
+- ring_attention.py: context parallelism via ppermute ring
+- ulysses.py:        sequence parallelism via all_to_all head exchange
+- pipeline.py:       microbatch pipeline over a 'pp' axis
+"""
+
+from . import mesh
+from .mesh import create_mesh, get_global_mesh, set_global_mesh
+from . import ring_attention
+from . import ulysses
+from . import pipeline

@@ -1,0 +1,57 @@
+"""Device-mesh construction and the global mesh registry.
+
+Replaces the reference's NCCL ring/communicator bookkeeping
+(platform/nccl_helper.h:90 NCCLContextMap, :179 multi-ring,
+platform/collective_helper.h:50 NCCLCommContext keyed by ring_id):
+on TPU a single jax.sharding.Mesh with named axes subsumes every ring —
+XLA routes each collective over ICI (mesh-adjacent axes) or DCN.
+"""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_GLOBAL_MESH = None
+
+# canonical axis order: data, model(tensor), pipeline, sequence, expert
+AXES = ('dp', 'mp', 'pp', 'sp', 'ep')
+
+
+def create_mesh(dp=None, mp=1, pp=1, sp=1, ep=1, devices=None):
+    """Build a mesh over the available devices.  dp defaults to
+    'whatever remains'.  Axis sizes must multiply to the device count."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    rest = mp * pp * sp * ep
+    if dp is None:
+        if n % rest:
+            raise ValueError('device count %d not divisible by %d'
+                             % (n, rest))
+        dp = n // rest
+    sizes = dict(dp=dp, mp=mp, pp=pp, sp=sp, ep=ep)
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError('mesh %s needs %d devices, have %d'
+                         % (sizes, total, n))
+    axes = [a for a in AXES if sizes[a] > 1] or ['dp']
+    shape = tuple(sizes[a] for a in axes)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axes))
+
+
+def set_global_mesh(mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    # ring 0 keeps mapping to the dp axis; extra rings map to the other
+    # axes in order, mirroring the reference's ring_id convention
+    from ..ops import collective_ops
+    collective_ops.RING_AXES = {i: a for i, a in
+                                enumerate(mesh.axis_names)}
+    return mesh
+
+
+def get_global_mesh():
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = create_mesh()
+    return _GLOBAL_MESH

@@ -1,0 +1,84 @@
+"""Pipeline parallelism: microbatch pipeline over a 'pp' mesh axis.
+
+Reference: PipelineOptimizer (python/paddle/fluid/optimizer.py:3311) +
+PipelineTrainer/SectionWorker threads passing Scopes through blocking
+queues (framework/trainer.h:114, framework/pipeline_trainer.cc:26).
+
+TPU-native re-design: no threads or queues — a GPipe schedule expressed
+as a fori_loop where every device applies ITS stage (all stages' params
+live on their own devices via shard_map) and activations hop stages with
+ppermute.  jax.vjp through ppermute reverses the ring, so grads flow
+back through the pipeline automatically — the reference's backward
+section workers come for free from autodiff.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply_inner(stage_fn, params, x_micro, axis_name):
+    """Inside shard_map.
+    params: stage params, ALREADY stage-sharded (leading dim removed).
+    x_micro: [n_micro, micro_B, ...] microbatches (replicated input).
+    Returns [n_micro, micro_B, ...] outputs (replicated)."""
+    n_stages = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    total = n_micro + n_stages - 1
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    buf = jnp.zeros_like(x_micro[0])  # current activation on this device
+    out = jnp.zeros_like(x_micro)
+
+    def body(t, carry):
+        buf, out = carry
+        # stage 0 ingests microbatch t (if any remain)
+        feed = x_micro[jnp.minimum(t, n_micro - 1)]
+        buf = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(params, buf)
+        # last stage emits microbatch t-(n_stages-1)
+        mi = t - (n_stages - 1)
+        emit = jnp.logical_and(idx == n_stages - 1, mi >= 0)
+        out = jax.lax.cond(
+            emit,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(mi, 0), 0),
+            lambda o: o, out)
+        # hop activations to the next stage
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return buf, out
+
+    _, out = jax.lax.fori_loop(0, total, body, (buf, out))
+    # broadcast the last stage's outputs to every device
+    src = n_stages - 1
+    mask = (idx == src).astype(out.dtype)
+    return jax.lax.psum(out * mask, axis_name)
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, axis='pp',
+                   n_microbatches=4):
+    """stage_params: pytree with leading dim = n_stages (stacked per-stage
+    params); x: [B, ...] global batch.  Activations must have the same
+    shape across stages (classic GPipe restriction for the rotating
+    buffer)."""
+    n_stages = mesh.shape[axis]
+    b = x.shape[0]
+    assert b % n_microbatches == 0
+    x_micro = x.reshape((n_microbatches, b // n_microbatches)
+                        + x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+
+    def inner(params, xm):
+        # strip the per-stage leading dim of 1
+        params = jax.tree.map(lambda p: p[0], params)
+        return pipeline_apply_inner(stage_fn, params, xm, axis)
+
+    f = jax.shard_map(inner, mesh=mesh,
+                      in_specs=(param_specs, P()), out_specs=P(),
+                      check_vma=False)
+    out = f(stage_params, x_micro)
+    return out.reshape((b,) + out.shape[2:])

@@ -1,0 +1,93 @@
+"""Ring attention: context parallelism over a mesh axis.
+
+NEW capability vs the reference (SURVEY.md §5: sequence scaling there is
+LoD batching only).  The sequence dim is sharded over the 'sp' axis; K/V
+blocks rotate around the ICI ring via ppermute while each device
+accumulates its Q-block's attention with a numerically-stable online
+softmax (flash-attention style streaming).  Communication overlaps with
+the next block's compute (XLA schedules the ppermute DMA concurrently).
+
+Differentiable: jax.vjp through ppermute reverses the ring, so the same
+code serves training.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attend(q, k, v, m, l, acc, q_off, k_off, scale, causal):
+    """One K/V block of online-softmax attention.
+    q [B,Tq,H,D], k/v [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D]."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(tq)
+        kpos = k_off + jnp.arange(tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows (m_new == -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention_inner(q, k, v, axis_name, causal=False):
+    """Call INSIDE shard_map with q,k,v sequence-sharded [B,T_loc,H,D]."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    acc0 = jnp.zeros((b, tq, h, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc, kk, vv = carry
+        kv_idx = (idx - i) % n
+        m, l, acc = _block_attend(q, kk, vv, m, l, acc,
+                                  idx * tq, kv_idx * tq, scale, causal)
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        return m, l, acc, kk, vv
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body,
+                                        (m0, l0, acc0, k, v))
+    denom = jnp.transpose(jnp.maximum(l, 1e-20), (0, 2, 1))[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis='sp', causal=False):
+    """q,k,v: GLOBAL [B,T,H,D] arrays; returns [B,T,H,D].  Shards T over
+    `axis` and runs the ring."""
+    spec = P(None, axis, None, None)
+    f = jax.shard_map(
+        functools.partial(ring_attention_inner, axis_name=axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)
+
+
+def reference_attention(q, k, v, causal=False):
+    """Dense reference for testing: [B,T,H,D]."""
+    d = q.shape[-1]
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) / (d ** 0.5)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v)

@@ -1,0 +1,47 @@
+"""Ulysses-style sequence parallelism: all_to_all head/sequence exchange.
+
+NEW capability vs the reference.  Input activations are sequence-sharded
+[B, T/n, H, D]; an all_to_all over the 'sp' axis re-shards to
+head-sharded [B, T, H/n, D], attention runs locally over the FULL
+sequence with a head subset, and a second all_to_all restores sequence
+sharding.  Two collectives per attention vs ring's n ppermutes — better
+when heads >= mesh axis size and T fits per-device memory.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .ring_attention import reference_attention
+
+
+def ulysses_attention_inner(q, k, v, axis_name, causal=False):
+    """Inside shard_map: q,k,v [B, T_loc, H, D] sequence-sharded;
+    H must be divisible by the axis size."""
+
+    def seq_to_heads(x):
+        # [B,T/n,H,D] -> [B,T,H/n,D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qh = seq_to_heads(q)
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    out = reference_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def ulysses_attention(q, k, v, mesh, axis='sp', causal=False):
+    spec = P(None, axis, None, None)
+    f = jax.shard_map(
+        functools.partial(ulysses_attention_inner, axis_name=axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)
