@@ -53,3 +53,5 @@ __all__ = [
     'ParamAttr', 'CompiledProgram', 'BuildStrategy', 'io', 'metrics',
     'dygraph', 'DataFeeder', 'scope_guard', 'global_scope',
 ]
+from . import dataset
+from .dataset import DatasetFactory

@@ -304,3 +304,45 @@ class Executor(object):
 
 def _as_numpy(v):
     return np.asarray(core.as_array(v))
+
+
+def _train_or_infer_from_dataset(executor, program, dataset, scope,
+                                 thread, debug, fetch_list, fetch_info,
+                                 print_period):
+    """Shared body of train/infer_from_dataset.
+
+    Reference: executor.py:1115 train_from_dataset -> TrainerFactory ->
+    MultiTrainer threads (framework/trainer.h:64, hogwild_worker.cc:163).
+    TPU-native: the native feeder (runtime/datafeed.cc) overlaps parsing
+    with device steps; the jitted segment is the 'device worker'."""
+    program = program or framework.default_main_program()
+    scope = scope or core.global_scope()
+    fetch_list = fetch_list or []
+    fetch_names = [v.name if isinstance(v, framework.Variable) else v
+                   for v in fetch_list]
+    step = 0
+    for feed in dataset.batches():
+        fetches = fetch_names if (fetch_names and print_period and
+                                  step % print_period == 0) else []
+        out = executor.run(program, feed=feed, fetch_list=fetches,
+                           scope=scope)
+        if fetches:
+            info = fetch_info or fetch_names
+            msg = ' '.join('%s=%s' % (k, np.asarray(v).ravel()[:4])
+                           for k, v in zip(info, out))
+            print('[dataset step %d] %s' % (step, msg))
+        step += 1
+    return step
+
+
+def _train_from_dataset(self, program=None, dataset=None, scope=None,
+                        thread=0, debug=False, fetch_list=None,
+                        fetch_info=None, print_period=100):
+    """Reference: executor.py:1115."""
+    return _train_or_infer_from_dataset(
+        self, program, dataset, scope, thread, debug, fetch_list,
+        fetch_info, print_period)
+
+
+Executor.train_from_dataset = _train_from_dataset
+Executor.infer_from_dataset = _train_from_dataset

@@ -1,0 +1,100 @@
+"""Native runtime bindings (ctypes over libptruntime.so).
+
+Reference native components being replaced: framework/data_feed.* (C++
+multithreaded readers), framework/channel.h, operators/reader/
+lod_tensor_blocking_queue.h.  Built with `make -C paddle_tpu/runtime`
+(auto-built on first import if g++ is available).
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, 'libptruntime.so')
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        subprocess.check_call(['make', '-s', '-C', _DIR])
+    lib = ctypes.CDLL(_SO)
+    lib.ptfeed_create.restype = ctypes.c_void_p
+    lib.ptfeed_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    lib.ptfeed_next.restype = ctypes.c_int
+    lib.ptfeed_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_float),
+                                ctypes.POINTER(ctypes.c_int64)]
+    lib.ptfeed_dense_dim.restype = ctypes.c_int
+    lib.ptfeed_dense_dim.argtypes = [ctypes.c_void_p]
+    lib.ptfeed_sparse_dim.restype = ctypes.c_int
+    lib.ptfeed_sparse_dim.argtypes = [ctypes.c_void_p]
+    lib.ptfeed_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class MultiSlotDataFeed(object):
+    """Native multithreaded MultiSlot-format feeder.
+
+    slots: [(name, 'dense'|'sparse', dim)] — dense slots are float
+    vectors of exactly `dim`; sparse slots are id lists padded/truncated
+    to `dim` with -1.
+    """
+
+    def __init__(self, files, slots, batch_size, nthreads=4,
+                 shuffle_buffer=0, seed=0):
+        lib = _load()
+        self._lib = lib
+        self.slots = list(slots)
+        self.batch_size = batch_size
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        spec = ','.join('%s:%s:%d' % s for s in slots).encode()
+        self._h = lib.ptfeed_create(arr, len(files), spec, batch_size,
+                                    nthreads, shuffle_buffer, seed)
+        self._dense_dim = lib.ptfeed_dense_dim(self._h)
+        self._sparse_dim = lib.ptfeed_sparse_dim(self._h)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        dense = np.empty((self.batch_size, max(self._dense_dim, 1)),
+                         np.float32)
+        sparse = np.empty((self.batch_size, max(self._sparse_dim, 1)),
+                          np.int64)
+        n = self._lib.ptfeed_next(
+            self._h,
+            dense.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            sparse.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if n == 0:
+            raise StopIteration
+        out = {}
+        doff = soff = 0
+        for name, kind, dim in self.slots:
+            if kind == 'dense':
+                out[name] = dense[:n, doff:doff + dim]
+                doff += dim
+            else:
+                out[name] = sparse[:n, soff:soff + dim]
+                soff += dim
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.ptfeed_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
