@@ -135,10 +135,62 @@ class Optimizer(object):
         """Reference: optimizer.py:690."""
         if grad_clip is not None:
             self._grad_clip = grad_clip
+        if framework.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list or [])
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Eager update path: build (once) a scratch program containing
+        only the update ops via the SAME _append_optimize_op used by the
+        static path, then run it jitted each step with param/grad values
+        fed in.  Accumulators persist in a private scope.  Reference
+        analog: dygraph reuses _append_optimize_op through the tracer
+        (optimizer.py dygraph branch)."""
+        from .executor import Executor
+        params = [p for p in parameter_list
+                  if getattr(p, 'trainable', True) and p.grad is not None]
+        if not params:
+            return [], []
+        key = tuple(id(p) for p in params)
+        if getattr(self, '_eager_key', None) != key:
+            self._eager_key = key
+            self._eager_scope = core.Scope()
+            self._accumulators = {}
+            self._learning_rate_map = {}
+            main, startup = framework.Program(), framework.Program()
+            with framework.program_guard(main, startup):
+                block = main.global_block()
+                pg = []
+                for p in params:
+                    pv = block.create_parameter(
+                        shape=list(p.shape), dtype=p.dtype, name=p.name)
+                    gv = block.create_var(
+                        name=p.name + '@GRAD', shape=tuple(p.shape),
+                        dtype=p.dtype)
+                    pg.append((pv, gv))
+                self._create_global_learning_rate()
+                self._create_accumulators(block, [x for x, _ in pg])
+                for item in pg:
+                    self._append_optimize_op(block, item)
+                self._finish_update(block, pg)
+            self._eager_main = main
+            self._eager_exe = Executor(core.XLAPlace(0))
+            with core.scope_guard(self._eager_scope):
+                self._eager_exe.run(startup)
+        feed = {}
+        for p in params:
+            feed[p.name] = p.value
+            feed[p.name + '@GRAD'] = p.grad
+        with core.scope_guard(self._eager_scope):
+            self._eager_exe.run(self._eager_main, feed=feed,
+                                fetch_list=[])
+            for p in params:
+                p.value = core.as_array(
+                    self._eager_scope.find_var(p.name))
+        return [], []
 
 
 class SGDOptimizer(Optimizer):
