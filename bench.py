@@ -15,14 +15,16 @@ import time
 import numpy as np
 
 
-def bench_resnet50(batch=128, steps=30, warmup=5, amp=True):
+def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
+                   data_format='NHWC'):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 42
     with fluid.program_guard(main, startup):
-        feeds, logits, loss, acc = models.resnet.build()
+        feeds, logits, loss, acc = models.resnet.build(
+            data_format=data_format)
         opt = fluid.optimizer.Momentum(0.1, momentum=0.9)
         if amp:
             opt = fluid.contrib.mixed_precision.decorate(
@@ -31,9 +33,11 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True):
 
     rng = np.random.RandomState(0)
     import jax
+    shape = (batch, 224, 224, 3) if data_format == 'NHWC' else \
+        (batch, 3, 224, 224)
     # synthetic batch resident on device: measure compute, not the
     # host->device pipe (the input pipeline is benched separately)
-    x = jax.device_put(rng.rand(batch, 3, 224, 224).astype('float32'))
+    x = jax.device_put(rng.rand(*shape).astype('float32'))
     y = jax.device_put(rng.randint(0, 1000, (batch, 1)).astype('int32'))
 
     scope = fluid.Scope()

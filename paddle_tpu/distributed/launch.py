@@ -1,0 +1,95 @@
+"""Multi-host launcher: python -m paddle_tpu.distributed.launch train.py
+
+Reference: python/paddle/distributed/launch.py:147,298 — spawns one
+trainer process PER GPU with PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS
+env.
+
+TPU-native re-design: jax is a single-controller SPMD runtime — ONE
+process per HOST drives all local chips, and multi-host jobs
+rendezvous through jax.distributed.initialize (coordinator address +
+process id/count), replacing the reference's gen_nccl_id broadcast.
+The launcher keeps the PaddleCloud env-var contract so fleet role
+makers work unchanged.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _parse_args():
+    p = argparse.ArgumentParser(
+        description='paddle_tpu distributed launcher')
+    p.add_argument('--cluster_node_ips', type=str, default='127.0.0.1')
+    p.add_argument('--node_ip', type=str, default='127.0.0.1')
+    p.add_argument('--started_port', type=int, default=6170)
+    p.add_argument('--selected_gpus', type=str, default=None,
+                   help='accepted for compatibility; chips are managed '
+                        'by the jax runtime')
+    p.add_argument('--nproc_per_node', type=int, default=1,
+                   help='processes per host (1 for TPU SPMD)')
+    p.add_argument('--log_dir', type=str, default=None)
+    p.add_argument('training_script', type=str)
+    p.add_argument('training_script_args', nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch():
+    args = _parse_args()
+    ips = args.cluster_node_ips.split(',')
+    nnodes = len(ips)
+    node_id = ips.index(args.node_ip) if args.node_ip in ips else 0
+    coordinator = '%s:%d' % (ips[0], args.started_port)
+
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        rank = node_id * args.nproc_per_node + local_rank
+        world = nnodes * args.nproc_per_node
+        env = dict(os.environ)
+        env.update({
+            'PADDLE_TRAINER_ID': str(rank),
+            'PADDLE_TRAINERS_NUM': str(world),
+            'PADDLE_CURRENT_ENDPOINT': '%s:%d' % (
+                args.node_ip, args.started_port + local_rank),
+            'PADDLE_TRAINER_ENDPOINTS': ','.join(
+                '%s:%d' % (ip, args.started_port + r)
+                for ip in ips for r in range(args.nproc_per_node)),
+            # jax.distributed rendezvous
+            'JAX_COORDINATOR_ADDRESS': coordinator,
+            'JAX_PROCESS_ID': str(rank),
+            'JAX_NUM_PROCESSES': str(world),
+        })
+        cmd = [sys.executable, '-u', args.training_script] + \
+            args.training_script_args
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            logf = open(os.path.join(args.log_dir,
+                                     'worker.%d.log' % rank), 'w')
+            procs.append((subprocess.Popen(cmd, env=env, stdout=logf,
+                                           stderr=subprocess.STDOUT),
+                          logf))
+        else:
+            procs.append((subprocess.Popen(cmd, env=env), None))
+
+    rc = 0
+    for p, logf in procs:
+        rc |= p.wait()
+        if logf:
+            logf.close()
+    sys.exit(rc)
+
+
+def init_distributed():
+    """Call early in the training script on multi-host jobs."""
+    import jax
+    addr = os.environ.get('JAX_COORDINATOR_ADDRESS')
+    if addr and os.environ.get('JAX_NUM_PROCESSES', '1') != '1':
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ['JAX_NUM_PROCESSES']),
+            process_id=int(os.environ['JAX_PROCESS_ID']))
+
+
+if __name__ == '__main__':
+    launch()

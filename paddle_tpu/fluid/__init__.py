@@ -55,3 +55,7 @@ __all__ = [
 ]
 from . import dataset
 from .dataset import DatasetFactory
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from . import flags
+from .flags import get_flags, set_flags

@@ -256,6 +256,12 @@ class Executor(object):
                   return_numpy):
         device = self.place.jax_device()
         fetched = {}
+        has_host = any(not isinstance(it, _Segment) for it in plan)
+        if has_host:
+            # host ops read vars through the scope; make feeds visible
+            for k, v in feed.items():
+                scope.set_var(k, v.data if isinstance(v, core.LoDTensor)
+                              else v)
         for item in plan:
             if isinstance(item, _Segment):
                 self._run_segment(item, feed, scope, device, fetched)
@@ -297,6 +303,18 @@ class Executor(object):
                 for n in seg.input_names}
         with jax.default_device(device):
             out = seg.compiled(self._step, state, data)
+        from .flags import get_flag
+        if get_flag('FLAGS_check_nan_inf'):
+            # reference: CheckVarHasNanOrInf per-op sweep
+            # (framework/details/nan_inf_utils.h:28) — here per segment
+            # output, which is where values become observable
+            for n, v in out.items():
+                arr = np.asarray(core.as_array(v))
+                if np.issubdtype(arr.dtype, np.floating) and \
+                        not np.isfinite(arr).all():
+                    raise FloatingPointError(
+                        'nan/inf detected in var %s (step %d)'
+                        % (n, self._step))
         for n, v in out.items():
             scope.set_var(n, v)
             fetched[n] = v

@@ -1,0 +1,63 @@
+"""Global flags registry.
+
+Reference: ~60 gflags DEFINEs (platform/flags.cc + per-module), read from
+FLAGS_* env vars at import (python/paddle/fluid/__init__.py:163-228) with
+runtime get/set via pybind/global_value_getter_setter.cc.
+
+Here: one registry, initialized from FLAGS_* env vars, with the
+paddle 2.x-style get_flags/set_flags surface.
+"""
+
+import os
+
+_DEFAULTS = {
+    'FLAGS_check_nan_inf': False,
+    'FLAGS_benchmark': False,
+    'FLAGS_eager_delete_tensor_gb': 0.0,   # subsumed by XLA liveness
+    'FLAGS_fraction_of_gpu_memory_to_use': 0.92,  # accepted, unused
+    'FLAGS_cudnn_deterministic': False,
+    'FLAGS_cpu_deterministic': False,
+    'FLAGS_paddle_num_threads': 1,
+    'FLAGS_use_pinned_memory': True,
+    'FLAGS_print_op_timing': False,
+    'FLAGS_sync_nccl_allreduce': False,    # XLA dataflow orders comms
+    'FLAGS_communicator_fake_rpc': False,
+    'FLAGS_rpc_deadline': 180000,
+    'FLAGS_rpc_retry_times': 3,
+}
+
+_flags = {}
+
+
+def _coerce(default, raw):
+    if isinstance(default, bool):
+        return raw.lower() in ('1', 'true', 'yes', 'on')
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def _init():
+    for k, v in _DEFAULTS.items():
+        raw = os.environ.get(k)
+        _flags[k] = _coerce(v, raw) if raw is not None else v
+
+
+_init()
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags.get(k) for k in keys}
+
+
+def set_flags(d):
+    for k, v in d.items():
+        _flags[k] = v
+
+
+def get_flag(key, default=None):
+    return _flags.get(key, default)

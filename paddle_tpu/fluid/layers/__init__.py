@@ -8,6 +8,7 @@ from . import math_op_patch  # noqa: F401
 from . import control_flow
 from . import learning_rate_scheduler
 from . import sequence_lod
+from . import rnn
 
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
@@ -17,6 +18,8 @@ from .control_flow import While, increment, Switch  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
+from .rnn import (  # noqa: F401
+    dynamic_lstm, dynamic_gru, lstm_unit)
 from .sequence_lod import (  # noqa: F401
     sequence_pool, sequence_softmax, sequence_expand, sequence_reshape,
     sequence_first_step, sequence_last_step, sequence_conv)

@@ -1,0 +1,88 @@
+"""RNN layers: dynamic_lstm / dynamic_gru on padded+mask batches.
+
+Reference: python/paddle/fluid/layers/rnn.py + layers/nn.py dynamic_lstm
+(over operators/lstm_op with LoD).  TPU-native: [B,T,D] + mask, scan
+inside one jitted segment.
+"""
+
+from ..layer_helper import LayerHelper
+
+
+def dynamic_lstm(input, size, mask=None, h_0=None, c_0=None,
+                 param_attr=None, bias_attr=None, use_peepholes=False,
+                 is_reverse=False, gate_activation='sigmoid',
+                 cell_activation='tanh', candidate_activation='tanh',
+                 dtype='float32', name=None):
+    """input: [B, T, 4*H] pre-projected (as in the reference, where the
+    x->4H projection is a preceding fc).  size = 4*H."""
+    helper = LayerHelper('lstm', name=name)
+    hidden_size = size // 4
+    w = helper.create_parameter(param_attr,
+                                shape=[hidden_size, 4 * hidden_size],
+                                dtype=dtype)
+    bias = helper.create_parameter(bias_attr, shape=[4 * hidden_size],
+                                   dtype=dtype, is_bias=True)
+    from . import nn as _nn
+    x = _nn.elementwise_add(input, bias, axis=2)
+    inputs = {'Input': x, 'Weight': w}
+    if mask is not None:
+        inputs['Mask'] = mask
+    if h_0 is not None:
+        inputs['H0'] = h_0
+    if c_0 is not None:
+        inputs['C0'] = c_0
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op('lstm', inputs=inputs,
+                     outputs={'Hidden': hidden, 'Cell': cell,
+                              'LastH': last_h, 'LastC': last_c},
+                     attrs={'is_reverse': is_reverse})
+    return hidden, cell
+
+
+def dynamic_gru(input, size, mask=None, h_0=None, param_attr=None,
+                bias_attr=None, is_reverse=False, dtype='float32',
+                name=None):
+    """input: [B, T, 3*H] pre-projected; size = H."""
+    helper = LayerHelper('gru', name=name)
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                dtype=dtype)
+    bias = helper.create_parameter(bias_attr, shape=[3 * size],
+                                   dtype=dtype, is_bias=True)
+    from . import nn as _nn
+    x = _nn.elementwise_add(input, bias, axis=2)
+    inputs = {'Input': x, 'Weight': w}
+    if mask is not None:
+        inputs['Mask'] = mask
+    if h_0 is not None:
+        inputs['H0'] = h_0
+    hidden = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    helper.append_op('gru', inputs=inputs,
+                     outputs={'Hidden': hidden, 'LastH': last_h},
+                     attrs={'is_reverse': is_reverse})
+    return hidden
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference layers/nn.py lstm_unit) — composed
+    from elementwise ops for StaticRNN-style loops."""
+    from . import nn as _nn
+    from . import ops as _ops
+    from . import tensor as _tensor
+    concat = _tensor.concat([x_t, hidden_t_prev], axis=1)
+    hidden_size = hidden_t_prev.shape[1]
+    gates = _nn.fc(concat, size=4 * hidden_size, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    i, f, g, o = _nn.split(gates, 4, dim=1)
+    i = _ops.sigmoid(i)
+    f = _ops.sigmoid(_ops.scale(f, bias=forget_bias))
+    g = _ops.tanh(g)
+    o = _ops.sigmoid(o)
+    c = _nn.elementwise_add(_nn.elementwise_mul(f, cell_t_prev),
+                            _nn.elementwise_mul(i, g))
+    h = _nn.elementwise_mul(o, _ops.tanh(c))
+    return h, c

@@ -612,3 +612,54 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Dpsgd = DpsgdOptimizer
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Momentum + Deep Gradient Compression.
+
+    Reference: optimizer.py:952 (DGCMomentumOptimizer) +
+    operators/dgc_op.h + details/sparse_all_reduce_op_handle.h.  Before
+    rampup_begin_step behaves as plain momentum; after, gradients pass
+    through the dgc op (top-k + error feedback) before the update /
+    collective all-reduce.
+    """
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 **kwargs):
+        super(DGCMomentumOptimizer, self).__init__(
+            learning_rate, momentum, use_nesterov, **kwargs)
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity[-1] if isinstance(
+            sparsity, (list, tuple)) else sparsity
+
+    def _create_accumulators(self, block, parameters):
+        super(DGCMomentumOptimizer, self)._create_accumulators(
+            block, parameters)
+        for p in parameters:
+            self._add_accumulator('dgc_u', p)
+            self._add_accumulator('dgc_v', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        u = self._get_accumulator('dgc_u', p)
+        v = self._get_accumulator('dgc_v', p)
+        encoded = block.create_var(
+            name=unique_name.generate(g.name + '_dgc'),
+            shape=tuple(p.shape), dtype=p.dtype)
+        encoded.stop_gradient = True
+        block.append_op('dgc',
+                        inputs={'Grad': g, 'U': u, 'V': v},
+                        outputs={'EncodeGrad': encoded, 'UOut': u,
+                                 'VOut': v, 'GradOut': encoded},
+                        attrs={'m': self._momentum,
+                               'sparsity_ratio': self._sparsity},
+                        infer_shape=False)
+        # momentum is already folded into the dgc accumulators (u), so
+        # the parameter update is plain sgd on the encoded grad
+        # (reference dgc_momentum op's DGC branch)
+        return block.append_op(
+            'sgd',
+            inputs={'Param': p, 'Grad': encoded,
+                    'LearningRate': self._create_param_lr((p, encoded))},
+            outputs={'ParamOut': p}, infer_shape=False)

@@ -1,0 +1,85 @@
+"""Distributed transpilers (program rewriting).
+
+Reference: python/paddle/fluid/transpiler/ — DistributeTranspiler
+(distribute_transpiler.py:536) rewrites programs for PS or NCCL2 modes;
+collective.py (GradAllReduce:178, LocalSGD:270) inserts collective ops.
+
+TPU-native: NCCL2/collective mode maps to the shard_map collective
+runtime (the rewrite inserts c_allreduce ops exactly like the
+reference); PS mode's sparse tables map to the sharded-embedding design
+(parallel/sparse_embedding planned) — classic CPU parameter-server
+program splitting is intentionally not reproduced on TPU.
+"""
+
+from .collective import GradAllReduce, LocalSGD
+from .memory_optimize import memory_optimize, release_memory
+
+
+class DistributeTranspilerConfig(object):
+    """Reference: distribute_transpiler.py:141."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = True
+        self.mode = 'nccl2'
+        self.collective_mode = 'grad_allreduce'
+        self.nccl_comm_num = 1
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.use_hierarchical_allreduce = False
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+
+
+class DistributeTranspiler(object):
+    """Reference: distribute_transpiler.py:536."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_id = 0
+        self._trainers = 1
+
+    def transpile(self, trainer_id, program=None, pservers='127.0.0.1:0',
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint='127.0.0.1:0'):
+        from .. import framework
+        program = program or framework.default_main_program()
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        mode = self.config.mode
+        if mode in ('nccl2', 'collective'):
+            # collective rewrite happens in the fleet optimizer (the
+            # grads exist only after minimize); transpile() marks the
+            # program so the executor uses the shard_map runtime
+            program._collective_dp = True
+            self.trainer_program = program
+            return
+        raise NotImplementedError(
+            "DistributeTranspiler mode='%s': the CPU parameter-server "
+            "path is replaced on TPU by sharded embeddings + collective "
+            "dense sync; use fleet.distributed_optimizer "
+            "(incubate.fleet.collective) or mode='nccl2'" % mode)
+
+    def get_trainer_program(self, wait_port=True):
+        return self.trainer_program
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError(
+            'no parameter servers on TPU; see transpile() notes')
+
+    def get_pserver_programs(self, endpoint):
+        raise NotImplementedError(
+            'no parameter servers on TPU; see transpile() notes')
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        raise NotImplementedError(
+            'no parameter servers on TPU; see transpile() notes')
+
+
+class HashName(object):
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = pserver_endpoints
+
+
+RoundRobin = HashName

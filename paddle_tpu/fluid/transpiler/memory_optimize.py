@@ -1,0 +1,17 @@
+"""Legacy memory_optimize API.
+
+Reference: python/paddle/fluid/transpiler/memory_optimization_transpiler
+(var reuse analysis).  On TPU, XLA buffer assignment + donation already
+performs this optimization, so these are documented no-ops — matching
+the reference's own deprecation of the API in favor of build-strategy
+passes.
+"""
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None

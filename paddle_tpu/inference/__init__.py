@@ -1,0 +1,15 @@
+"""Inference stack: AnalysisPredictor-style serving path.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:47
+(AnalysisPredictor over NaiveExecutor with ZeroCopyTensor IO) +
+paddle_infer C/C++ API.
+
+TPU-native: the saved inference model (program json + params npz, see
+fluid/io.py save_inference_model) loads into a test-mode Program; the
+predictor jits the whole forward once per input shape and keeps params
+device-resident between calls — the XLA analog of the reference's
+analysis passes + param sync-to-device pass.
+"""
+
+from .predictor import (AnalysisConfig, AnalysisPredictor,
+                        create_paddle_predictor, PaddleTensor)

@@ -1,0 +1,87 @@
+"""AnalysisPredictor over the segment executor."""
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid import io as fluid_io
+from ..fluid.executor import Executor
+
+
+class AnalysisConfig(object):
+    """Reference: inference/api/paddle_analysis_config.h."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self.model_dir = model_dir
+        self.model_filename = None
+        self.params_filename = params_file
+        self._use_xla = True
+        self._switch_ir_optim = True
+
+    def set_model(self, model_dir, params_file=None):
+        self.model_dir = model_dir
+        self.params_filename = params_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass  # accelerator is the default on TPU
+
+    def disable_gpu(self):
+        self._use_xla = False
+
+    def switch_ir_optim(self, x=True):
+        self._switch_ir_optim = x
+
+    def enable_memory_optim(self):
+        pass
+
+
+class PaddleTensor(object):
+    def __init__(self, data=None, name=None):
+        self.data = np.asarray(data) if data is not None else None
+        self.name = name
+        self.shape = tuple(self.data.shape) if data is not None else ()
+
+    def as_ndarray(self):
+        return self.data
+
+
+class AnalysisPredictor(object):
+    """Reference: inference/api/analysis_predictor.h:47."""
+
+    def __init__(self, config):
+        self.config = config
+        self._scope = core.Scope()
+        place = core.XLAPlace(0) if config._use_xla else core.CPUPlace()
+        self._exe = Executor(place)
+        with core.scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_vars = \
+                fluid_io.load_inference_model(
+                    config.model_dir, self._exe,
+                    model_filename=config.model_filename,
+                    params_filename=config.params_filename)
+
+    # -- zero-copy style API ---------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self._fetch_vars]
+
+    def run_dict(self, feed):
+        with core.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars)
+        return outs
+
+    def run(self, inputs):
+        """inputs: [PaddleTensor] or [ndarray] in feed order."""
+        feed = {}
+        for name, t in zip(self._feed_names, inputs):
+            feed[name] = t.data if isinstance(t, PaddleTensor) else \
+                np.asarray(t)
+        outs = self.run_dict(feed)
+        return [PaddleTensor(o, name=v.name)
+                for o, v in zip(outs, self._fetch_vars)]
+
+
+def create_paddle_predictor(config):
+    return AnalysisPredictor(config)

@@ -19,3 +19,4 @@ from . import collective_ops  # noqa: F401
 from .registry import register, register_host, get, is_registered  # noqa
 from . import sequence_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401

@@ -44,10 +44,10 @@ def conv2d(ctx, ins, attrs):
     if data_format in ('NCHW', 'AnyLayout'):
         dn = ('NCHW', 'OIHW', 'NCHW')
     else:
+        # program weights are always OIHW (layer contract); present them
+        # to XLA as HWIO for the NHWC path
         dn = ('NHWC', 'HWIO', 'NHWC')
-        if w.ndim == 4 and w.shape[1] != x.shape[-1] // groups:
-            # weights stored OIHW: convert
-            w = jnp.transpose(w, (2, 3, 1, 0))
+        w = jnp.transpose(w, (2, 3, 1, 0))
     pad = _conv_padding(attrs.get('paddings', [0, 0]),
                         attrs.get('padding_algorithm', 'EXPLICIT'),
                         w.shape[-2:], strides, dilations)

@@ -8,6 +8,7 @@ input-output donation reuses the buffers — the TPU analog of the
 reference's in-place mutation.
 """
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -239,3 +240,29 @@ def proximal_gd(ctx, ins, attrs):
     else:
         p_out = prox / (1.0 + lr * l2)
     return {'ParamOut': [p_out]}
+
+
+@register('dgc')
+def dgc(ctx, ins, attrs):
+    """Deep Gradient Compression sparsification with momentum correction
+    and local error feedback (reference operators/dgc_op.h:39,168).
+    u = m*u + g; v = v + u; keep top-k |v| as the communicated grad,
+    retain the rest locally.  On ICI the bandwidth win is moot, but the
+    semantics (and convergence behavior) are preserved for parity."""
+    g = ins['Grad'][0]
+    u = ins['U'][0]
+    v = ins['V'][0]
+    m = attrs.get('m', 0.9)
+    ratio = attrs.get('sparsity_ratio', 0.999)
+    n = int(np.prod(g.shape))
+    k = max(1, int(n * (1.0 - ratio)))
+    u_new = m * u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new.reshape(-1))
+    thr = jax.lax.top_k(flat, k)[0][-1]
+    mask = (jnp.abs(v_new) >= thr).astype(g.dtype)
+    encoded = v_new * mask
+    return {'EncodeGrad': [encoded],
+            'UOut': [u_new * (1 - mask)],
+            'VOut': [v_new * (1 - mask)],
+            'GradOut': [encoded]}

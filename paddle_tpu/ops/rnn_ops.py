@@ -1,0 +1,111 @@
+"""Recurrent op lowerings: LSTM / GRU over lax.scan.
+
+Reference kernels: operators/lstm_op.*, gru_op.*, cudnn_lstm_op.cu
+(cuDNN), operators/math/sequence2batch.h (LoD batch reordering), and the
+fused operators/fused/fusion_lstm_op.cc.
+
+TPU-native re-design: sequences are padded [B, T, D] + mask; the time
+loop is lax.scan (compiled once, unrolled by XLA onto the MXU as a
+batched matmul per step); the LoD batch-reorder machinery disappears.
+Gate order follows the reference: input, forget, cell(candidate), output
+for LSTM; update/reset/candidate for GRU.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _mask_step(mask, t, new, old):
+    """Keep old state where the sequence has ended."""
+    if mask is None:
+        return new
+    m = mask[:, t][:, None]
+    return m * new + (1.0 - m) * old
+
+
+@register('lstm', no_grad_out_slots=('LastH', 'LastC'))
+def lstm(ctx, ins, attrs):
+    """Input [B,T,4H] (pre-projected x@W + b), Weight [H,4H] (hidden),
+    optional H0/C0 [B,H], optional Mask [B,T].
+    Outputs Hidden [B,T,H], Cell [B,T,H], LastH, LastC."""
+    x = ins['Input'][0]
+    w = ins['Weight'][0]
+    b, t, h4 = x.shape
+    h = h4 // 4
+    mask = ins['Mask'][0] if ins.get('Mask') else None
+    h0 = ins['H0'][0] if ins.get('H0') else jnp.zeros((b, h), x.dtype)
+    c0 = ins['C0'][0] if ins.get('C0') else jnp.zeros((b, h), x.dtype)
+    is_reverse = attrs.get('is_reverse', False)
+
+    xs = jnp.flip(x, 1) if is_reverse else x
+    ms = jnp.flip(mask, 1) if (mask is not None and is_reverse) else mask
+
+    def step(carry, xt):
+        hp, cp, t_idx = carry
+        gates = xt + hp @ w
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * cp + i * g
+        hh = o * jnp.tanh(c)
+        if ms is not None:
+            m = jax.lax.dynamic_index_in_dim(ms, t_idx, 1,
+                                             keepdims=False)[:, None]
+            m = m.astype(hh.dtype)
+            hh = m * hh + (1 - m) * hp
+            c = m * c + (1 - m) * cp
+        return (hh, c, t_idx + 1), (hh, c)
+
+    (last_h, last_c, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0, 0), jnp.swapaxes(xs, 0, 1))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, 1)
+        cs = jnp.flip(cs, 1)
+    return {'Hidden': [hs], 'Cell': [cs], 'LastH': [last_h],
+            'LastC': [last_c]}
+
+
+@register('gru', no_grad_out_slots=('LastH',))
+def gru(ctx, ins, attrs):
+    """Input [B,T,3H] (pre-projected), Weight [H,3H], optional H0, Mask.
+    Gate order: update(z), reset(r), candidate — reference
+    operators/gru_op.h."""
+    x = ins['Input'][0]
+    w = ins['Weight'][0]
+    b, t, h3 = x.shape
+    h = h3 // 3
+    mask = ins['Mask'][0] if ins.get('Mask') else None
+    h0 = ins['H0'][0] if ins.get('H0') else jnp.zeros((b, h), x.dtype)
+    is_reverse = attrs.get('is_reverse', False)
+    w_zr = w[:, :2 * h]
+    w_c = w[:, 2 * h:]
+
+    xs = jnp.flip(x, 1) if is_reverse else x
+    ms = jnp.flip(mask, 1) if (mask is not None and is_reverse) else mask
+
+    def step(carry, xt):
+        hp, t_idx = carry
+        x_zr, x_c = xt[:, :2 * h], xt[:, 2 * h:]
+        zr = jax.nn.sigmoid(x_zr + hp @ w_zr)
+        z, r = jnp.split(zr, 2, axis=-1)
+        c = jnp.tanh(x_c + (r * hp) @ w_c)
+        hh = (1 - z) * hp + z * c
+        if ms is not None:
+            m = jax.lax.dynamic_index_in_dim(ms, t_idx, 1,
+                                             keepdims=False)[:, None]
+            m = m.astype(hh.dtype)
+            hh = m * hh + (1 - m) * hp
+        return (hh, t_idx + 1), hh
+
+    (last_h, _), hs = jax.lax.scan(step, (h0, 0),
+                                   jnp.swapaxes(xs, 0, 1))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, 1)
+    return {'Hidden': [hs], 'LastH': [last_h]}

@@ -1,0 +1,122 @@
+"""Sparse / beyond-HBM embedding tables — the parameter-server analog.
+
+Reference: the large-scale sparse path — FleetWrapper::PullSparse/
+PushSparse against PSLib (framework/fleet/fleet_wrapper.h:77-145),
+SelectedRows sparse grads (framework/selected_rows.h), distributed
+lookup-table prefetch (operators/distributed/parameter_prefetch.h).
+
+TPU-native re-design, two tiers:
+1. device-sharded: table rows sharded over a mesh axis via GSPMD
+   (use CompiledProgram.with_param_shardings with P('mp', None) on the
+   table) — for vocabularies that fit aggregate HBM.
+2. HostShardedEmbedding (this module): the table lives in host RAM;
+   each step a host op gathers the touched rows ("pull sparse"), the
+   device computes with a dense [B,S,dim] activation, and after backward
+   a host op applies the row-sparse update ("push sparse") with a
+   per-row adagrad/sgd.  Duplicate ids accumulate via np.add.at, the
+   SelectedRows merge-add semantics (operators/math/
+   selected_rows_functor.cc).
+"""
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid import framework
+from ..fluid import unique_name
+from ..ops import registry
+
+
+class HostShardedEmbedding(object):
+    _REGISTRY = {}
+
+    def __init__(self, name, vocab_size, dim, optimizer='adagrad',
+                 learning_rate=0.05, initializer_scale=0.01, seed=0,
+                 dtype='float32'):
+        self.name = name or unique_name.generate('host_embedding')
+        rng = np.random.RandomState(seed)
+        self.table = (rng.randn(vocab_size, dim) *
+                      initializer_scale).astype(dtype)
+        self.acc = np.zeros((vocab_size, 1), dtype) \
+            if optimizer == 'adagrad' else None
+        self.optimizer = optimizer
+        self.lr = learning_rate
+        self.dim = dim
+        HostShardedEmbedding._REGISTRY[self.name] = self
+
+    # -- program-building API --------------------------------------------
+    def lookup(self, ids):
+        """Append a host pull-sparse op; returns rows var [B, S, dim]
+        that participates in autodiff like any activation."""
+        block = ids.block.program.current_block()
+        rows = block.create_var(
+            name=unique_name.generate(self.name + '_rows'),
+            shape=tuple(list(ids.shape) + [self.dim]),
+            dtype=str(self.table.dtype))
+        rows.stop_gradient = False
+        block.append_op('host_emb_lookup',
+                        inputs={'Ids': ids}, outputs={'Out': rows},
+                        attrs={'table': self.name})
+        self._ids_name = ids.name
+        self._rows_var = rows
+        return rows
+
+    def apply_gradients(self, program=None):
+        """Append the host push-sparse op (call AFTER
+        optimizer.minimize so the rows grad exists)."""
+        program = program or framework.default_main_program()
+        gname = program._grad_name_map.get(self._rows_var.name)
+        if gname is None:
+            raise RuntimeError('no gradient reached embedding %s'
+                               % self.name)
+        block = program.current_block()
+        block.append_op('host_emb_update',
+                        inputs={'Ids': self._ids_name, 'Grad': gname},
+                        outputs={}, attrs={'table': self.name})
+
+    # -- host kernels -----------------------------------------------------
+    def _pull(self, ids):
+        return self.table[ids]
+
+    def _push(self, ids, grad):
+        flat_ids = ids.reshape(-1)
+        flat_g = grad.reshape(-1, self.dim)
+        if self.optimizer == 'adagrad':
+            sq = np.zeros((self.table.shape[0], 1), self.table.dtype)
+            np.add.at(sq, flat_ids,
+                      (flat_g ** 2).mean(-1, keepdims=True))
+            self.acc += sq
+            scale = self.lr / (np.sqrt(self.acc[flat_ids]) + 1e-6)
+            upd = np.zeros_like(self.table)
+            np.add.at(upd, flat_ids, scale * flat_g)
+            self.table -= upd
+        else:  # sgd
+            upd = np.zeros_like(self.table)
+            np.add.at(upd, flat_ids, flat_g)
+            self.table -= self.lr * upd
+
+    def state_dict(self):
+        out = {self.name + '.table': self.table}
+        if self.acc is not None:
+            out[self.name + '.acc'] = self.acc
+        return out
+
+    def load_state_dict(self, d):
+        self.table = d[self.name + '.table']
+        if self.name + '.acc' in d:
+            self.acc = d[self.name + '.acc']
+
+
+@registry.register_host('host_emb_lookup')
+def host_emb_lookup(executor, scope, op):
+    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    ids = np.asarray(core.as_array(scope.find_var(op.input('Ids')[0])))
+    scope.set_var(op.output('Out')[0], table._pull(ids))
+
+
+@registry.register_host('host_emb_update')
+def host_emb_update(executor, scope, op):
+    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    ids = np.asarray(core.as_array(scope.find_var(op.input('Ids')[0])))
+    grad = np.asarray(core.as_array(
+        scope.find_var(op.input('Grad')[0])))
+    table._push(ids, grad)

@@ -1,0 +1,45 @@
+"""DGC momentum: sparsified comm grads, convergence preserved."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import registry
+
+
+def test_dgc_op_topk_and_error_feedback():
+    g = np.array([[0.1, -2.0], [0.5, 0.05]], 'float32')
+    u = np.zeros((2, 2), 'float32')
+    v = np.zeros((2, 2), 'float32')
+    out = registry.get('dgc').fn(
+        registry.LowerCtx(0), {'Grad': [g], 'U': [u], 'V': [v]},
+        {'m': 0.9, 'sparsity_ratio': 0.75})  # keep top-1
+    enc = np.asarray(out['EncodeGrad'][0])
+    assert (enc != 0).sum() == 1
+    assert enc[0, 1] == -2.0
+    vout = np.asarray(out['VOut'][0])
+    assert vout[0, 1] == 0.0            # communicated -> cleared
+    assert vout[1, 0] == 0.5            # retained locally
+
+
+def test_dgc_momentum_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.data('y', shape=[2], dtype='float32')
+        pred = fluid.layers.fc(x, 2, bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.DGCMomentumOptimizer(
+            0.05, momentum=0.9, sparsity=(0.75,))
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 2).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        final = None
+        for _ in range(300):
+            xs = rng.randn(16, 4).astype('float32')
+            final, = exe.run(main, feed={'x': xs, 'y': xs @ W},
+                             fetch_list=[loss])
+    assert float(final) < 0.1, float(final)

@@ -1,0 +1,119 @@
+"""Inference predictor, transpiler shims, nan/inf flag, launch CLI."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _train_and_save(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(x, 8, act='relu')
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype('float32')
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(30):
+            xs = rng.randn(16, 4).astype('float32')
+            exe.run(main, feed={'x': xs, 'y': xs @ W},
+                    fetch_list=[loss])
+        fluid.io.save_inference_model(str(tmp_path), ['x'], [pred], exe,
+                                      main_program=main)
+        xs = rng.randn(5, 4).astype('float32')
+        expect, = exe.run(main, feed={'x': xs, 'y': xs @ W},
+                          fetch_list=[pred])
+    return xs, expect
+
+
+def test_predictor_roundtrip(tmp_path):
+    xs, expect = _train_and_save(tmp_path)
+    from paddle_tpu.inference import AnalysisConfig, \
+        create_paddle_predictor, PaddleTensor
+    cfg = AnalysisConfig(str(tmp_path))
+    predictor = create_paddle_predictor(cfg)
+    assert predictor.get_input_names() == ['x']
+    outs = predictor.run([PaddleTensor(xs)])
+    np.testing.assert_allclose(outs[0].as_ndarray(), expect, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_check_nan_inf_flag():
+    fluid.set_flags({'FLAGS_check_nan_inf': True})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[2], dtype='float32')
+            y = fluid.layers.log(x)  # log of negatives -> nan
+            out = fluid.layers.reduce_sum(y)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            with pytest.raises(FloatingPointError):
+                exe.run(main,
+                        feed={'x': -np.ones((3, 2), 'float32')},
+                        fetch_list=[out])
+    finally:
+        fluid.set_flags({'FLAGS_check_nan_inf': False})
+
+
+def test_transpiler_nccl2_marks_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        fluid.layers.fc(x, 2)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = 'nccl2'
+    t = fluid.DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=0, program=main, trainers=2)
+    assert getattr(main, '_collective_dp', False)
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program('127.0.0.1:6174')
+
+
+def test_grad_allreduce_transpiler_rewrite():
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    n_before = len(main.global_block().ops)
+    GradAllReduce().transpile(startup, main, rank=0,
+                              endpoints=['a', 'b'],
+                              current_endpoint='a')
+    ops = [op.type for op in main.global_block().ops]
+    assert ops.count('c_allreduce_sum') == 2  # w and b grads
+    assert len(ops) == n_before + 4
+    # rewritten program still runs (under shard_map mode)
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        l, = exe.run(main, feed={'x': rng.randn(16, 4).astype('float32'),
+                                 'y': rng.randn(16, 1).astype('float32')},
+                     fetch_list=[loss])
+        assert np.isfinite(l).all()
+
+
+def test_launch_cli_single_node(tmp_path):
+    import subprocess, sys, os
+    script = tmp_path / 'train.py'
+    script.write_text(
+        'import os\n'
+        'print("RANK", os.environ["PADDLE_TRAINER_ID"],\n'
+        '      os.environ["PADDLE_TRAINERS_NUM"])\n')
+    out = subprocess.run(
+        [sys.executable, '-m', 'paddle_tpu.distributed.launch',
+         str(script)],
+        capture_output=True, text=True, cwd='/root/repo',
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert 'RANK 0 1' in out.stdout, out.stdout + out.stderr

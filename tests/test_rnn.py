@@ -1,0 +1,92 @@
+"""LSTM/GRU ops + an IMDB-style sentiment model (book test analog:
+python/paddle/fluid/tests/book/test_understand_sentiment.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_lstm_op_numpy_parity():
+    from paddle_tpu.ops import registry
+    rng = np.random.RandomState(0)
+    B, T, H = 2, 5, 4
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = rng.randn(H, 4 * H).astype('float32') * 0.2
+    out = registry.get('lstm').fn(registry.LowerCtx(0),
+                                  {'Input': [x], 'Weight': [w]}, {})
+    hs = np.asarray(out['Hidden'][0])
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    hp = np.zeros((B, H)); cp = np.zeros((B, H))
+    for t in range(T):
+        gates = x[:, t] + hp @ w
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sigmoid(f) * cp + sigmoid(i) * np.tanh(g)
+        hp = sigmoid(o) * np.tanh(c)
+        cp = c
+        np.testing.assert_allclose(hs[:, t], hp, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_mask_freezes_state():
+    from paddle_tpu.ops import registry
+    rng = np.random.RandomState(1)
+    B, T, H = 2, 4, 3
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = rng.randn(H, 4 * H).astype('float32') * 0.2
+    mask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+    out = registry.get('lstm').fn(
+        registry.LowerCtx(0),
+        {'Input': [x], 'Weight': [w], 'Mask': [mask]}, {})
+    hs = np.asarray(out['Hidden'][0])
+    np.testing.assert_allclose(hs[0, 2], hs[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(hs[0, 3], hs[0, 1], rtol=1e-6)
+    last = np.asarray(out['LastH'][0])
+    np.testing.assert_allclose(last[0], hs[0, 1], rtol=1e-6)
+
+
+def test_sentiment_lstm_trains():
+    vocab, emb_dim, hid = 200, 16, 16
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data('words', shape=[20], dtype='int64')
+        mask = fluid.layers.data('mask', shape=[20], dtype='float32')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(words, size=[vocab, emb_dim])
+        proj = fluid.layers.fc(emb, size=4 * hid, num_flatten_dims=2)
+        hidden, _ = fluid.layers.dynamic_lstm(proj, size=4 * hid,
+                                              mask=mask)
+        pooled = fluid.layers.sequence_pool(hidden, 'max', mask=mask)
+        pred = fluid.layers.fc(pooled, size=2, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    # synthetic: label = whether token 7 appears early
+    def batch(n=32):
+        w = rng.randint(0, vocab, (n, 20)).astype('int64')
+        lens = rng.randint(5, 21, n)
+        m = (np.arange(20)[None] < lens[:, None]).astype('float32')
+        y = (w[:, :5] == 7).any(1).astype('int64')[:, None]
+        w[y[:, 0] == 1, 2] = 7
+        return {'words': w, 'mask': m, 'label': y}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            l, = exe.run(main, feed=batch(), fetch_list=[loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_gru_runs():
+    from paddle_tpu.ops import registry
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 5, 9).astype('float32')
+    w = rng.randn(3, 9).astype('float32') * 0.2
+    out = registry.get('gru').fn(registry.LowerCtx(0),
+                                 {'Input': [x], 'Weight': [w]}, {})
+    assert out['Hidden'][0].shape == (2, 5, 3)

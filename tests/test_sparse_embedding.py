@@ -1,0 +1,57 @@
+"""Host-sharded embedding (parameter-server analog) end-to-end."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel.sparse_embedding import HostShardedEmbedding
+
+
+def test_host_embedding_trains():
+    vocab, dim = 10000, 8
+    emb = HostShardedEmbedding('test_emb', vocab, dim,
+                               optimizer='adagrad', learning_rate=0.1,
+                               seed=3)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data('ids', shape=[5], dtype='int64')
+        label = fluid.layers.data('label', shape=[1], dtype='float32')
+        rows = emb.lookup(ids)                      # host pull-sparse
+        feat = fluid.layers.reshape(rows, [0, 5 * dim])
+        pred = fluid.layers.fc(feat, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        emb.apply_gradients(main)                   # host push-sparse
+
+    rng = np.random.RandomState(0)
+    table0 = emb.table.copy()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        # memorize a small id set -> loss must drop and only touched
+        # rows may change
+        ids_np = rng.randint(0, 200, (16, 5)).astype('int64')
+        y_np = rng.rand(16, 1).astype('float32')
+        for _ in range(40):
+            l, = exe.run(main, feed={'ids': ids_np, 'label': y_np},
+                         fetch_list=[loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    touched = np.unique(ids_np)
+    changed = np.where(np.abs(emb.table - table0).sum(1) > 0)[0]
+    assert set(changed) <= set(touched.tolist())
+    assert len(changed) > 0
+
+
+def test_host_embedding_duplicate_ids_accumulate():
+    emb = HostShardedEmbedding('dup_emb', 10, 2, optimizer='sgd',
+                               learning_rate=1.0)
+    emb.table[:] = 0
+    ids = np.array([[1, 1, 2]])
+    grad = np.ones((1, 3, 2), 'float32')
+    emb._push(ids, grad)
+    np.testing.assert_allclose(emb.table[1], [-2.0, -2.0])
+    np.testing.assert_allclose(emb.table[2], [-1.0, -1.0])
