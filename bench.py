@@ -9,10 +9,23 @@ era (the reference repo itself publishes no numbers; see BASELINE.md).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _enable_compile_cache():
+    import jax
+    cache_dir = os.environ.get('PADDLE_TPU_JAX_CACHE',
+                               '/root/repo/.jax_cache')
+    try:
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          1.0)
+    except Exception:
+        pass
 
 
 def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
@@ -60,9 +73,11 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
 
 
 def main():
+    _enable_compile_cache()
+    layout = os.environ.get('PADDLE_TPU_BENCH_LAYOUT', 'NCHW')
     for batch in (128, 64, 32):
         try:
-            ips = bench_resnet50(batch=batch)
+            ips = bench_resnet50(batch=batch, data_format=layout)
             break
         except Exception as e:
             sys.stderr.write('batch %d failed: %s\n' % (batch, e))

@@ -1,0 +1,12 @@
+"""Dataset loaders. Reference: python/paddle/dataset/ (mnist, imdb,
+uci_housing, flowers...).
+
+This image is zero-egress, so each loader reads a local copy when
+PADDLE_TPU_DATA_HOME points at one and otherwise serves a seeded
+SYNTHETIC stand-in with the same shapes/dtypes/vocabulary so the book
+tests and examples run everywhere.
+"""
+
+from . import mnist
+from . import uci_housing
+from . import imdb

@@ -85,6 +85,15 @@ class CompiledProgram(object):
             (lambda name, shape, _d=dict(rule): _d.get(name)))
         return self
 
+    def with_sharded_optimizer_states(self, axis='dp'):
+        """ZeRO-1-style weight-update sharding (the 'Automatic
+        Cross-Replica Sharding of Weight Update' design): optimizer
+        accumulators are sharded over the data-parallel axis and GSPMD
+        schedules the reduce-scatter / all-gather around the update.
+        Params stay replicated, so fwd/bwd are untouched."""
+        self._shard_opt_states_axis = axis
+        return self
+
     @property
     def program(self):
         return self._program

@@ -1,3 +1,4 @@
 """fluid.contrib. Reference: python/paddle/fluid/contrib/."""
 
 from . import mixed_precision
+from . import slim

@@ -1,0 +1,101 @@
+"""Quantization-aware training program rewrite.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass): insert
+fake_quant(weights, channel-wise abs-max) + fake_quant_dequant
+(activations, moving-average abs-max) in front of quantizable ops.
+"""
+
+from ... import unique_name
+from ...framework import default_startup_program
+
+QUANTIZABLE = ('conv2d', 'depthwise_conv2d', 'mul', 'matmul',
+               'matmul_v2')
+_WEIGHT_SLOTS = {'conv2d': 'Filter', 'depthwise_conv2d': 'Filter',
+                 'mul': 'Y', 'matmul': 'Y', 'matmul_v2': 'Y'}
+_ACT_SLOTS = {'conv2d': 'Input', 'depthwise_conv2d': 'Input',
+              'mul': 'X', 'matmul': 'X', 'matmul_v2': 'X'}
+
+
+class QuantizationTransformPass(object):
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, quantizable_op_type=QUANTIZABLE):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.quantizable = set(quantizable_op_type)
+
+    def apply(self, program, startup_program=None, for_test=False):
+        startup_program = startup_program or default_startup_program()
+        block = program.global_block()
+        param_names = set(p.name for p in block.all_parameters())
+        new_ops = []
+        for op in list(block.ops):
+            if op.type in self.quantizable:
+                self._insert_quant(block, startup_program, op,
+                                   new_ops, param_names, for_test)
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+    def _insert_quant(self, block, startup, op, new_ops, param_names,
+                      for_test):
+        wslot = _WEIGHT_SLOTS[op.type]
+        aslot = _ACT_SLOTS[op.type]
+        # weight: channel-wise abs-max fake quant
+        for i, name in enumerate(op.inputs.get(wslot, [])):
+            if name not in param_names:
+                continue
+            v = block._find_var_recursive(name)
+            qname = unique_name.generate(name + '.quantized')
+            qv = block.create_var(name=qname, shape=v.shape,
+                                  dtype=v.dtype)
+            sname = unique_name.generate(name + '.scale')
+            sv = block.create_var(name=sname, shape=(v.shape[0],),
+                                  dtype='float32')
+            sv.stop_gradient = True
+            from ...framework import Operator
+            qop = Operator(block, 'fake_channel_wise_quantize_abs_max',
+                           inputs={'X': [name]},
+                           outputs={'Out': [qname],
+                                    'OutScale': [sname]},
+                           attrs={'bit_length': self.weight_bits,
+                                  '__op_seed__': 0})
+            new_ops.append(qop)
+            op.inputs[wslot][i] = qname
+        # activation: moving-average abs-max quant-dequant
+        for i, name in enumerate(op.inputs.get(aslot, [])):
+            v = block._find_var_recursive(name)
+            if v is None or v.dtype not in ('float32', 'bfloat16',
+                                            'float16'):
+                continue
+            state_name = unique_name.generate(name + '.quant_scale')
+            block.create_var(name=state_name, shape=(1,),
+                             dtype='float32', persistable=True)
+            sb = startup.global_block()
+            sb.create_var(name=state_name, shape=(1,),
+                          dtype='float32', persistable=True)
+            sb.append_op('fill_constant', outputs={'Out': state_name},
+                         attrs={'shape': [1], 'dtype': 'float32',
+                                'value': 1.0})
+            qname = unique_name.generate(name + '.quantized')
+            block.create_var(name=qname, shape=v.shape, dtype=v.dtype)
+            from ...framework import Operator
+            qop = Operator(
+                block, 'fake_quantize_dequantize_moving_average_abs_max',
+                inputs={'X': [name], 'InScale': [state_name]},
+                outputs={'Out': [qname], 'OutScale': [state_name]},
+                attrs={'bit_length': self.activation_bits,
+                       'moving_rate': self.moving_rate,
+                       'is_test': for_test, '__op_seed__': 0})
+            new_ops.append(qop)
+            op.inputs[aslot][i] = qname
+
+
+def quantize_program(program, startup_program=None, weight_bits=8,
+                     activation_bits=8, for_test=False):
+    """Convenience wrapper: apply QAT rewrite in place."""
+    return QuantizationTransformPass(
+        weight_bits, activation_bits).apply(program, startup_program,
+                                            for_test)

@@ -9,3 +9,5 @@ from .nn import (Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
                  Dropout)
 from .checkpoint import save_dygraph, load_dygraph
 from .parallel import DataParallel, ParallelEnv, prepare_context
+from . import jit
+from .jit import TracedLayer

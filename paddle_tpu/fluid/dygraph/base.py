@@ -132,6 +132,44 @@ class Tracer(object):
         self._tape = []
         self._step = 0
         self._no_grad = False
+        self._capture = None  # (program, block) during TracedLayer.trace
+
+    # -- dygraph -> static capture (reference imperative/jit/
+    # ProgramDescTracer, dygraph/jit.py TracedLayer) -------------------
+    def begin_capture(self, program, input_vars):
+        block = program.global_block()
+        for v in input_vars:
+            block.create_var(name=v.name, shape=(-1,) + v.shape[1:],
+                             dtype=v.dtype, stop_gradient=True,
+                             is_data=True)
+        self._capture = (program, block)
+
+    def end_capture(self):
+        prog = self._capture[0]
+        self._capture = None
+        return prog
+
+    def _capture_op(self, op_type, inputs, outputs, attrs):
+        program, block = self._capture
+        for s, vs in inputs.items():
+            for v in vs:
+                if not block.has_var(v.name):
+                    block.create_parameter(
+                        name=v.name, shape=list(v.shape),
+                        dtype=v.dtype) if v.persistable else \
+                        block.create_var(name=v.name, shape=v.shape,
+                                         dtype=v.dtype)
+        for s, vs in outputs.items():
+            for v in vs:
+                block.create_var(name=v.name, shape=v.shape,
+                                 dtype=v.dtype)
+        block.append_op(
+            op_type,
+            inputs={s: [v.name for v in vs]
+                    for s, vs in inputs.items()},
+            outputs={s: [v.name for v in vs]
+                     for s, vs in outputs.items()},
+            attrs=dict(attrs), infer_shape=False)
 
     def trace_op(self, op_type, inputs, outputs_spec=None, attrs=None):
         """inputs: {slot: [VarBase]}; returns {slot: [VarBase]}."""
@@ -144,6 +182,8 @@ class Tracer(object):
         outs_vals = opdef.fn(ctx, ins_vals, attrs)
         outputs = {s: [VarBase(v) for v in vs]
                    for s, vs in outs_vals.items()}
+        if self._capture is not None:
+            self._capture_op(op_type, inputs, outputs, attrs)
         requires = (not self._no_grad) and any(
             not v.stop_gradient for vs in inputs.values() for v in vs)
         if requires:

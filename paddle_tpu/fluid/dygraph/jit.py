@@ -1,0 +1,72 @@
+"""TracedLayer: dygraph -> static Program capture.
+
+Reference: python/paddle/fluid/dygraph/jit.py (TracedLayer) over
+imperative/jit/ ProgramDescTracer — record the ops a Layer executes
+eagerly into a Program that the static executor / inference predictor
+can run.
+"""
+
+import numpy as np
+
+from .. import core
+from .. import framework
+from .base import VarBase
+
+
+class TracedLayer(object):
+    def __init__(self, program, feed_names, fetch_names, param_values):
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._param_values = param_values
+        self._scope = core.Scope()
+        for name, val in param_values.items():
+            self._scope.set_var(name, val)
+        from ..executor import Executor
+        self._exe = Executor(core.XLAPlace(0))
+
+    @staticmethod
+    def trace(layer, inputs):
+        tracer = framework._dygraph_tracer()
+        if tracer is None:
+            raise RuntimeError('TracedLayer.trace requires dygraph guard')
+        program = framework.Program()
+        tracer.begin_capture(program, inputs)
+        try:
+            outputs = layer(*inputs)
+        finally:
+            tracer.end_capture()
+        outs = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+        params = {p.name: p.value for p in layer.parameters()}
+        # BN running stats etc.: any persistable VarBase touched
+        for sub in [layer] + layer.sublayers():
+            for attr in sub.__dict__.values():
+                if isinstance(attr, VarBase) and attr.persistable:
+                    params.setdefault(attr.name, attr.value)
+        traced = TracedLayer(program, [v.name for v in inputs],
+                             [v.name for v in outs], params)
+        return outputs, traced
+
+    @property
+    def program(self):
+        return self._program
+
+    def __call__(self, inputs):
+        feed = {}
+        for name, v in zip(self._feed_names, inputs):
+            feed[name] = v.value if isinstance(v, VarBase) else \
+                np.asarray(v)
+        with core.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+        return outs
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from .. import io
+        with core.scope_guard(self._scope):
+            io.save_inference_model(
+                dirname, self._feed_names,
+                [self._program.global_block().var(n)
+                 for n in self._fetch_names],
+                self._exe, main_program=self._program)

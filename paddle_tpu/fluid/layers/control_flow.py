@@ -121,3 +121,169 @@ class Switch(object):
         raise NotImplementedError(
             'Switch: express piecewise logic with layers.where / masks '
             '(see layers/learning_rate_scheduler.py piecewise_decay)')
+
+
+class StaticRNN(object):
+    """Static-length RNN builder.
+
+    Reference: layers/control_flow.py StaticRNN over
+    operators/recurrent_op — a sub-block executed once per time step
+    with memory variables.
+
+    TPU-native re-design: the step block is captured once as a template
+    and UNROLLED at build time (T is static anyway); XLA then fuses the
+    unrolled steps.  Memories thread through the clones; step_input
+    slices [B, T, ...] per step; step outputs stack to [B, T, ...].
+    """
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('static_rnn', name=name)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_len = None
+        self._inputs = []      # [(x_var, step_var)]
+        self._memories = []    # [(init_var, mem_var, updated_var)]
+        self._outputs = []     # [step out var]
+        self._template_ops = None
+        self._block = None
+        self._op_start = None
+        self._excluded_ops = []
+
+    class _StepGuard(object):
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            rnn = self.rnn
+            rnn.status = StaticRNN.IN_RNN_BLOCK
+            rnn._block = rnn.helper.main_program.current_block()
+            rnn._op_start = len(rnn._block.ops)
+            return self
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            if exc_type is not None:
+                return False
+            self.rnn._complete()
+            self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+            return True
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def step_input(self, x):
+        """x: [B, T, ...] -> per-step [B, ...] (slice at t=0 for the
+        template)."""
+        if self.seq_len is None:
+            self.seq_len = x.shape[1]
+        from . import nn as _nn
+        start = len(self._block.ops)
+        step0 = _nn.slice(x, axes=[1], starts=[0], ends=[1])
+        step0 = _nn.squeeze(step0, axes=[1])
+        self._excluded_ops.extend(self._block.ops[start:])
+        self._inputs.append((x, step0))
+        return step0
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, dtype='float32'):
+        from . import tensor as _t
+        if init is None:
+            if batch_ref is None:
+                raise ValueError('memory needs init or batch_ref')
+            start = len(self._block.ops)
+            init = _t.fill_constant_batch_size_like(
+                batch_ref, [0] + list(shape), dtype, init_value)
+            self._excluded_ops.extend(self._block.ops[start:])
+        mem = init  # template reads the init; clones read prev update
+        self._memories.append([init, mem, None])
+        return mem
+
+    def update_memory(self, mem, var):
+        for entry in self._memories:
+            if entry[1] is mem:
+                entry[2] = var
+                return
+        raise ValueError('update_memory: unknown memory var')
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        excluded = set(id(op) for op in self._excluded_ops)
+        self._template_ops = [op for op in
+                              self._block.ops[self._op_start:]
+                              if id(op) not in excluded]
+
+    def __call__(self, *args):
+        """Unroll: replay the template for t = 1..T-1 with renamed
+        vars, then stack step outputs to [B, T, ...]."""
+        import copy
+        from .. import unique_name as un
+        from . import nn as _nn
+        from . import tensor as _t
+        block = self._block
+        T = self.seq_len
+        step_outs = {o.name: [o] for o in self._outputs}
+        # memory chain: template used init; later steps use updates
+        mem_map = {}
+        for init, mem, upd in self._memories:
+            if upd is None:
+                raise ValueError('memory never updated')
+            mem_map[mem.name] = upd.name
+
+        prev_rename = {}
+        for init, mem, upd in self._memories:
+            prev_rename[mem.name] = upd.name
+
+        template = self._template_ops
+        for t in range(1, T):
+            rename = {}
+            # step inputs: new slice at t
+            for x, step0 in self._inputs:
+                st = _nn.slice(x, axes=[1], starts=[t], ends=[t + 1])
+                st = _nn.squeeze(st, axes=[1])
+                rename[step0.name] = st.name
+            rename.update(prev_rename)
+            new_prev = {}
+            for op in template:
+                new_inputs = {s: [rename.get(n, n) for n in ns]
+                              for s, ns in op.inputs.items()}
+                new_outputs = {}
+                for s, ns in op.outputs.items():
+                    row = []
+                    for n in ns:
+                        nn_name = un.generate(n + '_t%d' % t)
+                        v = block._find_var_recursive(n)
+                        nv = block.create_var(
+                            name=nn_name,
+                            shape=v.shape if v else (),
+                            dtype=v.dtype if v else 'float32')
+                        nv.stop_gradient = (v.stop_gradient
+                                            if v else False)
+                        rename[n] = nn_name
+                        row.append(nn_name)
+                    new_outputs[s] = row
+                block.append_op(op.type, inputs=new_inputs,
+                                outputs=new_outputs,
+                                attrs=copy.deepcopy(op.attrs),
+                                infer_shape=False)
+            for o in self._outputs:
+                step_outs[o.name].append(
+                    block._find_var_recursive(rename[o.name]))
+            for init, mem, upd in self._memories:
+                new_prev[mem.name] = rename.get(upd.name, upd.name)
+            prev_rename = new_prev
+
+        results = []
+        for o in self._outputs:
+            stacked = _nn.stack([v for v in step_outs[o.name]], axis=1)
+            results.append(stacked)
+        if len(results) == 1:
+            return results[0]
+        return results

@@ -723,3 +723,19 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None):
 
 def resize_nearest(input, out_shape=None, scale=None, name=None):
     return image_resize(input, out_shape, scale, name, 'NEAREST')
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python op (reference layers/nn.py py_func). Cuts the XLA
+    segment; forward-only (backward_func unsupported under jit)."""
+    from ..layers import tensor as _t
+    from ...ops.host_ops import register_py_func
+    helper = LayerHelper('py_func')
+    fid = helper.name
+    register_py_func(fid, func)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    helper.append_op('py_func', inputs={'X': list(xs)},
+                     outputs={'Out': list(outs)},
+                     attrs={'func_id': fid})
+    return out

@@ -553,10 +553,103 @@ class RecomputeOptimizer(Optimizer):
         return self.apply_gradients(params_grads), params_grads
 
 
-class ModelAverage(Optimizer):
-    def __init__(self, average_window_rate, min_average_window=10000,
-                 max_average_window=10000, **kwargs):
-        raise NotImplementedError('ModelAverage: planned')
+class ModelAverage(object):
+    """Running parameter average for eval (reference optimizer.py:2759).
+
+    Maintains sum accumulators in-graph; apply()/restore() swap averaged
+    params in and out of the scope on the host."""
+
+    def __init__(self, average_window_rate=0.15,
+                 min_average_window=10000, max_average_window=10000,
+                 **kwargs):
+        self._avg = {}
+        block = default_main_program().global_block()
+        sb = default_startup_program().global_block()
+        self._params = [p for p in block.all_parameters()
+                        if getattr(p, 'trainable', True)]
+        self._count_name = unique_name.generate('ma_count')
+        block.create_var(name=self._count_name, shape=(1,),
+                         dtype='float32', persistable=True)
+        sb.create_var(name=self._count_name, shape=(1,),
+                      dtype='float32', persistable=True)
+        sb.append_op('fill_constant', outputs={'Out': self._count_name},
+                     attrs={'shape': [1], 'dtype': 'float32',
+                            'value': 0.0})
+        block.append_op('increment', inputs={'X': self._count_name},
+                        outputs={'Out': self._count_name},
+                        attrs={'step': 1.0}, infer_shape=False)
+        for p in self._params:
+            name = unique_name.generate(p.name + '_ma_sum')
+            block.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                             persistable=True)
+            sb.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                          persistable=True)
+            sb.append_op('fill_constant', outputs={'Out': name},
+                         attrs={'shape': list(p.shape),
+                                'dtype': p.dtype, 'value': 0.0})
+            block.append_op('elementwise_add',
+                            inputs={'X': name, 'Y': p},
+                            outputs={'Out': name}, attrs={'axis': -1},
+                            infer_shape=False)
+            self._avg[p.name] = name
+        self._backup = {}
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            scope = core.global_scope()
+            count = float(np.asarray(core.as_array(
+                scope.find_var(self._count_name))).ravel()[0])
+            count = max(count, 1.0)
+            self._backup = {}
+            for p in self._params:
+                self._backup[p.name] = core.as_array(
+                    scope.find_var(p.name))
+                avg = core.as_array(scope.find_var(self._avg[p.name]))
+                scope.set_var(p.name, avg / count)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return guard()
+
+    def restore(self, executor=None):
+        scope = core.global_scope()
+        for name, val in self._backup.items():
+            scope.set_var(name, val)
+        self._backup = {}
+
+
+class PipelineOptimizer(object):
+    """Pipeline-parallel optimizer API (reference optimizer.py:3311 +
+    PipelineTrainer/SectionWorker, framework/trainer.h:114).
+
+    TPU-native: the SectionWorker thread/queue machinery is replaced by
+    the shard_map GPipe schedule in parallel/pipeline.py (activations
+    hop stages via ppermute, autodiff reverses the ring).  This wrapper
+    keeps the fluid API for single-stage programs and points multi-stage
+    users at pipeline_apply; full program-cutting onto the 'pp' axis is
+    the planned follow-up.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._cut_list:
+            raise NotImplementedError(
+                'program cutting onto the pp mesh axis lands next '
+                'round; build staged models with '
+                'paddle_tpu.parallel.pipeline.pipeline_apply')
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
 
 
 class ExponentialMovingAverage(object):

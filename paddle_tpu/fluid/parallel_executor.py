@@ -54,6 +54,22 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     executor._step += 1
     fetched = {}
     param_rule = getattr(compiled, '_param_sharding_rule', None)
+    zero_axis = getattr(compiled, '_shard_opt_states_axis', None)
+    if zero_axis is not None:
+        param_names = set(p.name for p in program.all_parameters())
+        base_rule = param_rule
+
+        def param_rule(name, shape, _base=base_rule):  # noqa: F811
+            if _base is not None:
+                spec = _base(name, shape)
+                if spec is not None:
+                    return spec
+            # accumulators (not model params): shard dim 0 over dp
+            if name not in param_names and len(shape) >= 1 and \
+                    shape[0] % mesh.shape[zero_axis] == 0 and \
+                    shape[0] > 1:
+                return P(zero_axis)
+            return None
     for item in plan:
         if isinstance(item, _Segment):
             _run_segment_parallel(executor, item, feed, scope, mesh, ndev,

@@ -20,3 +20,5 @@ from .registry import register, register_host, get, is_registered  # noqa
 from . import sequence_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

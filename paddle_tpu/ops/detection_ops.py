@@ -1,0 +1,277 @@
+"""Detection / vision op lowerings.
+
+Reference: paddle/fluid/operators/detection/ (~16k LoC C++/CUDA:
+prior_box, box_coder, yolo_box, multiclass_nms, roi_align, ...).
+
+TPU-native notes: NMS has data-dependent output size in the reference;
+here outputs are FIXED-SIZE (keep_top_k) with -1-padded labels/scores so
+the whole post-process stays compiled (the serving host trims padding).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('prior_box', no_grad_out_slots=('Boxes', 'Variances'))
+def prior_box(ctx, ins, attrs):
+    """SSD prior boxes (reference detection/prior_box_op.cc)."""
+    feat = ins['Input'][0]      # [N, C, H, W]
+    image = ins['Image'][0]     # [N, C, IH, IW]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs['min_sizes']]
+    max_sizes = [float(s) for s in attrs.get('max_sizes', [])]
+    ars = [1.0]
+    for a in attrs.get('aspect_ratios', []):
+        if all(abs(a - x) > 1e-6 for x in ars):
+            ars.append(float(a))
+            if attrs.get('flip', False):
+                ars.append(1.0 / float(a))
+    variances = attrs.get('variances', [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get('step_w', 0.0) or iw / w
+    step_h = attrs.get('step_h', 0.0) or ih / h
+    offset = attrs.get('offset', 0.5)
+    clip = attrs.get('clip', False)
+
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * np.sqrt(ar) / 2.0
+            bh = ms / np.sqrt(ar) / 2.0
+            boxes.append((bw, bh))
+        for Ms in max_sizes:
+            s = np.sqrt(ms * Ms)
+            boxes.append((s / 2.0, s / 2.0))
+    nb = len(boxes)
+    cx = (np.arange(w) + offset) * step_w
+    cy = (np.arange(h) + offset) * step_h
+    gx, gy = np.meshgrid(cx, cy)
+    out = np.zeros((h, w, nb, 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[:, :, i, 0] = (gx - bw) / iw
+        out[:, :, i, 1] = (gy - bh) / ih
+        out[:, :, i, 2] = (gx + bw) / iw
+        out[:, :, i, 3] = (gy + bh) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32),
+                  (h, w, nb, 1))
+    return {'Boxes': [jnp.asarray(out)], 'Variances': [jnp.asarray(var)]}
+
+
+@register('box_coder')
+def box_coder(ctx, ins, attrs):
+    """Encode/decode boxes vs priors (reference detection/box_coder_op)."""
+    prior = ins['PriorBox'][0]          # [M, 4] xyxy
+    target = ins['TargetBox'][0]
+    pvar = ins['PriorBoxVar'][0] if ins.get('PriorBoxVar') else None
+    code_type = attrs.get('code_type', 'encode_center_size')
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+    if code_type == 'encode_center_size':
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        out = jnp.stack([
+            (tcx - pcx) / pw / pvar[:, 0],
+            (tcy - pcy) / ph / pvar[:, 1],
+            jnp.log(tw / pw) / pvar[:, 2],
+            jnp.log(th / ph) / pvar[:, 3]], axis=-1)
+        return {'OutputBox': [out]}
+    # decode: target [N, M, 4] deltas
+    t = target
+    cx = t[..., 0] * pvar[:, 0] * pw + pcx
+    cy = t[..., 1] * pvar[:, 1] * ph + pcy
+    bw = jnp.exp(t[..., 2] * pvar[:, 2]) * pw
+    bh = jnp.exp(t[..., 3] * pvar[:, 3]) * ph
+    out = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                     cy + bh / 2], axis=-1)
+    return {'OutputBox': [out]}
+
+
+@register('iou_similarity')
+def iou_similarity(ctx, ins, attrs):
+    x = ins['X'][0]  # [N, 4]
+    y = ins['Y'][0]  # [M, 4]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {'Out': [inter / (area_x[:, None] + area_y[None, :]
+                             - inter + 1e-10)]}
+
+
+@register('yolo_box', no_grad_out_slots=('Boxes', 'Scores'))
+def yolo_box(ctx, ins, attrs):
+    """Reference detection/yolo_box_op.cc."""
+    x = ins['X'][0]               # [N, A*(5+C), H, W]
+    img_size = ins['ImgSize'][0]  # [N, 2] (h, w)
+    anchors = attrs['anchors']
+    class_num = attrs['class_num']
+    conf_thresh = attrs.get('conf_thresh', 0.01)
+    downsample = attrs.get('downsample_ratio', 32)
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx[None, None, None, :]) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy[None, None, :, None]) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    input_size = downsample * h
+    bw = jnp.exp(x[:, :, 2]) * aw[None, :, None, None] / input_size
+    bh = jnp.exp(x[:, :, 3]) * ah[None, :, None, None] / input_size
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    keep = (conf > conf_thresh).astype(x.dtype)
+    imh = img_size[:, 0].astype(jnp.float32)[:, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None]
+    boxes = jnp.stack([
+        (bx - bw / 2).reshape(n, -1) * imw,
+        (by - bh / 2).reshape(n, -1) * imh,
+        (bx + bw / 2).reshape(n, -1) * imw,
+        (by + bh / 2).reshape(n, -1) * imh], axis=-1)
+    boxes = boxes * keep.reshape(n, -1)[..., None]
+    scores = (probs * keep[:, :, None]).transpose(0, 1, 3, 4, 2)
+    scores = scores.reshape(n, -1, class_num)
+    return {'Boxes': [boxes], 'Scores': [scores]}
+
+
+def _nms_single(boxes, scores, iou_thr, keep_k):
+    """Greedy NMS with fixed output size keep_k; returns (idx, valid)."""
+    n = boxes.shape[0]
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+    def iou_with(i):
+        b = boxes[i]
+        lt = jnp.maximum(boxes[:, :2], b[:2])
+        rb = jnp.minimum(boxes[:, 2:], b[2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[:, 0] * wh[:, 1]
+        ab = (b[2] - b[0]) * (b[3] - b[1])
+        return inter / (area + ab - inter + 1e-10)
+
+    def body(k, carry):
+        alive, out_idx, out_valid = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        valid = masked[i] > -jnp.inf
+        suppress = iou_with(i) >= iou_thr
+        alive = jnp.where(valid, alive & ~suppress, alive)
+        out_idx = out_idx.at[k].set(jnp.where(valid, i, -1))
+        out_valid = out_valid.at[k].set(valid)
+        return alive, out_idx, out_valid
+
+    alive0 = jnp.ones((n,), bool)
+    idx0 = jnp.full((keep_k,), -1, jnp.int32)
+    val0 = jnp.zeros((keep_k,), bool)
+    _, idx, valid = jax.lax.fori_loop(0, keep_k, body,
+                                      (alive0, idx0, val0))
+    return idx, valid
+
+
+@register('multiclass_nms', no_grad_out_slots=('Out',))
+def multiclass_nms(ctx, ins, attrs):
+    """Fixed-size output [N, keep_top_k, 6] rows (label, score, x1, y1,
+    x2, y2); invalid rows have label == -1.  The reference emits a
+    variable-length LoDTensor (detection/multiclass_nms_op.cc); fixed
+    padding keeps it compiled on TPU."""
+    boxes = ins['BBoxes'][0]   # [N, M, 4]
+    scores = ins['Scores'][0]  # [N, C, M]
+    score_thr = attrs.get('score_threshold', 0.05)
+    nms_thr = attrs.get('nms_threshold', 0.45)
+    nms_top_k = attrs.get('nms_top_k', 128)
+    keep_top_k = attrs.get('keep_top_k', 100)
+    n, c, m = scores.shape
+    k_pre = min(nms_top_k, m)
+
+    def per_image(bx, sc):
+        rows = []
+        for cls in range(c):
+            s = jnp.where(sc[cls] > score_thr, sc[cls], -jnp.inf)
+            top_s, top_i = jax.lax.top_k(s, k_pre)
+            bb = bx[top_i]
+            idx, valid = _nms_single(bb, top_s, nms_thr, k_pre)
+            safe = jnp.maximum(idx, 0)
+            rows.append(jnp.concatenate([
+                jnp.where(valid, float(cls), -1.0)[:, None],
+                jnp.where(valid, top_s[safe], 0.0)[:, None],
+                bb[safe] * valid[:, None]], axis=-1))
+        allr = jnp.concatenate(rows, axis=0)
+        order = jnp.argsort(-jnp.where(allr[:, 0] >= 0, allr[:, 1],
+                                       -jnp.inf))
+        return allr[order[:keep_top_k]]
+
+    out = jax.vmap(per_image)(boxes, scores)
+    return {'Out': [out]}
+
+
+@register('roi_align')
+def roi_align(ctx, ins, attrs):
+    """Reference detection/roi_align_op.cc; rois [R, 4] + RoisNum->
+    batch indices via RoisBatch input [R]."""
+    x = jnp.asarray(ins['X'][0])         # [N, C, H, W]
+    rois = jnp.asarray(ins['ROIs'][0])   # [R, 4] xyxy in input scale
+    batch_idx = ins['RoisBatch'][0] if ins.get('RoisBatch') else \
+        jnp.zeros((rois.shape[0],), jnp.int32)
+    ph = attrs.get('pooled_height', 7)
+    pw = attrs.get('pooled_width', 7)
+    scale = attrs.get('spatial_scale', 1.0)
+    sampling = attrs.get('sampling_ratio', 2)
+    if sampling <= 0:
+        sampling = 2
+    n, ch, h, w = x.shape
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample points
+        iy = (jnp.arange(ph)[:, None, None, None] * bin_h + y1 +
+              (jnp.arange(sampling)[None, None, :, None] + 0.5)
+              * bin_h / sampling)
+        ix = (jnp.arange(pw)[None, :, None, None] * bin_w + x1 +
+              (jnp.arange(sampling)[None, None, None, :] + 0.5)
+              * bin_w / sampling)
+        iy = jnp.broadcast_to(iy, (ph, pw, sampling, sampling))
+        ix = jnp.broadcast_to(ix, (ph, pw, sampling, sampling))
+        img = x[bi]  # [C, H, W]
+
+        y0 = jnp.clip(jnp.floor(iy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(ix), 0, w - 1)
+        y1c = jnp.clip(y0 + 1, 0, h - 1)
+        x1c = jnp.clip(x0 + 1, 0, w - 1)
+        ly = iy - y0
+        lx = ix - x0
+
+        def gat(yy, xx):
+            return img[:, yy.astype(jnp.int32), xx.astype(jnp.int32)]
+
+        val = (gat(y0, x0) * (1 - ly) * (1 - lx) +
+               gat(y1c, x0) * ly * (1 - lx) +
+               gat(y0, x1c) * (1 - ly) * lx +
+               gat(y1c, x1c) * ly * lx)   # [C, ph, pw, s, s]
+        return val.mean(axis=(-1, -2))
+
+    out = jax.vmap(one_roi)(rois, batch_idx.astype(jnp.int32))
+    return {'Out': [out]}
+
+
+@register('generate_proposals')
+def generate_proposals(ctx, ins, attrs):
+    raise NotImplementedError(
+        'generate_proposals: compose yolo_box/box_coder + '
+        'multiclass_nms fixed-size variants')

@@ -73,3 +73,26 @@ def load_combine(executor, scope, op):
     data = np.load(path + '.npz')
     for name in op.output('Out'):
         scope.set_var(name, data[name])
+
+
+_PY_FUNCS = {}
+
+
+def register_py_func(fid, fn):
+    _PY_FUNCS[fid] = fn
+
+
+@register_host('py_func')
+def py_func(executor, scope, op):
+    """Host python escape hatch (reference operators/py_func_op.cc)."""
+    from ..fluid import core
+    fn = _PY_FUNCS[op.attr('func_id')]
+    ins = [np.asarray(core.as_array(scope.find_var(n)))
+           for n in op.input('X')]
+    outs = fn(*ins)
+    if outs is None:
+        outs = []
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for name, val in zip(op.output('Out'), outs):
+        scope.set_var(name, np.asarray(val))

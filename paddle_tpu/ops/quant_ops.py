@@ -1,0 +1,84 @@
+"""Quantization op lowerings (QAT fake-quant family).
+
+Reference: operators/fake_quantize_op.cc|.cu, fake_dequantize_op.* used
+by contrib/slim/quantization/quantization_pass.py.
+
+Straight-through estimator comes for free from the lowering structure:
+out = x + stop_gradient(q(x) - x), so jax.vjp-synthesized grads pass
+through the rounding.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _ste(x, q):
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _quant_dequant(x, scale, bits):
+    bnt = (1 << (bits - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) / bnt * s
+    return q
+
+
+@register('fake_quantize_abs_max', no_grad_out_slots=('OutScale',))
+def fake_quantize_abs_max(ctx, ins, attrs):
+    x = ins['X'][0]
+    bits = attrs.get('bit_length', 8)
+    scale = jnp.max(jnp.abs(x))
+    return {'Out': [_ste(x, _quant_dequant(x, scale, bits))],
+            'OutScale': [scale.reshape(1)]}
+
+
+@register('fake_channel_wise_quantize_abs_max',
+          no_grad_out_slots=('OutScale',))
+def fake_channel_wise_quantize_abs_max(ctx, ins, attrs):
+    x = ins['X'][0]
+    bits = attrs.get('bit_length', 8)
+    axes = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {'Out': [_ste(x, _quant_dequant(x, s, bits))],
+            'OutScale': [scale]}
+
+
+@register('fake_quantize_dequantize_moving_average_abs_max',
+          no_grad_out_slots=('OutScale', 'StateOut', 'AccumOut'))
+def fake_quantize_dequantize_moving_average_abs_max(ctx, ins, attrs):
+    """Activation QAT with a moving-average scale (reference
+    fake_quantize_op.cc MovingAverageAbsMax)."""
+    x = ins['X'][0]
+    in_scale = ins['InScale'][0].reshape(())
+    bits = attrs.get('bit_length', 8)
+    rate = attrs.get('moving_rate', 0.9)
+    is_test = attrs.get('is_test', False)
+    cur = jnp.max(jnp.abs(x))
+    scale = jnp.where(jnp.asarray(is_test), in_scale,
+                      rate * in_scale + (1 - rate) * cur)
+    scale = jnp.maximum(scale, 1e-8)
+    return {'Out': [_ste(x, _quant_dequant(x, scale, bits))],
+            'OutScale': [scale.reshape(1)]}
+
+
+@register('fake_dequantize_max_abs')
+def fake_dequantize_max_abs(ctx, ins, attrs):
+    x = ins['X'][0]
+    scale = ins['Scale'][0].reshape(())
+    max_range = attrs.get('max_range', 127.0)
+    return {'Out': [x * scale / max_range]}
+
+
+@register('moving_average_abs_max_scale',
+          no_grad_out_slots=('OutScale',))
+def moving_average_abs_max_scale(ctx, ins, attrs):
+    x = ins['X'][0]
+    in_scale = ins['InScale'][0].reshape(())
+    rate = attrs.get('moving_rate', 0.9)
+    cur = jnp.max(jnp.abs(x))
+    return {'Out': [x],
+            'OutScale': [(rate * in_scale
+                          + (1 - rate) * cur).reshape(1)]}

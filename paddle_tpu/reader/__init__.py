@@ -1,0 +1,134 @@
+"""Reader decorators. Reference: python/paddle/reader/decorator.py
+(shuffle, batch, buffered, xmap_readers, compose, chain)."""
+
+import itertools
+import random
+import threading
+import queue as _queue
+
+
+def shuffle(reader, buf_size):
+    def impl():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        random.shuffle(buf)
+        for b in buf:
+            yield b
+    return impl
+
+
+def batch(reader, batch_size, drop_last=False):
+    def impl():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return impl
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (reference decorator.py buffered)."""
+    def impl():
+        q = _queue.Queue(maxsize=size)
+        end = object()
+
+        def worker():
+            for item in reader():
+                q.put(item)
+            q.put(end)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+    return impl
+
+
+def compose(*readers):
+    def impl():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return impl
+
+
+def chain(*readers):
+    def impl():
+        return itertools.chain(*[r() for r in readers])
+    return impl
+
+
+def map_readers(func, *readers):
+    def impl():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Thread-pool mapped reader (reference xmap_readers)."""
+    def impl():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for s in reader():
+                in_q.put(s)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                s = in_q.get()
+                if s is end:
+                    out_q.put(end)
+                    break
+                out_q.put(mapper(s))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        finished = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            yield item
+    return impl
+
+
+def cache(reader):
+    data = []
+
+    def impl():
+        if not data:
+            data.extend(reader())
+        return iter(data)
+    return impl
+
+
+def firstn(reader, n):
+    def impl():
+        return itertools.islice(reader(), n)
+    return impl

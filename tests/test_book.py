@@ -1,0 +1,101 @@
+"""Book tests: classic end-to-end workflows.
+
+Reference: python/paddle/fluid/tests/book/ (fit_a_line, recognize_digits,
+word2vec, ... with loss-decrease assertions) — exercising the full
+dataset/reader/DataFeeder/executor/io pipeline.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.reader as preader
+from paddle_tpu import dataset
+
+
+def test_fit_a_line(tmp_path):
+    """reference book/test_fit_a_line.py."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[13], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_loss)
+
+    train_reader = preader.batch(
+        preader.shuffle(dataset.uci_housing.train(), buf_size=500),
+        batch_size=20)
+    place = fluid.XLAPlace(0)
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(place)
+        exe.run(startup)
+        first = last = None
+        for epoch in range(6):
+            for batch in train_reader():
+                l, = exe.run(main, feed=feeder.feed(batch),
+                             fetch_list=[avg_loss])
+                if first is None:
+                    first = float(l)
+                last = float(l)
+        assert last < first * 0.3, (first, last)
+        # inference save/load roundtrip through the predictor
+        fluid.io.save_inference_model(str(tmp_path), ['x'],
+                                      [y_predict], exe, main)
+    from paddle_tpu.inference import AnalysisConfig, \
+        create_paddle_predictor
+    pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+    test_batch = list(dataset.uci_housing.test()())[:8]
+    xs = np.stack([b[0] for b in test_batch])
+    out = pred.run([xs])
+    assert out[0].as_ndarray().shape == (8, 1)
+
+
+def test_recognize_digits_reader_pipeline():
+    """reference book/test_recognize_digits.py (mlp variant) with the
+    mnist dataset reader + DataFeeder."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data('img', shape=[784], dtype='float32')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        h = fluid.layers.fc(img, 128, act='relu')
+        pred = fluid.layers.fc(h, 10, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        acc = fluid.layers.accuracy(pred, label)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    reader = preader.batch(dataset.mnist.train(), batch_size=64,
+                           drop_last=True)
+    place = fluid.XLAPlace(0)
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(place)
+        exe.run(startup)
+        accs = []
+        for epoch in range(3):
+            for batch in reader():
+                _, a = exe.run(main, feed=feeder.feed(batch),
+                               fetch_list=[loss, acc])
+                accs.append(float(a))
+    assert np.mean(accs[-10:]) > 0.9, np.mean(accs[-10:])
+
+
+def test_reader_decorators():
+    def base():
+        return iter(range(10))
+
+    b = preader.batch(base, 3)
+    batches = list(b())
+    assert batches[0] == [0, 1, 2] and batches[-1] == [9]
+    s = list(preader.shuffle(base, 100)())
+    assert sorted(s) == list(range(10))
+    buf = list(preader.buffered(base, 2)())
+    assert buf == list(range(10))
+    m = list(preader.map_readers(lambda a: a * 2, base)())
+    assert m == [i * 2 for i in range(10)]
+    x = sorted(preader.xmap_readers(lambda a: a + 1, base, 2, 4)())
+    assert x == [i + 1 for i in range(10)]

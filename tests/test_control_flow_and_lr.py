@@ -94,3 +94,28 @@ def test_sequence_ops():
         ctx, {'X': [np.array([3, 2])]}, {'maxlen': 4,
                                          'out_dtype': 'float32'})
     np.testing.assert_allclose(m['Y'][0], mask)
+
+
+def test_static_rnn_unroll():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4, 3], dtype='float32')
+        from paddle_tpu.fluid.layers.control_flow import StaticRNN
+        rnn = StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(batch_ref=xt, shape=[3])
+            h = fluid.layers.elementwise_add(xt, prev)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        total = fluid.layers.reduce_sum(out)
+    xs = np.arange(24, dtype='float32').reshape(2, 4, 3)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        o, = exe.run(main, feed={'x': xs}, fetch_list=[out])
+    # h_t = cumulative sum over time
+    np.testing.assert_allclose(o, np.cumsum(xs, axis=1), rtol=1e-5)

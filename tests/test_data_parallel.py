@@ -150,3 +150,25 @@ def test_collective_ops_semantics():
     np.testing.assert_allclose(np.asarray(ag), x)
     np.testing.assert_allclose(np.asarray(bc),
                                np.tile(x[2], (n, 1)))
+
+
+def test_zero_sharded_optimizer_states_parity():
+    """ZeRO-1 weight-update sharding: same losses/params as replicated."""
+    batches = make_batches()
+    m1, s1, l1 = build_model(21)
+    ref, ref_p = train(_single, m1, s1, l1, batches,
+                       fluid.optimizer.Adam(0.01))
+
+    m2, s2, l2 = build_model(21)
+    box = {}
+
+    def _zero(exe, main, feed, fetch):
+        if 'cp' not in box:
+            box['cp'] = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=l2.name).with_sharded_optimizer_states()
+        return exe.run(box['cp'], feed=feed, fetch_list=fetch)
+
+    par, par_p = train(_zero, m2, s2, l2, batches,
+                       fluid.optimizer.Adam(0.01))
+    np.testing.assert_allclose(ref, par, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ref_p, par_p, rtol=1e-4, atol=1e-5)

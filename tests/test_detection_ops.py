@@ -1,0 +1,82 @@
+"""Detection op tests vs numpy references."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import registry
+
+ctx = registry.LowerCtx(0)
+rng = np.random.RandomState(0)
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], 'float32')
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], 'float32')
+    out = np.asarray(registry.get('iou_similarity').fn(
+        ctx, {'X': [x], 'Y': [y]}, {})['Out'][0])
+    np.testing.assert_allclose(out[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[1, 0], 1 / 7, rtol=1e-5)
+    np.testing.assert_allclose(out[1, 1], 1 / 7, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0, 0, 4, 4], [2, 2, 8, 10]], 'float32')
+    target = np.array([[1, 1, 3, 3], [2, 3, 9, 9]], 'float32')
+    enc = np.asarray(registry.get('box_coder').fn(
+        ctx, {'PriorBox': [prior], 'TargetBox': [target]},
+        {'code_type': 'encode_center_size'})['OutputBox'][0])
+    dec = np.asarray(registry.get('box_coder').fn(
+        ctx, {'PriorBox': [prior], 'TargetBox': [enc[None]]},
+        {'code_type': 'decode_center_size'})['OutputBox'][0])
+    np.testing.assert_allclose(dec[0], target, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_shapes():
+    feat = np.zeros((1, 8, 4, 4), 'float32')
+    img = np.zeros((1, 3, 64, 64), 'float32')
+    out = registry.get('prior_box').fn(
+        ctx, {'Input': [feat], 'Image': [img]},
+        {'min_sizes': [16.0], 'max_sizes': [32.0],
+         'aspect_ratios': [2.0], 'flip': True})
+    boxes = np.asarray(out['Boxes'][0])
+    assert boxes.shape == (4, 4, 4, 4)  # 1 + 2 flipped ars + 1 max size
+    assert (boxes[..., 2] >= boxes[..., 0]).all()
+
+
+def test_multiclass_nms_suppresses():
+    # two overlapping boxes + one distinct, single class
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60]]], 'float32')
+    scores = np.array([[[0.9, 0.8, 0.7]]], 'float32')
+    out = np.asarray(registry.get('multiclass_nms').fn(
+        ctx, {'BBoxes': [boxes], 'Scores': [scores]},
+        {'score_threshold': 0.1, 'nms_threshold': 0.5,
+         'keep_top_k': 3, 'nms_top_k': 3})['Out'][0])
+    valid = out[0][out[0, :, 0] >= 0]
+    assert valid.shape[0] == 2  # overlapping pair suppressed to one
+    np.testing.assert_allclose(sorted(valid[:, 1].tolist()),
+                               [0.7, 0.9], rtol=1e-5)
+
+
+def test_yolo_box_shapes():
+    x = rng.randn(2, 3 * 7, 4, 4).astype('float32')
+    img = np.array([[416, 416], [320, 480]], 'int32')
+    out = registry.get('yolo_box').fn(
+        ctx, {'X': [x], 'ImgSize': [img]},
+        {'anchors': [10, 13, 16, 30, 33, 23], 'class_num': 2,
+         'conf_thresh': 0.0, 'downsample_ratio': 32})
+    assert np.asarray(out['Boxes'][0]).shape == (2, 48, 4)
+    assert np.asarray(out['Scores'][0]).shape == (2, 48, 2)
+
+
+def test_roi_align_identity():
+    # a constant image must pool to the constant
+    x = np.full((1, 2, 8, 8), 3.5, 'float32')
+    rois = np.array([[0, 0, 8, 8]], 'float32')
+    out = np.asarray(registry.get('roi_align').fn(
+        ctx, {'X': [x], 'ROIs': [rois]},
+        {'pooled_height': 2, 'pooled_width': 2,
+         'spatial_scale': 1.0})['Out'][0])
+    np.testing.assert_allclose(out, np.full((1, 2, 2, 2), 3.5),
+                               rtol=1e-5)

@@ -98,3 +98,65 @@ def test_dygraph_state_dict_roundtrip(tmp_path):
             [loaded[p.name] for p in net.parameters()])})
         for p, q in zip(net.parameters(), net2.parameters()):
             np.testing.assert_allclose(p.numpy(), q.numpy())
+
+
+def test_traced_layer_roundtrip(tmp_path):
+    from paddle_tpu.fluid.dygraph import TracedLayer
+    rng = np.random.RandomState(0)
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(6, 3, act='relu')
+        x = to_variable(rng.randn(4, 6).astype('float32'))
+        eager_out = net(x)
+        out, traced = TracedLayer.trace(net, [x])
+        static_out = traced([x])[0]
+        np.testing.assert_allclose(eager_out.numpy(), static_out,
+                                   rtol=1e-5)
+        traced.save_inference_model(str(tmp_path))
+    from paddle_tpu.inference import AnalysisConfig, \
+        create_paddle_predictor
+    pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+    out2 = pred.run([rng.randn(2, 6).astype('float32')])
+    assert out2[0].as_ndarray().shape == (2, 3)
+
+
+def test_model_average():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(0.15)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        vals = []
+        pname = main.all_parameters()[0].name
+        for _ in range(5):
+            exe.run(main, feed={'x': np.ones((4, 2), 'float32')},
+                    fetch_list=[loss])
+            vals.append(np.asarray(scope.find_var(pname)).copy())
+        expected_avg = np.mean(vals, axis=0)
+        with ma.apply(exe):
+            avg_now = np.asarray(scope.find_var(pname))
+            np.testing.assert_allclose(avg_now, expected_avg,
+                                       rtol=1e-5)
+        restored = np.asarray(scope.find_var(pname))
+        np.testing.assert_allclose(restored, vals[-1], rtol=1e-6)
+
+
+def test_py_func_host_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[3], dtype='float32')
+        h = fluid.layers.scale(x, scale=2.0)
+        out = main.global_block().create_var(
+            name='pyfunc_out', shape=(-1, 3), dtype='float32')
+        fluid.layers.py_func(lambda a: a + 1.0, h, out)
+        final = fluid.layers.scale(out, scale=3.0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        r, = exe.run(main, feed={'x': np.ones((2, 3), 'float32')},
+                     fetch_list=[final])
+    np.testing.assert_allclose(r, np.full((2, 3), 9.0), rtol=1e-6)

@@ -1,0 +1,64 @@
+"""QAT fake-quant ops + program rewrite pass."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import registry
+
+
+def test_fake_quant_abs_max():
+    x = np.array([[-1.0, 0.5], [0.25, 1.0]], 'float32') * 4
+    out = registry.get('fake_quantize_abs_max').fn(
+        registry.LowerCtx(0), {'X': [x]}, {'bit_length': 8})
+    q = np.asarray(out['Out'][0])
+    s = float(np.asarray(out['OutScale'][0]))
+    assert s == 4.0
+    # max error bounded by one quant step
+    assert np.abs(q - x).max() <= s / 127 + 1e-6
+
+
+def test_fake_quant_ste_gradient():
+    import jax, jax.numpy as jnp
+
+    def f(x):
+        out = registry.get('fake_quantize_abs_max').fn(
+            registry.LowerCtx(0), {'X': [x]}, {'bit_length': 8})
+        return jnp.sum(out['Out'][0] ** 2)
+
+    x = jnp.asarray(np.array([[0.3, -0.7]], 'float32'))
+    g = jax.grad(f)(x)
+    # straight-through: grad ~ 2*q(x) but nonzero and finite
+    assert np.isfinite(np.asarray(g)).all()
+    assert (np.asarray(g) != 0).all()
+
+
+def test_qat_rewrite_trains():
+    from paddle_tpu.fluid.contrib.slim.quantization import \
+        quantize_program
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(x, 16, act='relu')
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        quantize_program(main, startup)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count('fake_channel_wise_quantize_abs_max') == 2
+    assert types.count(
+        'fake_quantize_dequantize_moving_average_abs_max') == 2
+    rng = np.random.RandomState(0)
+    W = rng.randn(8, 1).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for _ in range(80):
+            xs = rng.randn(32, 8).astype('float32')
+            l, = exe.run(main, feed={'x': xs, 'y': xs @ W},
+                         fetch_list=[loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
