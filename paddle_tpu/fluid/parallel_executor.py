@@ -110,6 +110,11 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
              for n in seg.state_names}
     data = {n: executor._lookup_input(n, feed, scope)
             for n in seg.input_names}
+    # pin state shardings by resharding the inputs (device_put is a
+    # no-op when the array already matches); outputs inherit XLA's
+    # propagated shardings and flow back here next step
+    state = {n: jax.device_put(v, state_shard(n, v))
+             for n, v in state.items()}
     if seg.compiled is None or not isinstance(seg.compiled, tuple):
         fn = _make_segment_fn(seg)
         in_shardings = (None,
