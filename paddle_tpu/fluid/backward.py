@@ -33,6 +33,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     program = loss.block.program
     block = program.global_block()
     no_grad_set = set(no_grad_set or [])
+    with program._role_guard('backward'):
+        return _append_backward_impl(loss, program, block, parameter_list,
+                                     no_grad_set, callbacks, checkpoints)
+
+
+def _append_backward_impl(loss, program, block, parameter_list,
+                          no_grad_set, callbacks, checkpoints):
 
     loss_idx = None
     for i in range(len(block.ops) - 1, -1, -1):
@@ -173,6 +180,9 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
                 contribs[n].append(gname)
         grad_outputs['GRAD::' + slot] = row
     attrs = dict(op.attrs)
+    # the grad op inherits the forward op's attrs (incl. __op_seed__, so
+    # e.g. dropout regenerates the same mask) but NOT its role
+    attrs['__op_role__'] = 'backward'
     if op.type in ('matmul', 'matmul_v2', 'mul', 'conv2d',
                    'depthwise_conv2d') or any(
             n in checkpoint_names for n in op.input_arg_names):

@@ -77,6 +77,10 @@ class OptimizerWithMixedPrecision(object):
         return params_grads
 
     def apply_gradients(self, params_grads):
+        with default_main_program()._role_guard('optimize'):
+            return self._apply_gradients_impl(params_grads)
+
+    def _apply_gradients_impl(self, params_grads):
         block = default_main_program().global_block()
         grads = [g for _, g in params_grads if g is not None]
         unscaled = []

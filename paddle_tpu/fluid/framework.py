@@ -257,6 +257,11 @@ class Block(object):
         attrs = dict(attrs or {})
         if '__op_seed__' not in attrs:
             attrs['__op_seed__'] = self.program._next_op_seed()
+        # role stamp (reference: OpRole attr, framework/op_proto_maker.h):
+        # lets clone(for_test=True) prune backward/optimize ops.
+        if '__op_role__' not in attrs:
+            attrs['__op_role__'] = getattr(self.program, '_current_role',
+                                           'forward')
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.append(op)
         if infer_shape and registry.is_registered(type) \
@@ -346,6 +351,18 @@ class Program(object):
         self._op_seed_counter = [0]
         self._seed_base = np.random.randint(0, 2 ** 31 - 1)
         self._exec_cache = {}
+        self._current_role = 'forward'
+
+    @contextlib.contextmanager
+    def _role_guard(self, role):
+        """Context manager stamping appended ops with `role`
+        ('backward' / 'optimize'); clone(for_test=True) prunes them."""
+        prev = self._current_role
+        self._current_role = role
+        try:
+            yield
+        finally:
+            self._current_role = prev
 
     def _bump_version(self):
         self._version += 1
@@ -392,10 +409,11 @@ class Program(object):
                 yield v
 
     def clone(self, for_test=False):
-        """Reference: Program.clone (framework.py:3817). Deep-copies the IR;
+        """Reference: Program.clone (framework.py:3839). Deep-copies the IR;
         for_test=True flips is_test attrs (dropout/batch_norm eval mode) and
-        prunes nothing else (backward/optimize ops are appended after clone
-        in the standard workflow)."""
+        prunes backward/optimize ops (reference: core.prune_backward +
+        _inference_optimize at framework.py:3994-4005), so a cloned eval
+        program never mutates parameters or optimizer state."""
         import copy
         p = Program.__new__(Program)
         p.random_seed = self.random_seed
@@ -403,6 +421,7 @@ class Program(object):
         p._op_seed_counter = list(self._op_seed_counter)
         p._seed_base = self._seed_base
         p._exec_cache = {}
+        p._current_role = 'forward'
         p.current_block_idx = self.current_block_idx
         p.blocks = []
         for b in self.blocks:
@@ -422,6 +441,9 @@ class Program(object):
                     nv = Variable(nb, **d)
                 nb.vars[name] = nv
             for op in b.ops:
+                if for_test and op.attrs.get('__op_role__') in (
+                        'backward', 'optimize'):
+                    continue
                 attrs = copy.deepcopy(op.attrs)
                 if for_test and 'is_test' in attrs:
                     attrs['is_test'] = True

@@ -107,6 +107,10 @@ class Optimizer(object):
 
     def apply_gradients(self, params_grads):
         """Reference: optimizer.py:575."""
+        with default_main_program()._role_guard('optimize'):
+            return self._apply_gradients_impl(params_grads)
+
+    def _apply_gradients_impl(self, params_grads):
         from .clip import append_gradient_clip_ops
         from .regularizer import append_regularization_ops
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
@@ -575,23 +579,24 @@ class ModelAverage(object):
         sb.append_op('fill_constant', outputs={'Out': self._count_name},
                      attrs={'shape': [1], 'dtype': 'float32',
                             'value': 0.0})
-        block.append_op('increment', inputs={'X': self._count_name},
-                        outputs={'Out': self._count_name},
-                        attrs={'step': 1.0}, infer_shape=False)
-        for p in self._params:
-            name = unique_name.generate(p.name + '_ma_sum')
-            block.create_var(name=name, shape=p.shape, dtype=p.dtype,
-                             persistable=True)
-            sb.create_var(name=name, shape=p.shape, dtype=p.dtype,
-                          persistable=True)
-            sb.append_op('fill_constant', outputs={'Out': name},
-                         attrs={'shape': list(p.shape),
-                                'dtype': p.dtype, 'value': 0.0})
-            block.append_op('elementwise_add',
-                            inputs={'X': name, 'Y': p},
-                            outputs={'Out': name}, attrs={'axis': -1},
-                            infer_shape=False)
-            self._avg[p.name] = name
+        with default_main_program()._role_guard('optimize'):
+            block.append_op('increment', inputs={'X': self._count_name},
+                            outputs={'Out': self._count_name},
+                            attrs={'step': 1.0}, infer_shape=False)
+            for p in self._params:
+                name = unique_name.generate(p.name + '_ma_sum')
+                block.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                                 persistable=True)
+                sb.create_var(name=name, shape=p.shape, dtype=p.dtype,
+                              persistable=True)
+                sb.append_op('fill_constant', outputs={'Out': name},
+                             attrs={'shape': list(p.shape),
+                                    'dtype': p.dtype, 'value': 0.0})
+                block.append_op('elementwise_add',
+                                inputs={'X': name, 'Y': p},
+                                outputs={'Out': name}, attrs={'axis': -1},
+                                infer_shape=False)
+                self._avg[p.name] = name
         self._backup = {}
 
     def apply(self, executor=None, need_restore=True):

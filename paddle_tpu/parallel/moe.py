@@ -1,0 +1,117 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+NEW capability vs the reference (SURVEY.md §2.4: no expert parallelism
+exists in fluid v1.6; the closest analog is the sparse parameter-server
+path, `framework/fleet/fleet_wrapper.h:55`, which shards *tables* across
+hosts).  TPU-native design follows GShard: experts are sharded over the
+'ep' axis, tokens are routed to them with `jax.lax.all_to_all` over ICI,
+and the dispatch/combine maps are dense one-hot tensors so everything is
+static-shaped MXU work — no scatter with data-dependent shapes.
+
+Differentiable end-to-end: all_to_all and the one-hot einsums are linear,
+so jax.vjp routes token grads back through the same ring.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def top1_gating(x, wg, n_experts, capacity):
+    """Top-1 gating (Switch-style) producing dense dispatch/combine maps.
+
+    x: [S, D] local tokens.  wg: [D, E].  Returns
+      dispatch [S, E, C] one-hot, combine [S, E, C] gate-weighted,
+      aux_loss (load-balance loss, Switch eq. 4).
+    """
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # [S, E]
+    expert = jnp.argmax(probs, axis=-1)                     # [S]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot       # [S, E]
+    pos_in_expert = jnp.sum(pos, axis=-1)                   # [S]
+    keep = pos_in_expert < capacity
+    gate = jnp.max(probs * onehot, axis=-1) * keep          # [S]
+    pos_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    # load-balance aux loss: E * sum_e fraction_e * mean_prob_e
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * n_experts
+    return dispatch, combine, aux
+
+
+def moe_ffn_inner(x, wg, w1, w2, axis_name, capacity_factor=2.0):
+    """Call INSIDE shard_map.  Expert-parallel MoE FFN.
+
+    x:  [S, D] tokens local to this shard (any sharding of the batch).
+    wg: [D, E] gate weights (replicated).
+    w1: [E_loc, D, H], w2: [E_loc, H, D] — experts sharded over
+        `axis_name` (E = n_shards * E_loc).
+    Returns ([S, D], aux_loss).
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    e_loc = w1.shape[0]
+    n_experts = n_shards * e_loc
+    s, d = x.shape
+    capacity = max(1, int(capacity_factor * s / n_experts))
+
+    dispatch, combine, aux = top1_gating(x, wg, n_experts, capacity)
+    # gather expert inputs: [E, C, D]
+    expert_in = jnp.einsum('sec,sd->ecd', dispatch, x.astype(jnp.float32))
+    # scatter expert dim over shards, concat token dim:
+    # [E, C, D] -> [E_loc, n_shards * C, D]
+    expert_in = jax.lax.all_to_all(
+        expert_in.reshape(n_shards, e_loc, capacity, d), axis_name, 0, 0
+    ).transpose(1, 0, 2, 3).reshape(e_loc, n_shards * capacity, d)
+    # per-local-expert FFN (vmapped over E_loc -> batched MXU matmuls)
+    h = jax.nn.relu(jnp.einsum('ecd,edh->ech', expert_in, w1))
+    expert_out = jnp.einsum('ech,ehd->ecd', h, w2)
+    # route back: [E_loc, n_shards*C, D] -> [E, C, D] on each shard
+    expert_out = jax.lax.all_to_all(
+        expert_out.reshape(e_loc, n_shards, capacity, d).transpose(
+            1, 0, 2, 3), axis_name, 0, 0).reshape(n_experts, capacity, d)
+    out = jnp.einsum('sec,ecd->sd', combine, expert_out)
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn(x, wg, w1, w2, mesh, axis='ep', capacity_factor=2.0):
+    """Global-array wrapper.  x [B, T, D] with the batch sharded over
+    `axis` (the canonical GShard layout: the expert axis doubles as a
+    data axis for tokens); experts sharded on `axis` via the leading dim
+    of w1 [E, D, H] / w2 [E, H, D].  Returns (out [B, T, D], aux)."""
+    b, t, d = x.shape
+    b_loc = b // mesh.shape[axis]
+
+    def inner(xf, wg_, w1_, w2_):
+        out, aux = moe_ffn_inner(xf.reshape(b_loc * t, d), wg_, w1_, w2_,
+                                 axis, capacity_factor)
+        return out.reshape(b_loc, t, d), jax.lax.pmean(aux, axis)
+
+    f = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis)),
+        out_specs=(P(axis), P()), check_vma=False)
+    return f(x, wg, w1, w2)
+
+
+def reference_moe_ffn(x, wg, w1_full, w2_full, capacity_factor=2.0):
+    """Dense single-device reference: w1_full [E, D, H], w2_full
+    [E, H, D].  Capacity is computed from x's own token count, so to
+    reproduce the sharded version's per-shard capacity semantics, call
+    this on each shard's batch slice and concatenate."""
+    b, t, d = x.shape
+    s = b * t
+    e = w1_full.shape[0]
+    capacity = max(1, int(capacity_factor * s / e))
+    dispatch, combine, aux = top1_gating(x.reshape(s, d), wg, e, capacity)
+    expert_in = jnp.einsum('sec,sd->ecd', dispatch,
+                           x.reshape(s, d).astype(jnp.float32))
+    h = jax.nn.relu(jnp.einsum('ecd,edh->ech', expert_in, w1_full))
+    expert_out = jnp.einsum('ech,ehd->ecd', h, w2_full)
+    out = jnp.einsum('sec,ecd->sd', combine, expert_out)
+    return out.reshape(b, t, d).astype(x.dtype), aux

@@ -69,9 +69,10 @@ class HostShardedEmbedding(object):
             raise RuntimeError('no gradient reached embedding %s'
                                % self.name)
         block = program.current_block()
-        block.append_op('host_emb_update',
-                        inputs={'Ids': self._ids_name, 'Grad': gname},
-                        outputs={}, attrs={'table': self.name})
+        with program._role_guard('optimize'):
+            block.append_op('host_emb_update',
+                            inputs={'Ids': self._ids_name, 'Grad': gname},
+                            outputs={}, attrs={'table': self.name})
 
     # -- host kernels -----------------------------------------------------
     def _pull(self, ids):

@@ -1,0 +1,77 @@
+"""clone(for_test=True) must prune backward/optimize ops so eval never
+mutates state (reference: Program.clone framework.py:3839 +
+core.prune_backward)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _net():
+    x = fluid.layers.data('x', shape=[8], dtype='float32')
+    y = fluid.layers.data('y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(x, 16, act='relu')
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return loss
+
+
+def _batch(rng, n=16):
+    xs = rng.randn(n, 8).astype('float32')
+    ys = rng.randn(n, 1).astype('float32')
+    return xs, ys
+
+
+def _eval_twice(test_prog, startup, loss, feed):
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        e1, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        e2, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+    return float(np.asarray(e1).ravel()[0]), float(np.asarray(e2).ravel()[0])
+
+
+def test_clone_prunes_optimizer():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        loss = _net()
+        fluid.optimizer.Adam(0.1).minimize(loss)
+    t = main.clone(for_test=True)
+    assert all(op.attrs.get('__op_role__') == 'forward'
+               for op in t.global_block().ops)
+    rng = np.random.RandomState(0)
+    xs, ys = _batch(rng)
+    e1, e2 = _eval_twice(t, startup, loss, {'x': xs, 'y': ys})
+    assert e1 == e2, (e1, e2)
+
+
+def test_clone_prunes_amp_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        loss = _net()
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(0.1), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    t = main.clone(for_test=True)
+    kept = [op.type for op in t.global_block().ops]
+    assert 'check_finite_and_unscale' not in kept
+    assert 'update_loss_scaling' not in kept
+    rng = np.random.RandomState(1)
+    xs, ys = _batch(rng)
+    e1, e2 = _eval_twice(t, startup, loss, {'x': xs, 'y': ys})
+    assert e1 == e2, (e1, e2)
+
+
+def test_clone_prunes_model_average():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss = _net()
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        fluid.optimizer.ModelAverage(0.15)
+    t = main.clone(for_test=True)
+    kept = [op.type for op in t.global_block().ops]
+    assert 'increment' not in kept
