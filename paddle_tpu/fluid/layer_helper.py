@@ -31,9 +31,11 @@ class LayerHelper(object):
     def startup_program(self):
         return framework.default_startup_program()
 
-    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
         return self.main_program.current_block().append_op(
-            type, inputs=inputs, outputs=outputs, attrs=attrs)
+            type, inputs=inputs, outputs=outputs, attrs=attrs,
+            infer_shape=infer_shape)
 
     def create_variable_for_type_inference(self, dtype,
                                            stop_gradient=False):

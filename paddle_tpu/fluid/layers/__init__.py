@@ -19,7 +19,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
 from .rnn import (  # noqa: F401
-    dynamic_lstm, dynamic_gru, lstm_unit)
+    dynamic_lstm, dynamic_gru, lstm_unit, beam_search, gather_tree)
 from .sequence_lod import (  # noqa: F401
     sequence_pool, sequence_softmax, sequence_expand, sequence_reshape,
     sequence_first_step, sequence_last_step, sequence_conv)

@@ -86,3 +86,36 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
                             _nn.elementwise_mul(i, g))
     h = _nn.elementwise_mul(o, _ops.tanh(c))
     return h, c
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None):
+    """One dense beam-search step (TPU-native: static [B,K] beams, see
+    ops/lang_ops.py beam_search).  `scores` are per-candidate log-probs
+    [B,K,V]; returns (selected_ids [B,K], selected_scores [B,K],
+    parent_idx [B,K])."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('beam_search', name=name)
+    ids = helper.create_variable_for_type_inference('int64')
+    sel = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference('int64')
+    helper.append_op('beam_search',
+                     inputs={'PreIds': pre_ids, 'PreScores': pre_scores,
+                             'Scores': scores},
+                     outputs={'SelectedIds': ids, 'SelectedScores': sel,
+                              'ParentIdx': parent},
+                     attrs={'beam_size': beam_size, 'end_id': end_id})
+    for v in (ids, parent):
+        v.stop_gradient = True
+    return ids, sel, parent
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search parents into full sequences:
+    ids/parents [T,B,K] -> [T,B,K]."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('gather_tree')
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op('gather_tree', inputs={'Ids': ids, 'Parents': parents},
+                     outputs={'Out': out})
+    out.stop_gradient = True
+    return out

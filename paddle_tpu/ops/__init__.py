@@ -22,3 +22,4 @@ from . import fused_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import lang_ops  # noqa: F401

@@ -1,0 +1,503 @@
+"""Structured-prediction / language ops: CRF, CTC, NCE, hierarchical
+softmax, beam search, edit distance.
+
+Reference: paddle/fluid/operators/linear_chain_crf_op.h (ForwardOneSequence),
+crf_decoding_op.h, chunk_eval_op.h, warpctc_op.cc, ctc_align_op.h,
+edit_distance_op.h, nce_op.h, hierarchical_sigmoid_op.h (+
+operators/math/matrix_bit_code.h SimpleCode), beam_search_op.cc,
+gather_tree_op.cc, cos_sim_op.h.
+
+TPU-native re-design: the reference walks LoD sequences with scalar C++
+loops; here every op is a static-shape scan/vmap over padded [B, T]
+batches with explicit lengths, so XLA maps the recurrences to fused
+device loops and the batch dim to the MXU/VPU.  Gradients come from
+jax.vjp over the same lowering (no hand-written grad kernels).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_host
+
+
+def _lengths_of(ins, bt_shape, slot='Length'):
+    """Length [B] from the named slot, else full T."""
+    if ins.get(slot):
+        return ins[slot][0].reshape(-1).astype(jnp.int32)
+    b, t = bt_shape
+    return jnp.full((b,), t, jnp.int32)
+
+
+# --------------------------------------------------------------------- CRF
+
+def _crf_nll_one(x, label, length, w_start, w_end, w):
+    """Negative log-likelihood of one padded sequence.
+    x [T, D] emissions, label [T] int, length scalar."""
+    t_max, d = x.shape
+    alpha0 = w_start + x[0]
+
+    def body(alpha, k):
+        new = jax.nn.logsumexp(alpha[:, None] + w, axis=0) + x[k]
+        alpha = jnp.where(k < length, new, alpha)
+        return alpha, alpha
+
+    alpha_last, alphas = jax.lax.scan(body, alpha0,
+                                      jnp.arange(1, t_max))
+    log_z = jax.nn.logsumexp(alpha_last + w_end)
+
+    pos = jnp.arange(t_max)
+    mask = (pos < length).astype(x.dtype)
+    emit = jnp.sum(jnp.take_along_axis(x, label[:, None], 1)[:, 0] * mask)
+    pair = w[label[:-1], label[1:]] * mask[1:]
+    last = label[jnp.maximum(length - 1, 0)]
+    score = w_start[label[0]] + emit + jnp.sum(pair) + w_end[last]
+    alpha_full = jnp.concatenate([alpha0[None], alphas], axis=0)
+    return log_z - score, alpha_full
+
+
+@register('linear_chain_crf',
+          no_grad_out_slots=('Alpha', 'EmissionExps', 'TransitionExps'))
+def linear_chain_crf(ctx, ins, attrs):
+    """Emission [B,T,D], Transition [D+2,D] (row0=start, row1=end,
+    rows 2..=pairwise), Label [B,T], Length [B] ->
+    LogLikelihood [B,1] (the reference returns -ll, i.e. a cost:
+    linear_chain_crf_op.h:216), Alpha [B,T,D] (log-domain forward table;
+    the reference stores L1-normalized probabilities — intermediate
+    only, consumed by nothing but its own backward)."""
+    x = ins['Emission'][0]
+    trans = ins['Transition'][0]
+    label = ins['Label'][0].astype(jnp.int32)
+    if label.ndim == 3:
+        label = label[..., 0]
+    lengths = _lengths_of(ins, x.shape[:2])
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+    nll, alpha = jax.vmap(
+        lambda xi, li, ni: _crf_nll_one(xi, li, ni, w_start, w_end, w)
+    )(x, label, lengths)
+    return {'LogLikelihood': [nll[:, None]],
+            'Alpha': [alpha],
+            'EmissionExps': [jnp.exp(x - jnp.max(x, -1, keepdims=True))],
+            'TransitionExps': [jnp.exp(trans)]}
+
+
+def _viterbi_one(x, length, w_start, w_end, w):
+    """Viterbi path of one padded sequence: x [T,D] -> path [T] int32."""
+    t_max, d = x.shape
+    alpha0 = w_start + x[0]
+
+    def fwd(alpha, k):
+        scores = alpha[:, None] + w              # [from, to]
+        best = jnp.max(scores, axis=0) + x[k]
+        bp = jnp.argmax(scores, axis=0).astype(jnp.int32)
+        new_alpha = jnp.where(k < length, best, alpha)
+        bp = jnp.where(k < length, bp, jnp.arange(d, dtype=jnp.int32))
+        return new_alpha, bp
+
+    alpha_last, bps = jax.lax.scan(fwd, alpha0, jnp.arange(1, t_max))
+    last_tag = jnp.argmax(alpha_last + w_end).astype(jnp.int32)
+
+    def back(tag, bp):
+        prev = bp[tag]
+        return prev, tag
+
+    # scan emits tag_{i+1} at index i and carries tag_i backwards, so the
+    # final carry is tag_0 and ys = [tag_1 .. tag_{T-1}]
+    tag0, tail = jax.lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([tag0[None], tail])
+    # positions beyond length: 0 (reference zero-fills the padded tail)
+    return jnp.where(jnp.arange(t_max) < length, path, 0)
+
+
+@register('crf_decoding', no_grad_out_slots=('ViterbiPath',))
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode.  With Label given, outputs per-position
+    correctness 0/1 instead of the path (crf_decoding_op.h:99)."""
+    x = ins['Emission'][0]
+    trans = ins['Transition'][0]
+    lengths = _lengths_of(ins, x.shape[:2])
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+    path = jax.vmap(
+        lambda xi, ni: _viterbi_one(xi, ni, w_start, w_end, w)
+    )(x, lengths)
+    if ins.get('Label'):
+        label = ins['Label'][0].astype(jnp.int32)
+        if label.ndim == 3:
+            label = label[..., 0]
+        mask = jnp.arange(x.shape[1])[None, :] < lengths[:, None]
+        path = jnp.where(mask & (label == path), 1, 0)
+    return {'ViterbiPath': [path.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------- chunk_eval
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    'IOB': (2, 0, 1, -1, -1),
+    'IOE': (2, -1, 0, 1, -1),
+    'IOBES': (4, 0, 1, 2, 3),
+    'plain': (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_end(prev_tag, prev_type, tag, type_, other, tb, ti, te, ts):
+    if prev_type == other:
+        return False
+    if type_ == other:
+        return True
+    if type_ != prev_type:
+        return True
+    if prev_tag == tb or prev_tag == ti:
+        return tag == tb or tag == ts
+    if prev_tag == te or prev_tag == ts:
+        return True
+    return False
+
+
+def _chunk_begin(prev_tag, prev_type, tag, type_, other, tb, ti, te, ts):
+    if prev_type == other:
+        return type_ != other
+    if type_ == other:
+        return False
+    if type_ != prev_type:
+        return True
+    if tag == tb or tag == ts:
+        return True
+    if tag == ti or tag == te:
+        return prev_tag == te or prev_tag == ts
+    return False
+
+
+def _get_segments(labels, num_tag_types, other, tb, ti, te, ts):
+    """Port of chunk_eval_op.h GetSegments."""
+    segments = []
+    chunk_start, in_chunk = 0, False
+    tag, type_ = -1, other
+    for i, lab in enumerate(labels):
+        prev_tag, prev_type = tag, type_
+        tag = int(lab) % num_tag_types
+        type_ = int(lab) // num_tag_types
+        if in_chunk and _chunk_end(prev_tag, prev_type, tag, type_,
+                                   other, tb, ti, te, ts):
+            segments.append((chunk_start, i - 1, prev_type))
+            in_chunk = False
+        if _chunk_begin(prev_tag, prev_type, tag, type_,
+                        other, tb, ti, te, ts):
+            chunk_start = i
+            in_chunk = True
+    if in_chunk:
+        segments.append((chunk_start, len(labels) - 1, type_))
+    return segments
+
+
+@register_host('chunk_eval')
+def chunk_eval(executor, scope, op):
+    """Host metric op (no gradient; reference runs it CPU-only too)."""
+    from ..fluid import core
+    infer = np.asarray(core.as_array(
+        scope.find_var(op.input('Inference')[0])))
+    label = np.asarray(core.as_array(scope.find_var(op.input('Label')[0])))
+    if infer.ndim == 3:
+        infer = infer[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    seq_len_in = op.input('SeqLength')
+    if seq_len_in:
+        lengths = np.asarray(core.as_array(
+            scope.find_var(seq_len_in[0]))).reshape(-1).astype(np.int64)
+    else:
+        lengths = np.full((infer.shape[0],), infer.shape[1], np.int64)
+    scheme = op.attr('chunk_scheme', 'IOB')
+    num_tag_types, tb, ti, te, ts = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types = int(op.attr('num_chunk_types'))
+    excluded = set(op.attr('excluded_chunk_types', []) or [])
+
+    n_infer = n_label = n_correct = 0
+    for i in range(infer.shape[0]):
+        ln = int(lengths[i])
+        segs_i = _get_segments(infer[i, :ln], num_tag_types, other,
+                               tb, ti, te, ts)
+        segs_l = _get_segments(label[i, :ln], num_tag_types, other,
+                               tb, ti, te, ts)
+        segs_i = [s for s in segs_i if s[2] not in excluded]
+        segs_l = [s for s in segs_l if s[2] not in excluded]
+        n_infer += len(segs_i)
+        n_label += len(segs_l)
+        n_correct += len(set(segs_i) & set(segs_l))
+
+    p = n_correct / n_infer if n_infer else 0.0
+    r = n_correct / n_label if n_label else 0.0
+    f1 = 2 * p * r / (p + r) if n_correct else 0.0
+    outs = {'Precision': np.array([p], np.float32),
+            'Recall': np.array([r], np.float32),
+            'F1-Score': np.array([f1], np.float32),
+            'NumInferChunks': np.array([n_infer], np.int64),
+            'NumLabelChunks': np.array([n_label], np.int64),
+            'NumCorrectChunks': np.array([n_correct], np.int64)}
+    for slot, val in outs.items():
+        names = op.output(slot)
+        if names:
+            scope.set_var(names[0], val)
+
+
+# --------------------------------------------------------------------- CTC
+
+@register('warpctc', no_grad_out_slots=('WarpCTCGrad',))
+def warpctc(ctx, ins, attrs):
+    """CTC loss on padded batches (reference: warpctc_op.cc dynload of
+    lib warp-ctc; here the log-domain forward recursion runs on device
+    via optax.ctc_loss).  Logits [B,T,V], Label [B,L],
+    LogitsLength [B], LabelLength [B] -> Loss [B,1]."""
+    import optax
+    logits = ins['Logits'][0]
+    label = ins['Label'][0].astype(jnp.int32)
+    b, t, v = logits.shape
+    lo_len = _lengths_of(ins, (b, t), 'LogitsLength')
+    la_len = _lengths_of(ins, (b, label.shape[1]), 'LabelLength')
+    blank = attrs.get('blank', 0)
+    logit_pad = (jnp.arange(t)[None, :] >= lo_len[:, None]).astype(
+        jnp.float32)
+    label_pad = (jnp.arange(label.shape[1])[None, :] >=
+                 la_len[:, None]).astype(jnp.float32)
+    loss = optax.ctc_loss(logits, logit_pad, label, label_pad,
+                          blank_id=blank)
+    if attrs.get('norm_by_times'):
+        loss = loss / jnp.maximum(lo_len.astype(loss.dtype), 1.0)
+    return {'Loss': [loss[:, None]],
+            'WarpCTCGrad': [jnp.zeros_like(logits)]}
+
+
+@register('ctc_align', no_grad_out_slots=('Output', 'OutputLength'))
+def ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode: merge repeats then drop blanks
+    (ctc_align_op.h).  Input [B,T] int, InputLength [B] ->
+    Output [B,T] left-aligned, padded with padding_value."""
+    x = ins['Input'][0].astype(jnp.int32)
+    b, t = x.shape
+    lengths = _lengths_of(ins, (b, t), 'InputLength')
+    blank = attrs.get('blank', 0)
+    pad = attrs.get('padding_value', 0)
+    pos = jnp.arange(t)[None, :]
+    valid = pos < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32), x[:, :-1]], 1)
+    keep = (x != blank) & (x != prev) & valid
+    # stable-compact kept elements to the left via argsort of ~keep
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(pos < out_len[:, None], compacted, pad)
+    return {'Output': [out.astype(jnp.int64)],
+            'OutputLength': [out_len[:, None].astype(jnp.int64)]}
+
+
+def _remove_tokens(x, lengths, tokens):
+    """Drop the given token ids from padded [B, L] sequences
+    (stable left-compaction), returning (compacted, new_lengths)."""
+    b, l = x.shape
+    pos = jnp.arange(l)[None, :]
+    keep = pos < lengths[:, None]
+    for tok in tokens:
+        keep &= x != tok
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(x, order, axis=1)
+    return out, jnp.sum(keep, axis=1).astype(jnp.int32)
+
+
+@register('edit_distance', no_grad_out_slots=('Out', 'SequenceNum'))
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between padded int sequences
+    (edit_distance_op.h): Hyps [B,L1], Refs [B,L2] (+lengths) ->
+    Out [B,1] float32, SequenceNum [1]."""
+    hyp = ins['Hyps'][0].astype(jnp.int32)
+    ref = ins['Refs'][0].astype(jnp.int32)
+    b, l1 = hyp.shape
+    l2 = ref.shape[1]
+    h_len = _lengths_of(ins, (b, l1), 'HypsLength')
+    r_len = _lengths_of(ins, (b, l2), 'RefsLength')
+    ignored = attrs.get('ignored_tokens') or ()
+    if len(ignored):
+        hyp, h_len = _remove_tokens(hyp, h_len, ignored)
+        ref, r_len = _remove_tokens(ref, r_len, ignored)
+
+    def one(h, r, hn, rn):
+        # DP over rows of the (l1+1) x (l2+1) table; row i edits h[:i]
+        row0 = jnp.arange(l2 + 1, dtype=jnp.float32)
+        cols = jnp.arange(1, l2 + 1)
+
+        def body(prev_row, i):
+            hi = h[i - 1]
+
+            def cell(left, j):
+                sub = prev_row[j - 1] + (hi != r[j - 1])
+                dele = prev_row[j] + 1.0
+                insr = left + 1.0
+                return jnp.minimum(jnp.minimum(sub, dele), insr)
+
+            new_row, vals = jax.lax.scan(
+                lambda carry, j: (cell(carry, j),) * 2,
+                i.astype(jnp.float32), cols)
+            row = jnp.concatenate([i[None].astype(jnp.float32), vals])
+            row = jnp.where(i <= hn, row, prev_row)
+            return row, None
+
+        last_row, _ = jax.lax.scan(body, row0,
+                                   jnp.arange(1, l1 + 1))
+        d = last_row[rn]
+        return d
+
+    dist = jax.vmap(one)(hyp, ref, h_len, r_len)
+    if attrs.get('normalized', False):
+        dist = dist / jnp.maximum(r_len.astype(dist.dtype), 1.0)
+    return {'Out': [dist[:, None]],
+            'SequenceNum': [jnp.asarray([b], jnp.int64)]}
+
+
+# ---------------------------------------------------------------- sampling
+
+@register('nce', no_grad_out_slots=('SampleLogits', 'SampleLabels'))
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (nce_op.h) with a uniform sampler on
+    device: Input [B,D], Weight [V,D], Bias [V], Label [B,num_true] ->
+    Cost [B,1]."""
+    x = ins['Input'][0]
+    w = ins['Weight'][0]
+    bias = ins['Bias'][0].reshape(-1) if ins.get('Bias') else None
+    label = ins['Label'][0].astype(jnp.int32)
+    if label.ndim == 1:
+        label = label[:, None]
+    b, d = x.shape
+    v = w.shape[0]
+    num_true = label.shape[1]
+    num_neg = int(attrs.get('num_neg_samples', 10))
+    sampler = attrs.get('sampler', 'uniform')
+    if sampler not in ('uniform', 0):
+        raise NotImplementedError(
+            'nce: only the uniform sampler is implemented (got %r)'
+            % (sampler,))
+
+    neg = jax.random.randint(
+        ctx.rng(salt=1 + int(attrs.get('seed', 0) or 0)),
+        (b, num_neg), 0, v, dtype=jnp.int32)
+    p_uniform = 1.0 / v
+
+    def logits_of(ids):
+        wl = w[ids]                                  # [B, K, D]
+        z = jnp.einsum('bkd,bd->bk', wl, x)
+        if bias is not None:
+            z = z + bias[ids]
+        return z
+
+    # logit - log(num_neg * P(w)): NCE's unigram correction
+    corr = jnp.log(num_neg * p_uniform)
+    z_true = logits_of(label) - corr
+    z_neg = logits_of(neg) - corr
+    pos_loss = jnp.sum(jax.nn.softplus(-z_true), axis=1)
+    neg_loss = jnp.sum(jax.nn.softplus(z_neg), axis=1)
+    cost = (pos_loss + neg_loss) / num_true
+    return {'Cost': [cost[:, None]],
+            'SampleLogits': [jnp.concatenate([z_true, z_neg], 1)],
+            'SampleLabels': [jnp.concatenate(
+                [label, neg], 1).astype(jnp.int64)]}
+
+
+@register('hierarchical_sigmoid', no_grad_out_slots=('PreOut',))
+def hierarchical_sigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over the default complete binary tree
+    (hierarchical_sigmoid_op.h + math/matrix_bit_code.h SimpleCode:
+    code = label + num_classes, node(bit b) = (code >> (b+1)) - 1,
+    bit value = (code >> b) & 1, path length = floor(log2(code))).
+    X [B,D], W [C-1,D], Bias [C-1], Label [B] -> Out [B,1]."""
+    x = ins['X'][0]
+    w = ins['W'][0]
+    bias = ins['Bias'][0].reshape(-1) if ins.get('Bias') else None
+    label = ins['Label'][0].reshape(-1).astype(jnp.int32)
+    num_classes = int(attrs['num_classes'])
+    b, d = x.shape
+    max_len = int(np.floor(np.log2(max(2 * num_classes - 1, 2))))
+
+    code = label + num_classes                       # [B]
+    # path length = floor(log2(code)), computed in integer arithmetic
+    # (float log2 is off by one ulp at exact powers of two, e.g. 32768)
+    length = jnp.sum((code[:, None] >> jnp.arange(
+        1, max_len + 1, dtype=jnp.int32)[None, :]) > 0,
+        axis=1).astype(jnp.int32)
+    bits_idx = jnp.arange(max_len, dtype=jnp.int32)  # [L]
+    node = (code[:, None] >> (bits_idx[None, :] + 1)) - 1    # [B, L]
+    bit = ((code[:, None] >> bits_idx[None, :]) & 1).astype(x.dtype)
+    mask = (bits_idx[None, :] < length[:, None]).astype(x.dtype)
+
+    node_c = jnp.clip(node, 0, w.shape[0] - 1)
+    z = jnp.einsum('bld,bd->bl', w[node_c], x)
+    if bias is not None:
+        z = z + bias[node_c]
+    # sigmoid cross entropy with the path bit as label
+    loss = (jax.nn.softplus(z) - bit * z) * mask
+    return {'Out': [jnp.sum(loss, axis=1, keepdims=True)],
+            'PreOut': [z]}
+
+
+# --------------------------------------------------------------- similarity
+
+@register('cos_sim')
+def cos_sim(ctx, ins, attrs):
+    """cos_sim_op.h: X [B,D], Y [B,D] or [1,D] -> Out [B,1]."""
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
+    dot = jnp.sum(x * y, axis=1, keepdims=True)
+    eps = jnp.asarray(1e-12, x.dtype)
+    out = dot / jnp.maximum(xn * yn, eps)
+    return {'Out': [out], 'XNorm': [xn], 'YNorm': [yn]}
+
+
+# -------------------------------------------------------------- beam search
+
+@register('beam_search',
+          no_grad_out_slots=('SelectedIds', 'SelectedScores', 'ParentIdx'))
+def beam_search(ctx, ins, attrs):
+    """One dense beam-search step (TPU-native redesign of
+    beam_search_op.cc, which walks LoD beams on CPU): static [B, K]
+    beams, no ragged pruning — finished beams are forced to extend with
+    end_id at zero added score.
+    PreIds [B,K], PreScores [B,K], Scores [B,K,V] (log-probs) ->
+    SelectedIds [B,K], SelectedScores [B,K], ParentIdx [B,K]."""
+    pre_ids = ins['PreIds'][0].astype(jnp.int32)
+    pre_scores = ins['PreScores'][0]
+    scores = ins['Scores'][0]
+    b, k, v = scores.shape
+    end_id = int(attrs.get('end_id', 1))
+    neg_inf = jnp.asarray(-1e9, scores.dtype)
+
+    finished = pre_ids == end_id                     # [B, K]
+    # finished beams: only end_id continuation, with 0 added score
+    onehot_end = jax.nn.one_hot(end_id, v, dtype=scores.dtype)
+    frozen = jnp.where(onehot_end[None, None] > 0, 0.0, neg_inf)
+    total = pre_scores[..., None] + jnp.where(
+        finished[..., None], frozen, scores)         # [B, K, V]
+    flat = total.reshape(b, k * v)
+    sel_scores, flat_idx = jax.lax.top_k(flat, k)
+    parent = (flat_idx // v).astype(jnp.int32)
+    ids = (flat_idx % v).astype(jnp.int32)
+    return {'SelectedIds': [ids.astype(jnp.int64)],
+            'SelectedScores': [sel_scores],
+            'ParentIdx': [parent.astype(jnp.int64)]}
+
+
+@register('gather_tree', no_grad_out_slots=('Out',))
+def gather_tree(ctx, ins, attrs):
+    """Backtrace beam parents into full sequences (gather_tree_op.cc):
+    Ids [T,B,K], Parents [T,B,K] -> Out [T,B,K]."""
+    ids = ins['Ids'][0].astype(jnp.int32)
+    parents = ins['Parents'][0].astype(jnp.int32)
+    t, b, k = ids.shape
+
+    def body(beam, inp):
+        step_ids, step_parents = inp
+        out = jnp.take_along_axis(step_ids, beam, axis=1)    # [B, K]
+        beam = jnp.take_along_axis(step_parents, beam, axis=1)
+        return beam, out
+
+    init = jnp.tile(jnp.arange(k, dtype=jnp.int32)[None], (b, 1))
+    _, outs = jax.lax.scan(body, init, (ids, parents), reverse=True)
+    return {'Out': [outs.astype(jnp.int64)]}

@@ -12,8 +12,6 @@ Differentiable end-to-end: all_to_all and the one-hot einsums are linear,
 so jax.vjp routes token grads back through the same ring.
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P

@@ -1,0 +1,233 @@
+"""Book-model integration tests (reference tests/book/):
+label_semantic_roles (CRF), machine_translation / rnn_encoder_decoder
+(seq2seq + beam search), recommender_system (cos_sim).  Together with
+test_book.py, test_models.py and test_rnn.py this covers all 9 reference
+book models with loss-decrease assertions."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _run_train(main, startup, loss, batch_fn, steps=25):
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            l, = exe.run(main, feed=batch_fn(), fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    return losses
+
+
+def test_label_semantic_roles_crf():
+    """Embedding -> lstm -> emission fc -> linear_chain_crf, then
+    crf_decoding + chunk_eval on the eval clone (book ch. 7)."""
+    vocab, emb_dim, hid, n_tags, t = 60, 16, 16, 5, 12
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data('words', shape=[t], dtype='int64')
+        target = fluid.layers.data('target', shape=[t], dtype='int64')
+        length = fluid.layers.data('length', shape=[1], dtype='int64')
+        mask = fluid.layers.data('mask', shape=[t], dtype='float32')
+        emb = fluid.layers.embedding(words, size=[vocab, emb_dim])
+        proj = fluid.layers.fc(emb, size=4 * hid, num_flatten_dims=2)
+        hidden, _ = fluid.layers.dynamic_lstm(proj, size=4 * hid,
+                                              mask=mask)
+        emission = fluid.layers.fc(hidden, size=n_tags,
+                                   num_flatten_dims=2)
+        crf_attr = fluid.ParamAttr(name='crfw')
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, target, param_attr=crf_attr, length=length)
+        loss = fluid.layers.mean(crf_cost)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+        decoded = fluid.layers.crf_decoding(emission, crf_attr,
+                                            length=length)
+
+    rng = np.random.RandomState(0)
+
+    def batch(n=16):
+        w = rng.randint(0, vocab, (n, t)).astype('int64')
+        lens = rng.randint(3, t + 1, n)
+        m = (np.arange(t)[None] < lens[:, None]).astype('float32')
+        # learnable mapping: tag = word % n_tags
+        tags = (w % n_tags).astype('int64')
+        return {'words': w, 'target': tags,
+                'length': lens[:, None].astype('int64'), 'mask': m}
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for _ in range(40):
+            l, = exe.run(main, feed=batch(), fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        # decode path on a fresh batch and sanity-check tag range
+        b = batch(4)
+        d, = exe.run(main, feed=b, fetch_list=[decoded])
+        d = np.asarray(d)
+        assert d.shape == (4, t)
+        assert (d >= 0).all() and (d < n_tags).all()
+
+
+def test_machine_translation_seq2seq_beam_decode():
+    """GRU encoder -> GRU decoder w/ teacher forcing (book ch. 8), then
+    step-by-step beam-search decode with layers.beam_search +
+    gather_tree."""
+    src_vocab, tgt_vocab, emb_dim, hid, ts, tt = 40, 30, 16, 16, 8, 6
+    beam, end_id = 3, 1
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 12
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data('src', shape=[ts], dtype='int64')
+        tgt_in = fluid.layers.data('tgt_in', shape=[tt], dtype='int64')
+        tgt_out = fluid.layers.data('tgt_out', shape=[tt], dtype='int64')
+        semb = fluid.layers.embedding(src, size=[src_vocab, emb_dim],
+                                      param_attr=fluid.ParamAttr('semb'))
+        sproj = fluid.layers.fc(semb, size=3 * hid, num_flatten_dims=2)
+        enc = fluid.layers.dynamic_gru(sproj, size=hid)
+        enc_last = fluid.layers.sequence_pool(enc, 'last')
+        temb = fluid.layers.embedding(tgt_in, size=[tgt_vocab, emb_dim],
+                                      param_attr=fluid.ParamAttr('temb'))
+        tproj = fluid.layers.fc(temb, size=3 * hid, num_flatten_dims=2,
+                                param_attr=fluid.ParamAttr('tproj_w'),
+                                bias_attr=fluid.ParamAttr('tproj_b'))
+        dec = fluid.layers.dynamic_gru(tproj, size=hid, h_0=enc_last,
+                                       param_attr=fluid.ParamAttr('dgru'),
+                                       bias_attr=fluid.ParamAttr('dgru_b'))
+        logits = fluid.layers.fc(dec, size=tgt_vocab, num_flatten_dims=2,
+                                 param_attr=fluid.ParamAttr('out_w'),
+                                 bias_attr=fluid.ParamAttr('out_b'))
+        probs = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(
+            fluid.layers.reshape(probs, [-1, tgt_vocab]),
+            fluid.layers.reshape(tgt_out, [-1, 1])))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    rng = np.random.RandomState(1)
+
+    def batch(n=16):
+        s = rng.randint(2, src_vocab, (n, ts)).astype('int64')
+        # toy task: t[0] = s[0] % V, t[k] = (t[k-1] + 3) % V — learnable
+        # from teacher-forcing input + encoder state
+        t_full = np.zeros((n, tt), 'int64')
+        t_full[:, 0] = s[:, 0] % tgt_vocab
+        for k in range(1, tt):
+            t_full[:, k] = (t_full[:, k - 1] + 3) % tgt_vocab
+        t_in = np.concatenate(
+            [np.zeros((n, 1), 'int64'), t_full[:, :-1]], 1)
+        return {'src': s, 'tgt_in': t_in, 'tgt_out': t_full}
+
+    losses = _run_train(main, startup, loss, batch, steps=40)
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+    # ---- step-by-step beam decode program (single decode step) ----
+    step_prog, step_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(step_prog, step_startup):
+        pre_ids = fluid.layers.data('pre_ids', shape=[beam], dtype='int64')
+        pre_scores = fluid.layers.data('pre_scores', shape=[beam],
+                                       dtype='float32')
+        h_in = fluid.layers.data('h_in', shape=[beam, hid],
+                                 dtype='float32')
+        temb2 = fluid.layers.embedding(
+            pre_ids, size=[tgt_vocab, emb_dim],
+            param_attr=fluid.ParamAttr('temb'))            # share weights
+        flat = fluid.layers.reshape(temb2, [-1, emb_dim])
+        tproj2 = fluid.layers.fc(flat, size=3 * hid,
+                                 param_attr=fluid.ParamAttr('tproj_w'),
+                                 bias_attr=fluid.ParamAttr('tproj_b'))
+        seq = fluid.layers.reshape(tproj2, [-1, 1, 3 * hid])
+        h_flat = fluid.layers.reshape(h_in, [-1, hid])
+        dec2 = fluid.layers.dynamic_gru(
+            seq, size=hid, h_0=h_flat,
+            param_attr=fluid.ParamAttr('dgru'),
+            bias_attr=fluid.ParamAttr('dgru_b'))
+        h_new = fluid.layers.reshape(dec2, [-1, beam, hid])
+        logits2 = fluid.layers.fc(
+            fluid.layers.reshape(dec2, [-1, hid]), size=tgt_vocab,
+            param_attr=fluid.ParamAttr('out_w'),
+            bias_attr=fluid.ParamAttr('out_b'))
+        logp = fluid.layers.log_softmax(logits2)
+        scores3 = fluid.layers.reshape(logp, [-1, beam, tgt_vocab])
+        sel_ids, sel_scores, parents = fluid.layers.beam_search(
+            pre_ids, pre_scores, scores3, beam_size=beam, end_id=end_id)
+
+    # encoder program to get h0
+    enc_prog = main.clone(for_test=True)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        # params are shared by name; startup of step_prog would clobber
+        # them, so only run it for vars not already initialized (none).
+        b = batch(2)
+        h0, = exe.run(enc_prog, feed=b, fetch_list=[enc_last])
+        h0 = np.asarray(h0)
+        n = h0.shape[0]
+        ids = np.zeros((n, beam), 'int64')
+        scores = np.full((n, beam), -1e9, 'float32')
+        scores[:, 0] = 0.0                       # one live beam at start
+        h = np.tile(h0[:, None, :], (1, beam, 1)).astype('float32')
+        all_ids, all_parents = [], []
+        for _ in range(tt):
+            ids_v, sc_v, par_v, h_v = exe.run(
+                step_prog,
+                feed={'pre_ids': ids, 'pre_scores': scores, 'h_in': h},
+                fetch_list=[sel_ids, sel_scores, parents, h_new])
+            ids, scores, par = (np.asarray(ids_v), np.asarray(sc_v),
+                                np.asarray(par_v))
+            h = np.take_along_axis(np.asarray(h_v),
+                                   par[:, :, None], axis=1)
+            all_ids.append(ids)
+            all_parents.append(par)
+        idst = np.stack(all_ids)                  # [T, B, K]
+        part = np.stack(all_parents)
+        dec_prog = fluid.Program()
+        with fluid.program_guard(dec_prog, fluid.Program()):
+            iv = fluid.layers.data('ids', shape=[n, beam], dtype='int64')
+            pv = fluid.layers.data('parents', shape=[n, beam],
+                                   dtype='int64')
+            tree = fluid.layers.gather_tree(iv, pv)
+        tr, = exe.run(dec_prog, feed={'ids': idst, 'parents': part},
+                      fetch_list=[tree])
+        tr = np.asarray(tr)
+        assert tr.shape == (tt, n, beam)
+        assert (tr >= 0).all() and (tr < tgt_vocab).all()
+
+
+def test_recommender_system_cos_sim():
+    """User/item embeddings -> cos_sim -> scaled rating, square error
+    (book ch. 5)."""
+    n_users, n_items, dim = 30, 40, 16
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        uid = fluid.layers.data('uid', shape=[1], dtype='int64')
+        mid = fluid.layers.data('mid', shape=[1], dtype='int64')
+        rating = fluid.layers.data('rating', shape=[1], dtype='float32')
+        uemb = fluid.layers.embedding(uid, size=[n_users, dim])
+        memb = fluid.layers.embedding(mid, size=[n_items, dim])
+        uvec = fluid.layers.fc(fluid.layers.reshape(uemb, [-1, dim]), 32,
+                               act='relu')
+        mvec = fluid.layers.fc(fluid.layers.reshape(memb, [-1, dim]), 32,
+                               act='relu')
+        sim = fluid.layers.cos_sim(uvec, mvec)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, rating))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    rng = np.random.RandomState(2)
+    true_u = rng.randn(n_users, 4)
+    true_m = rng.randn(n_items, 4)
+
+    def batch(n=32):
+        u = rng.randint(0, n_users, (n, 1)).astype('int64')
+        m = rng.randint(0, n_items, (n, 1)).astype('int64')
+        r = np.clip((true_u[u[:, 0]] * true_m[m[:, 0]]).sum(1), -5, 5)
+        return {'uid': u, 'mid': m,
+                'rating': r[:, None].astype('float32')}
+
+    losses = _run_train(main, startup, loss, batch, steps=40)
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])

@@ -1,0 +1,348 @@
+"""CRF / CTC / NCE / hsigmoid / beam search / edit distance op tests.
+
+Mirrors the reference's OpTest style (op_test.py): numpy reference
+implementations (brute force where feasible) vs the op lowerings."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops import registry
+
+
+def run_op(op_type, ins, attrs=None):
+    d = registry.get(op_type)
+    from paddle_tpu.ops.registry import LowerCtx
+    ctx = LowerCtx(step=jnp.asarray(0, jnp.int32), op_seed=7)
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return d.fn(ctx, ins, dict(attrs or {}))
+
+
+# ----------------------------------------------------------------- CRF
+
+def crf_brute(x, trans, label, length):
+    """Brute-force -log p(label) by enumerating all tag paths."""
+    d = x.shape[-1]
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+
+    def score(path):
+        s = w_start[path[0]] + x[0, path[0]] + w_end[path[-1]]
+        for k in range(1, len(path)):
+            s += x[k, path[k]] + w[path[k - 1], path[k]]
+        return s
+
+    logz = None
+    for path in itertools.product(range(d), repeat=length):
+        s = score(path)
+        logz = s if logz is None else np.logaddexp(logz, s)
+    return logz - score(tuple(label[:length]))
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, d = 3, 5, 4
+    x = rng.randn(b, t, d).astype('float32')
+    trans = rng.randn(d + 2, d).astype('float32')
+    label = rng.randint(0, d, (b, t)).astype('int64')
+    length = np.array([5, 3, 1], 'int64')
+    out = run_op('linear_chain_crf',
+                 {'Emission': [x], 'Transition': [trans],
+                  'Label': [label], 'Length': [length]})
+    nll = np.asarray(out['LogLikelihood'][0]).ravel()
+    for i in range(b):
+        want = crf_brute(x[i], trans, label[i], int(length[i]))
+        np.testing.assert_allclose(nll[i], want, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    b, t, d = 2, 4, 3
+    x = rng.randn(b, t, d).astype('float32')
+    trans = rng.randn(d + 2, d).astype('float32')
+    length = np.array([4, 2], 'int64')
+    out = run_op('crf_decoding',
+                 {'Emission': [x], 'Transition': [trans],
+                  'Length': [length]})
+    path = np.asarray(out['ViterbiPath'][0])
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+    for i in range(b):
+        ln = int(length[i])
+        best, best_path = None, None
+        for p in itertools.product(range(d), repeat=ln):
+            s = w_start[p[0]] + x[i, 0, p[0]] + w_end[p[-1]]
+            for k in range(1, ln):
+                s += x[i, k, p[k]] + w[p[k - 1], p[k]]
+            if best is None or s > best:
+                best, best_path = s, p
+        assert tuple(path[i, :ln]) == best_path
+        assert (path[i, ln:] == 0).all()
+
+
+def test_crf_gradient_flows():
+    rng = np.random.RandomState(2)
+    b, t, d = 2, 4, 3
+    x = jnp.asarray(rng.randn(b, t, d).astype('float32'))
+    trans = jnp.asarray(rng.randn(d + 2, d).astype('float32'))
+    label = jnp.asarray(rng.randint(0, d, (b, t)).astype('int32'))
+    length = jnp.asarray(np.array([4, 3], 'int32'))
+
+    def loss(x, trans):
+        out = run_op('linear_chain_crf',
+                     {'Emission': [x], 'Transition': [trans],
+                      'Label': [label], 'Length': [length]})
+        return jnp.mean(out['LogLikelihood'][0])
+
+    gx, gt = jax.grad(loss, argnums=(0, 1))(x, trans)
+    assert np.isfinite(np.asarray(gx)).all()
+    assert np.isfinite(np.asarray(gt)).all()
+    assert float(jnp.abs(gx).sum()) > 0
+    # padded tail of seq 1 (len 3 of 4) must get zero emission grad
+    assert float(jnp.abs(gx[1, 3]).sum()) == 0.0
+
+
+# ----------------------------------------------------------------- chunk_eval
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types: tags B-0=0 I-0=1 B-1=2 I-1=3 O=4
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.layers.data('inf', shape=[6], dtype='int64')
+        lab = fluid.layers.data('lab', shape=[6], dtype='int64')
+        ln = fluid.layers.data('ln', shape=[1], dtype='int64')
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            inf, lab, chunk_scheme='IOB', num_chunk_types=2,
+            seq_length=ln)
+    label = np.array([[0, 1, 4, 2, 3, 4]], 'int64')   # chunks: (0,1,t0) (3,4,t1)
+    infer = np.array([[0, 1, 4, 2, 4, 4]], 'int64')   # chunks: (0,1,t0) (3,3,t1)
+    length = np.array([[6]], 'int64')
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        pv, rv, fv, niv, nlv, ncv = exe.run(
+            main, feed={'inf': infer, 'lab': label, 'ln': length},
+            fetch_list=[p, r, f1, ni, nl, nc])
+    assert int(niv[0]) == 2 and int(nlv[0]) == 2 and int(ncv[0]) == 1
+    assert abs(float(pv[0]) - 0.5) < 1e-6
+    assert abs(float(rv[0]) - 0.5) < 1e-6
+    assert abs(float(fv[0]) - 0.5) < 1e-6
+
+
+# ----------------------------------------------------------------- CTC
+
+def test_warpctc_matches_manual_simple():
+    # Single frame, single label u: loss = -log softmax(logits)[u]
+    rng = np.random.RandomState(3)
+    logits = rng.randn(2, 1, 5).astype('float32')
+    label = np.array([[2], [4]], 'int64')
+    out = run_op('warpctc', {'Logits': [logits], 'Label': [label]},
+                 {'blank': 0})
+    loss = np.asarray(out['Loss'][0]).ravel()
+    p = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(-1, keepdims=True)
+    want = -np.log(p[np.arange(2), label.ravel()])
+    np.testing.assert_allclose(loss, want, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_grad_and_lengths():
+    rng = np.random.RandomState(4)
+    logits = jnp.asarray(rng.randn(2, 6, 5).astype('float32'))
+    label = jnp.asarray(np.array([[1, 2, 0], [3, 0, 0]], 'int32'))
+    lo_len = jnp.asarray(np.array([6, 4], 'int32'))
+    la_len = jnp.asarray(np.array([2, 1], 'int32'))
+
+    def loss_fn(lg):
+        out = run_op('warpctc',
+                     {'Logits': [lg], 'Label': [label],
+                      'LogitsLength': [lo_len], 'LabelLength': [la_len]},
+                     {'blank': 0})
+        return jnp.sum(out['Loss'][0])
+
+    g = jax.grad(loss_fn)(logits)
+    assert np.isfinite(np.asarray(g)).all()
+    # frames beyond logit length get no gradient
+    assert float(jnp.abs(g[1, 4:]).sum()) == 0.0
+
+
+def test_ctc_align():
+    x = np.array([[1, 1, 0, 2, 2, 0, 3],
+                  [0, 4, 4, 4, 0, 0, 5]], 'int64')
+    out = run_op('ctc_align', {'Input': [x]}, {'blank': 0})
+    got = np.asarray(out['Output'][0])
+    ln = np.asarray(out['OutputLength'][0]).ravel()
+    assert list(got[0, :3]) == [1, 2, 3] and ln[0] == 3
+    assert list(got[1, :2]) == [4, 5] and ln[1] == 2
+    assert (got[0, 3:] == 0).all()
+
+
+def test_edit_distance():
+    import difflib  # noqa: F401  (manual expected values below)
+    hyp = np.array([[1, 2, 3, 0], [1, 1, 1, 1]], 'int64')
+    ref = np.array([[1, 3, 3], [2, 2, 2]], 'int64')
+    h_len = np.array([3, 4], 'int64')
+    r_len = np.array([3, 3], 'int64')
+    out = run_op('edit_distance',
+                 {'Hyps': [hyp], 'Refs': [ref],
+                  'HypsLength': [h_len], 'RefsLength': [r_len]},
+                 {'normalized': False})
+    d = np.asarray(out['Out'][0]).ravel()
+    assert d[0] == 1.0   # substitute 2->3
+    assert d[1] == 4.0   # 3 substitutions + 1 deletion
+    assert int(np.asarray(out['SequenceNum'][0])[0]) == 2
+
+
+# ----------------------------------------------------------------- sampling
+
+def test_nce_trains_word2vec_style():
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        lab = fluid.layers.data('lab', shape=[1], dtype='int64')
+        cost = fluid.layers.nce(x, lab, num_total_classes=20,
+                                num_neg_samples=5)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    emb = rng.randn(20, 8).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for i in range(40):
+            ids = rng.randint(0, 20, (32,))
+            feed = {'x': emb[ids], 'lab': ids[:, None].astype('int64')}
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_hsigmoid_loss_decreases_and_path_math():
+    # path math: num_classes=4 -> codes 4..7, length 2
+    from paddle_tpu.ops.lang_ops import hierarchical_sigmoid  # noqa: F401
+    rng = np.random.RandomState(6)
+    x = rng.randn(3, 4).astype('float32')
+    w = rng.randn(3, 4).astype('float32')
+    bias = rng.randn(3).astype('float32')
+    label = np.array([0, 2, 3], 'int64')
+    out = run_op('hierarchical_sigmoid',
+                 {'X': [x], 'W': [w], 'Bias': [bias], 'Label': [label]},
+                 {'num_classes': 4})
+    got = np.asarray(out['Out'][0]).ravel()
+    # manual: code=label+4; bits b=0,1; node=(code>>(b+1))-1; bit=(code>>b)&1
+    for i, lb in enumerate(label):
+        code = lb + 4
+        want = 0.0
+        for b in range(2):
+            node = (code >> (b + 1)) - 1
+            bit = (code >> b) & 1
+            z = x[i] @ w[node] + bias[node]
+            want += np.log1p(np.exp(z)) - bit * z
+        np.testing.assert_allclose(got[i], want, rtol=1e-4, atol=1e-4)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 10
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data('x', shape=[8], dtype='float32')
+        lab = fluid.layers.data('lab', shape=[1], dtype='int64')
+        cost = fluid.layers.hsigmoid(xv, lab, num_classes=16)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    feats = rng.randn(16, 8).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for i in range(40):
+            ids = rng.randint(0, 16, (32,))
+            feed = {'x': feats[ids], 'lab': ids[:, None].astype('int64')}
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_cos_sim():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 6).astype('float32')
+    y = rng.randn(4, 6).astype('float32')
+    out = run_op('cos_sim', {'X': [x], 'Y': [y]})
+    got = np.asarray(out['Out'][0]).ravel()
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                             * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------- beam search
+
+def test_beam_search_step_and_gather_tree():
+    # 1 batch, beam 2, vocab 4
+    pre_ids = np.array([[2, 3]], 'int64')
+    pre_scores = np.array([[-1.0, -2.0]], 'float32')
+    scores = np.log(np.array([[[0.1, 0.2, 0.3, 0.4],
+                               [0.4, 0.3, 0.2, 0.1]]], 'float32'))
+    out = run_op('beam_search',
+                 {'PreIds': [pre_ids], 'PreScores': [pre_scores],
+                  'Scores': [scores]},
+                 {'beam_size': 2, 'end_id': 0})
+    ids = np.asarray(out['SelectedIds'][0])
+    parent = np.asarray(out['ParentIdx'][0])
+    total = pre_scores[0][:, None] + scores[0]
+    flat = total.ravel()
+    top2 = np.argsort(-flat)[:2]
+    assert list(ids[0]) == [int(t % 4) for t in top2]
+    assert list(parent[0]) == [int(t // 4) for t in top2]
+
+    # finished beam (pre_id == end_id) only extends end_id at no cost
+    pre_ids2 = np.array([[0, 3]], 'int64')
+    out2 = run_op('beam_search',
+                  {'PreIds': [pre_ids2], 'PreScores': [pre_scores],
+                   'Scores': [scores]},
+                  {'beam_size': 2, 'end_id': 0})
+    ids2 = np.asarray(out2['SelectedIds'][0])
+    sc2 = np.asarray(out2['SelectedScores'][0])
+    assert ids2[0, 0] == 0 and abs(sc2[0, 0] - (-1.0)) < 1e-6
+
+    # gather_tree backtrace
+    ids_t = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], 'int64')   # [T=3,B=1,K=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], 'int64')
+    out3 = run_op('gather_tree', {'Ids': [ids_t], 'Parents': [parents]})
+    got = np.asarray(out3['Out'][0])
+    # beam 0 at t2: id 5, parent 1 -> t1 id 4, parent(t1,beam1)=0 -> t0 id 1
+    assert list(got[:, 0, 0]) == [1, 4, 5]
+    # beam 1 at t2: id 6, parent 0 -> t1 id 3, parent(t1,beam0)=1 -> t0 id 2
+    assert list(got[:, 0, 1]) == [2, 3, 6]
+
+
+def test_hsigmoid_power_of_two_code():
+    # label + num_classes landing on an exact power of two must keep the
+    # full path (float log2 is off by one ulp there)
+    rng = np.random.RandomState(8)
+    num_classes = 20
+    x = rng.randn(1, 4).astype('float32')
+    w = rng.randn(num_classes - 1, 4).astype('float32')
+    label = np.array([12], 'int64')        # code = 32 = 2^5
+    out = run_op('hierarchical_sigmoid',
+                 {'X': [x], 'W': [w], 'Label': [label]},
+                 {'num_classes': num_classes})
+    got = float(np.asarray(out['Out'][0]).ravel()[0])
+    code = 32
+    want = 0.0
+    for b in range(5):                     # length = floor(log2(32)) = 5
+        node = (code >> (b + 1)) - 1
+        bit = (code >> b) & 1
+        z = float(x[0] @ w[node])
+        want += np.log1p(np.exp(z)) - bit * z
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_edit_distance_ignored_tokens():
+    hyp = np.array([[0, 1, 0, 2, 3]], 'int64')     # ignoring 0 -> [1,2,3]
+    ref = np.array([[1, 3, 3, 0, 0]], 'int64')     # ignoring 0 -> [1,3,3]
+    out = run_op('edit_distance', {'Hyps': [hyp], 'Refs': [ref]},
+                 {'normalized': False, 'ignored_tokens': [0]})
+    d = float(np.asarray(out['Out'][0]).ravel()[0])
+    assert d == 1.0   # substitute 2->3
