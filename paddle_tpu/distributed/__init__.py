@@ -1,0 +1,8 @@
+"""Distributed frontends: launch CLI, async communicator, heartbeat.
+
+Reference: python/paddle/distributed/ (launch.py) +
+operators/distributed/ (communicator.h, heart_beat_monitor.h)."""
+
+from .communicator import (  # noqa: F401
+    AsyncCommunicator, GeoSgdCommunicator, ParameterServerStore)
+from .heartbeat import HeartBeatMonitor  # noqa: F401

@@ -1,0 +1,176 @@
+"""Async parameter-server communicator: bounded-staleness gradient
+shipping and GeoSGD delta shipping.
+
+Reference: operators/distributed/communicator.h — AsyncCommunicator
+(:175) batches per-variable send queues in background threads and merges
+up to `merge_num` pending grads before one RPC; GeoSgdCommunicator
+(:343) trains locally and ships parameter *deltas* every
+`geo_need_push_nums` steps.  listen_and_serv's async loop applies grads
+with no barrier (operators/distributed_ops/listen_and_serv_op.cc:226).
+
+TPU-native re-design: dense synchronous training rides XLA collectives
+(ICI/DCN) and never goes through here; this path exists for the
+CTR/sparse workload where huge embedding tables live host-side
+(parallel/sparse_embedding.py) and workers tolerate bounded staleness.
+The "server" is a thread-safe host store (one per process; multi-host
+deployments shard tables across hosts the same way the reference shards
+param blocks across pservers).
+"""
+
+import threading
+import time
+import queue as _queue
+
+import numpy as np
+
+
+class ParameterServerStore(object):
+    """In-process stand-in for the pserver side: name -> np.ndarray with
+    an optimizer applied under a lock (the reference runs per-param
+    optimize sub-blocks inside listen_and_serv)."""
+
+    def __init__(self, lr=1.0):
+        self._params = {}
+        self._locks = {}
+        self._global_lock = threading.Lock()
+        self.lr = lr
+
+    def init_var(self, name, value):
+        with self._global_lock:
+            self._params[name] = np.array(value, copy=True)
+            self._locks[name] = threading.Lock()
+
+    def apply_grad(self, name, grad):
+        with self._locks[name]:
+            self._params[name] -= self.lr * grad
+
+    def apply_delta(self, name, delta):
+        with self._locks[name]:
+            self._params[name] += delta
+
+    def get(self, name):
+        with self._locks[name]:
+            return self._params[name].copy()
+
+    def names(self):
+        with self._global_lock:
+            return list(self._params)
+
+
+class AsyncCommunicator(object):
+    """Background-thread gradient shipper with merge-before-send.
+
+    send(name, grad) enqueues; a send thread drains each var's queue,
+    averages up to `merge_num` pending grads (the reference's
+    MergeVars), and applies them to the server store.  recv(name) pulls
+    the current server value (the reference's RecvThread batch-pulls on
+    a cadence)."""
+
+    def __init__(self, server, send_queue_size=20, merge_num=20,
+                 send_wait_times=5):
+        self.server = server
+        self.merge_num = max(1, int(merge_num))
+        self.send_wait_times = send_wait_times
+        self._queues = {}
+        self._qsize = int(send_queue_size)
+        self._threads = []
+        self._running = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle (reference: Communicator::Start/Stop) ---------------
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+        for t in self._threads:
+            t.join()
+        self._threads = []
+
+    def is_running(self):
+        return self._running
+
+    def _queue_of(self, name):
+        with self._lock:
+            q = self._queues.get(name)
+            if q is None:
+                q = _queue.Queue(maxsize=self._qsize)
+                self._queues[name] = q
+                t = threading.Thread(target=self._send_loop,
+                                     args=(name, q), daemon=True)
+                t.start()
+                self._threads.append(t)
+            return q
+
+    def send(self, name, grad):
+        if not self._running:
+            raise RuntimeError('communicator not started')
+        self._queue_of(name).put(np.asarray(grad))
+
+    def _send_loop(self, name, q):
+        while self._running or not q.empty():
+            try:
+                g = q.get(timeout=0.01)
+            except _queue.Empty:
+                continue
+            merged, n = np.array(g, dtype=np.float64), 1
+            while n < self.merge_num:
+                try:
+                    merged += q.get_nowait()
+                    n += 1
+                except _queue.Empty:
+                    break
+            self.server.apply_grad(name, (merged / n).astype(g.dtype))
+
+    def recv(self, name):
+        return self.server.get(name)
+
+    def flush(self):
+        """Block until every queue is drained (test/shutdown helper)."""
+        for q in list(self._queues.values()):
+            while not q.empty():
+                time.sleep(0.005)
+
+
+class GeoSgdCommunicator(object):
+    """GeoSGD: train locally, ship deltas.
+
+    Every `geo_need_push_nums` local steps, push
+    (local - last_synced) / trainers to the server and pull the merged
+    global value (reference: GeoSgdCommunicator::SendThread +
+    RecvUpdateVars)."""
+
+    def __init__(self, server, trainers, geo_need_push_nums=100):
+        self.server = server
+        self.trainers = max(1, int(trainers))
+        self.push_nums = max(1, int(geo_need_push_nums))
+        self._old = {}
+        self._steps = {}
+        self._running = False
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def init_from_server(self, name):
+        val = self.server.get(name)
+        self._old[name] = val.copy()
+        self._steps[name] = 0
+        return val
+
+    def step(self, name, local_value):
+        """Record one local training step; returns the (possibly
+        refreshed) local value."""
+        if not self._running:
+            raise RuntimeError('communicator not started')
+        self._steps[name] += 1
+        if self._steps[name] < self.push_nums:
+            return local_value
+        self._steps[name] = 0
+        delta = (np.asarray(local_value) - self._old[name]) / self.trainers
+        self.server.apply_delta(name, delta)
+        fresh = self.server.get(name)
+        self._old[name] = fresh.copy()
+        return fresh

@@ -22,4 +22,9 @@ from .rnn import (  # noqa: F401
     dynamic_lstm, dynamic_gru, lstm_unit, beam_search, gather_tree)
 from .sequence_lod import (  # noqa: F401
     sequence_pool, sequence_softmax, sequence_expand, sequence_reshape,
-    sequence_first_step, sequence_last_step, sequence_conv)
+    sequence_first_step, sequence_last_step, sequence_conv,
+    sequence_pad, sequence_unpad, sequence_concat, sequence_slice,
+    sequence_erase, sequence_enumerate, sequence_reverse,
+    sequence_expand_as, sequence_scatter, lod_reset)
+from . import extras
+from .extras import *  # noqa: F401,F403

@@ -9,6 +9,7 @@ _UNARY = [
     'floor', 'cos', 'sin', 'tan', 'acos', 'asin', 'atan', 'sinh', 'cosh',
     'round', 'reciprocal', 'square', 'softplus', 'softsign', 'log',
     'log2', 'log10', 'log1p', 'erf', 'sign', 'silu',
+    'logsigmoid', 'tanh_shrink',
 ]
 
 

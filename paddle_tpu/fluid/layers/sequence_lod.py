@@ -86,3 +86,94 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                             'contextStart': -(filter_size // 2)})
     pre_act = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr)
     return helper.append_activation(pre_act, act)
+
+
+def _multi_out(op_type, inputs, out_specs, attrs=None):
+    helper = LayerHelper(op_type)
+    outs = {}
+    ret = []
+    for slot, dt in out_specs:
+        v = helper.create_variable_for_type_inference(dt)
+        outs[slot] = v
+        ret.append(v)
+    helper.append_op(op_type, inputs=inputs, outputs=outs,
+                     attrs=attrs or {})
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+def sequence_pad(x, pad_value, mask=None, maxlen=None, name=None):
+    inputs = {'X': x, 'PadValue': pad_value}
+    if mask is not None:
+        inputs['Mask'] = mask
+    return _multi_out('sequence_pad', inputs,
+                      [('Out', x.dtype), ('Length', 'int64')])
+
+
+def sequence_unpad(x, length, name=None):
+    return _multi_out('sequence_unpad', {'X': x, 'Length': length},
+                      [('Out', x.dtype), ('Mask', 'float32')])
+
+
+def sequence_concat(input, masks=None, name=None):
+    inputs = {'X': list(input)}
+    if masks is not None:
+        inputs['Mask'] = list(masks)
+    return _multi_out('sequence_concat', inputs,
+                      [('Out', input[0].dtype), ('Mask', 'float32')])
+
+
+def sequence_slice(input, offset, length, name=None):
+    return _multi_out('sequence_slice',
+                      {'X': input, 'Offset': offset, 'Length': length},
+                      [('Out', input.dtype), ('Mask', 'float32')])
+
+
+def sequence_erase(input, tokens, mask=None, name=None):
+    inputs = {'X': input}
+    if mask is not None:
+        inputs['Mask'] = mask
+    return _multi_out('sequence_erase', inputs,
+                      [('Out', input.dtype), ('Mask', 'float32')],
+                      {'tokens': list(tokens)})
+
+
+def sequence_enumerate(input, win_size, pad_value=0, mask=None,
+                       name=None):
+    inputs = {'X': input}
+    if mask is not None:
+        inputs['Mask'] = mask
+    return _multi_out('sequence_enumerate', inputs,
+                      [('Out', input.dtype)],
+                      {'win_size': win_size, 'pad_value': pad_value})
+
+
+def sequence_reverse(x, mask=None, name=None):
+    inputs = {'X': x}
+    if mask is not None:
+        inputs['Mask'] = mask
+    return _multi_out('sequence_reverse', inputs, [('Y', x.dtype)])
+
+
+def sequence_expand_as(x, y, mask=None, name=None):
+    inputs = {'X': x, 'Y': y}
+    if mask is not None:
+        inputs['Mask'] = mask
+    return _multi_out('sequence_expand_as', inputs, [('Out', x.dtype)])
+
+
+def sequence_scatter(input, index, updates, mask=None, name=None):
+    inputs = {'X': input, 'Ids': index, 'Updates': updates}
+    if mask is not None:
+        inputs['Mask'] = mask
+    return _multi_out('sequence_scatter', inputs, [('Out', input.dtype)])
+
+
+def lod_reset(x, y=None, target_lod=None):
+    inputs = {'X': x}
+    attrs = {}
+    if y is not None:
+        inputs['Y'] = y
+    else:
+        attrs['target_lod'] = list(target_lod)
+    return _multi_out('lod_reset', inputs,
+                      [('Out', x.dtype), ('Mask', 'float32')], attrs)

@@ -23,3 +23,5 @@ from . import rnn_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import lang_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import vision_ops  # noqa: F401

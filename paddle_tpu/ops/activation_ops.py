@@ -158,3 +158,29 @@ def maxout(ctx, ins, attrs):
     groups = attrs['groups']
     n, c, h, w = x.shape
     return {'Out': [x.reshape(n, c // groups, groups, h, w).max(axis=2)]}
+
+
+_unary('logsigmoid', jax.nn.log_sigmoid)
+_unary('tanh_shrink', lambda x: x - jnp.tanh(x))
+
+
+@register('selu')
+def selu(ctx, ins, attrs):
+    scale = attrs.get('scale', 1.0507009873554805)
+    alpha = attrs.get('alpha', 1.6732632423543772)
+    x = ins['X'][0]
+    return {'Out': [scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))]}
+
+
+@register('stanh')
+def stanh(ctx, ins, attrs):
+    a = attrs.get('scale_a', 0.67)
+    b = attrs.get('scale_b', 1.7159)
+    return {'Out': [b * jnp.tanh(a * ins['X'][0])]}
+
+
+@register('brelu')
+def brelu(ctx, ins, attrs):
+    t_min = attrs.get('t_min', 0.0)
+    t_max = attrs.get('t_max', 24.0)
+    return {'Out': [jnp.clip(ins['X'][0], t_min, t_max)]}

@@ -1,0 +1,315 @@
+"""Ranking / regression / distillation loss ops and small misc ops.
+
+Reference: paddle/fluid/operators/ rank_loss_op.h, margin_rank_loss_op.h,
+hinge_loss_op.h, bpr_loss_op.h:55-77, modified_huber_loss_op.h:32-55,
+teacher_student_sigmoid_loss_op.h:25-64, center_loss_op.h, cvm_op.cc,
+fsp_op.h, l1_norm_op.h, mean_iou_op.h, shard_index_op.cc, size_op.cc,
+multiplex_op.h, bilinear_tensor_product_op.h, sampling_id_op.h,
+scatter_nd_add_op.h, pad_constant_like_op.h, spectral_norm_op.h,
+data_norm_op.cc, random_crop_op.h.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_host
+
+
+# ----------------------------------------------------------------- ranking
+
+@register('rank_loss')
+def rank_loss(ctx, ins, attrs):
+    """RankNet pairwise loss: Label in [0,1], Left/Right logits."""
+    label = ins['Label'][0]
+    left = ins['Left'][0]
+    right = ins['Right'][0]
+    d = left - right
+    return {'Out': [jax.nn.softplus(d) - label * d]}
+
+
+@register('margin_rank_loss', no_grad_out_slots=('Activated',))
+def margin_rank_loss(ctx, ins, attrs):
+    margin = attrs.get('margin', 0.0)
+    label = ins['Label'][0]        # {-1, +1}
+    x1 = ins['X1'][0]
+    x2 = ins['X2'][0]
+    val = -label * (x1 - x2) + margin
+    return {'Out': [jax.nn.relu(val)],
+            'Activated': [(val > 0).astype(x1.dtype)]}
+
+
+@register('hinge_loss')
+def hinge_loss(ctx, ins, attrs):
+    logits = ins['Logits'][0]
+    labels = ins['Labels'][0]      # {0, 1}
+    return {'Loss': [jax.nn.relu(1.0 - (2.0 * labels - 1.0) * logits)]}
+
+
+@register('bpr_loss')
+def bpr_loss(ctx, ins, attrs):
+    """Bayesian Personalized Ranking (bpr_loss_op.h:55-77):
+    loss_i = mean_{j != y_i} log(1 + exp(x_ij - x_iy))."""
+    x = ins['X'][0]
+    label = ins['Label'][0].reshape(-1).astype(jnp.int32)
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], 1)          # [N,1]
+    softp = jax.nn.softplus(x - pos)                         # [N,C]
+    mask = jax.nn.one_hot(label, c, dtype=x.dtype)
+    loss = jnp.sum(softp * (1.0 - mask), axis=1, keepdims=True) / (c - 1)
+    return {'Y': [loss]}
+
+
+@register('modified_huber_loss', no_grad_out_slots=('IntermediateVal',))
+def modified_huber_loss(ctx, ins, attrs):
+    x = ins['X'][0]
+    y = ins['Y'][0]                # {0, 1}
+    val = (2.0 * y - 1.0) * x
+    loss = jnp.where(val < -1.0, -4.0 * val,
+                     jnp.where(val < 1.0, (1.0 - val) ** 2, 0.0))
+    return {'Out': [loss], 'IntermediateVal': [val]}
+
+
+@register('teacher_student_sigmoid_loss')
+def teacher_student_sigmoid_loss(ctx, ins, attrs):
+    """CTR distillation loss (teacher_student_sigmoid_loss_op.h:25-64):
+    label < -1: click CE only (z=0); -1<=label<0: z=1;
+    0<=label<1: z=0 + teacher q=label; label>=1: z=1 + q=label-1."""
+    x = ins['X'][0]
+    label = ins['Label'][0]
+    ce0 = jax.nn.relu(x) + jnp.log1p(jnp.exp(-jnp.abs(x)))   # z = 0
+    ce1 = ce0 - x                                            # z = 1
+    q = jnp.where(label < 1.0, label, label - 1.0)
+    teacher = jax.nn.relu(x) - x * q + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    y = jnp.where(label < -1.0, ce0,
+                  jnp.where(label < 0.0, ce1,
+                            jnp.where(label < 1.0, ce0 + teacher,
+                                      ce1 + teacher)))
+    return {'Y': [y]}
+
+
+@register('center_loss',
+          no_grad_out_slots=('SampleCenterDiff', 'CentersOut'))
+def center_loss(ctx, ins, attrs):
+    """Center loss (center_loss_op.h): 0.5*||x - c_y||^2 per sample, and
+    the in-graph center update c += alpha * sum(diff_y) / (1 + n_y)."""
+    x = ins['X'][0]
+    label = ins['Label'][0].reshape(-1).astype(jnp.int32)
+    centers = ins['Centers'][0]
+    rate = ins['CenterUpdateRate'][0].reshape(()) \
+        if ins.get('CenterUpdateRate') else jnp.asarray(
+            attrs.get('alpha', 0.5), x.dtype)
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if attrs.get('need_update', True):
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        sums = jnp.zeros_like(centers).at[label].add(diff)
+        new_centers = centers + rate * sums / (1.0 + counts[:, None])
+    else:
+        new_centers = centers
+    return {'Loss': [loss], 'SampleCenterDiff': [diff],
+            'CentersOut': [new_centers]}
+
+
+@register('cvm')
+def cvm(ctx, ins, attrs):
+    """CTR show/click feature transform (cvm_op.cc)."""
+    x = ins['X'][0]
+    use_cvm = attrs.get('use_cvm', True)
+    show = jnp.log(x[:, 0:1] + 1.0)
+    clk = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return {'Y': [jnp.concatenate([show, clk, x[:, 2:]], axis=1)]}
+    return {'Y': [x[:, 2:]]}
+
+
+# ----------------------------------------------------------------- misc
+
+@register('fsp')
+def fsp(ctx, ins, attrs):
+    """Flow-of-solution-procedure matrix for distillation (fsp_op.h):
+    X [B,C1,H,W], Y [B,C2,H,W] -> [B,C1,C2] = X·Yᵀ/(H·W)."""
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    h, w = x.shape[2], x.shape[3]
+    out = jnp.einsum('bchw,bdhw->bcd', x, y) / (h * w)
+    return {'Out': [out]}
+
+
+@register('l1_norm')
+def l1_norm(ctx, ins, attrs):
+    return {'Out': [jnp.sum(jnp.abs(ins['X'][0])).reshape(1)]}
+
+
+@register('mean_iou',
+          no_grad_out_slots=('OutMeanIou', 'OutWrong', 'OutCorrect'))
+def mean_iou(ctx, ins, attrs):
+    """mean_iou_op.h: per-class IOU averaged over present classes."""
+    pred = ins['Predictions'][0].reshape(-1).astype(jnp.int32)
+    label = ins['Labels'][0].reshape(-1).astype(jnp.int32)
+    n = int(attrs['num_classes'])
+    correct = jnp.zeros((n,), jnp.float32).at[
+        jnp.where(pred == label, pred, n)].add(
+            1.0, mode='drop')
+    pred_cnt = jnp.zeros((n,), jnp.float32).at[pred].add(1.0)
+    label_cnt = jnp.zeros((n,), jnp.float32).at[label].add(1.0)
+    denom = pred_cnt + label_cnt - correct
+    present = denom > 0
+    iou = jnp.where(present, correct / jnp.maximum(denom, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1.0)
+    wrong = (pred_cnt + label_cnt - 2.0 * correct).astype(jnp.int32)
+    return {'OutMeanIou': [miou.reshape(1)],
+            'OutWrong': [wrong], 'OutCorrect': [correct.astype(jnp.int32)]}
+
+
+@register('shard_index', no_grad_out_slots=('Out',))
+def shard_index(ctx, ins, attrs):
+    """shard_index_op.cc: map global ids to shard-local ids."""
+    x = ins['X'][0]
+    index_num = int(attrs['index_num'])
+    nshards = int(attrs['nshards'])
+    shard_id = int(attrs['shard_id'])
+    ignore_value = attrs.get('ignore_value', -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {'Out': [jnp.where(in_shard, x % shard_size, ignore_value)]}
+
+
+@register('size', no_grad_out_slots=('Out',))
+def size(ctx, ins, attrs):
+    x = ins['Input'][0]
+    return {'Out': [jnp.asarray([int(np.prod(x.shape))], jnp.int64)]}
+
+
+@register('multiplex')
+def multiplex(ctx, ins, attrs):
+    """multiplex_op.h: row-wise select among k candidate tensors."""
+    ids = ins['Ids'][0].reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(ins['X'], axis=0)            # [K, B, ...]
+    return {'Out': [xs[ids, jnp.arange(ids.shape[0])]]}
+
+
+@register('bilinear_tensor_product')
+def bilinear_tensor_product(ctx, ins, attrs):
+    """x [B,M], y [B,N], Weight [K,M,N] -> out[b,k] = x_b W_k y_bᵀ."""
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    w = ins['Weight'][0]
+    out = jnp.einsum('bm,kmn,bn->bk', x, w, y)
+    if ins.get('Bias'):
+        out = out + ins['Bias'][0].reshape(1, -1)
+    return {'Out': [out]}
+
+
+@register('sampling_id', no_grad_out_slots=('Out',))
+def sampling_id(ctx, ins, attrs):
+    """Sample a column index per row from probability rows."""
+    x = ins['X'][0]
+    idx = jax.random.categorical(ctx.rng(salt=3), jnp.log(
+        jnp.maximum(x, 1e-20)), axis=-1)
+    return {'Out': [idx.astype(jnp.int64)]}
+
+
+@register('scatter_nd_add')
+def scatter_nd_add(ctx, ins, attrs):
+    x = ins['X'][0]
+    index = ins['Index'][0].astype(jnp.int32)
+    updates = ins['Updates'][0]
+    idx_tuple = tuple(index[..., i] for i in range(index.shape[-1]))
+    return {'Out': [x.at[idx_tuple].add(updates)]}
+
+
+@register('pad_constant_like')
+def pad_constant_like(ctx, ins, attrs):
+    """Pad Y up to X's shape with pad_value (pad_constant_like_op.h)."""
+    x = ins['X'][0]
+    y = ins['Y'][0]
+    pad_value = attrs.get('pad_value', 0.0)
+    pads = [(0, int(xd) - int(yd)) for xd, yd in zip(x.shape, y.shape)]
+    return {'Out': [jnp.pad(y, pads, constant_values=pad_value)]}
+
+
+@register('spectral_norm')
+def spectral_norm(ctx, ins, attrs):
+    """spectral_norm_op.h: power-iteration normalized weight."""
+    w = ins['Weight'][0]
+    u = ins['U'][0].reshape(-1)
+    v = ins['V'][0].reshape(-1)
+    dim = attrs.get('dim', 0)
+    power_iters = attrs.get('power_iters', 1)
+    eps = attrs.get('eps', 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def body(i, uv):
+        u_, v_ = uv
+        v_ = mat.T @ u_
+        v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+        u_ = mat @ v_
+        u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        return u_, v_
+
+    u, v = jax.lax.fori_loop(0, max(power_iters, 1), body, (u, v))
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ (mat @ v)
+    return {'Out': [w / sigma]}
+
+
+@register('data_norm', no_grad_out_slots=('Means', 'Scales'))
+def data_norm(ctx, ins, attrs):
+    """data_norm_op.cc: normalize by accumulated batch statistics."""
+    x = ins['X'][0]
+    bsize = ins['BatchSize'][0].reshape(-1)
+    bsum = ins['BatchSum'][0].reshape(-1)
+    bsqr = ins['BatchSquareSum'][0].reshape(-1)
+    eps = attrs.get('epsilon', 1e-4)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / jnp.maximum(
+        bsqr - bsize * means * means, eps))
+    return {'Y': [(x - means[None, :]) * scales[None, :]],
+            'Means': [means], 'Scales': [scales]}
+
+
+@register('random_crop', no_grad_out_slots=('Out', 'SeedOut'))
+def random_crop(ctx, ins, attrs):
+    """random_crop_op.h: per-sample random spatial window."""
+    x = ins['X'][0]
+    shape = attrs['shape']          # crop shape for trailing dims
+    ndim = x.ndim
+    k = len(shape)
+    keys = jax.random.split(ctx.rng(salt=5), x.shape[0])
+
+    def crop_one(xi, key):
+        starts = []
+        for i, s in enumerate(shape):
+            full = xi.shape[ndim - 1 - k + i]
+            key_i = jax.random.fold_in(key, i)
+            starts.append(jax.random.randint(key_i, (), 0,
+                                             full - s + 1))
+        begin = [0] * (xi.ndim - k) + starts
+        sizes = list(xi.shape[:xi.ndim - k]) + list(shape)
+        return jax.lax.dynamic_slice(xi, begin, sizes)
+
+    out = jax.vmap(crop_one)(x, keys)
+    return {'Out': [out], 'SeedOut': [jnp.zeros((1,), jnp.int64)]}
+
+
+# ----------------------------------------------------------- host (dynamic)
+
+@register_host('unique_with_counts')
+def unique_with_counts(executor, scope, op):
+    """Host op: output shapes are data-dependent (unique_with_counts_op.h
+    runs CPU-side in the reference too)."""
+    from ..fluid import core
+    x = np.asarray(core.as_array(
+        scope.find_var(op.input('X')[0]))).reshape(-1)
+    uniq, index, counts = np.unique(x, return_inverse=True,
+                                    return_counts=True)
+    scope.set_var(op.output('Out')[0], uniq)
+    names = op.output('Index')
+    if names:
+        scope.set_var(names[0], index.astype(np.int32))
+    names = op.output('Count')
+    if names:
+        scope.set_var(names[0], counts.astype(np.int32))

@@ -125,3 +125,178 @@ def sequence_conv(ctx, ins, attrs):
 @register('im2sequence')
 def im2sequence(ctx, ins, attrs):
     raise NotImplementedError('im2sequence: OCR path planned')
+
+
+# --- additional sequence ops on the padded+mask representation ---------
+# Reference: operators/sequence_ops/ sequence_pad_op.cc, sequence_unpad_op.cc,
+# sequence_concat_op.cc, sequence_slice_op.cc, sequence_erase_op.cc,
+# sequence_enumerate_op.cc, sequence_reverse_op.h, sequence_expand_as_op.cc,
+# sequence_scatter_op.cc, lod_reset_op.cc.  LoD offset juggling becomes
+# masked gathers/compactions on [B, T, ...] (SURVEY.md §5 long-context note).
+
+def _stable_compact(x, keep):
+    """Left-compact kept elements per row (stable); works on [B,T]."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    return jnp.take_along_axis(x, order, axis=1), \
+        jnp.sum(keep, axis=1).astype(jnp.int32)
+
+
+@register('sequence_pad', no_grad_out_slots=('Length',))
+def sequence_pad(ctx, ins, attrs):
+    """Fill invalid (masked-out) steps with PadValue; emit lengths."""
+    x = ins['X'][0]
+    mask = _mask_of(ins, x)
+    pad = ins['PadValue'][0].reshape(()) if ins.get('PadValue') else \
+        jnp.asarray(attrs.get('pad_value', 0.0), x.dtype)
+    m = mask
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    out = jnp.where(m > 0, x, pad.astype(x.dtype))
+    length = jnp.sum(mask, axis=1).astype(jnp.int64)
+    return {'Out': [out], 'Length': [length]}
+
+
+@register('sequence_unpad')
+def sequence_unpad(ctx, ins, attrs):
+    """Padded -> (padded, mask-from-length): the ragged side of the
+    reference op is represented by the explicit mask."""
+    x = ins['X'][0]
+    length = ins['Length'][0].reshape(-1)
+    t = x.shape[1]
+    mask = (jnp.arange(t)[None, :] < length[:, None]).astype(jnp.float32)
+    return {'Out': [x], 'Mask': [mask]}
+
+
+@register('sequence_concat')
+def sequence_concat(ctx, ins, attrs):
+    """Concatenate per-row valid prefixes of all X inputs, left-compacted."""
+    xs = ins['X']
+    masks = ins.get('Mask')
+    if not masks or len(masks) != len(xs):
+        masks = [jnp.ones(x.shape[:2], jnp.float32) for x in xs]
+    cat = jnp.concatenate(xs, axis=1)
+    keep = jnp.concatenate([m > 0 for m in masks], axis=1)
+    if cat.ndim == 2:
+        out, n = _stable_compact(cat, keep)
+    else:
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        out = jnp.take_along_axis(
+            cat, order[..., None] * jnp.ones(
+                (1, 1, cat.shape[2]), order.dtype), axis=1)
+        n = jnp.sum(keep, axis=1).astype(jnp.int32)
+    t = out.shape[1]
+    mask = (jnp.arange(t)[None, :] < n[:, None]).astype(jnp.float32)
+    return {'Out': [out], 'Mask': [mask]}
+
+
+@register('sequence_slice')
+def sequence_slice(ctx, ins, attrs):
+    """Per-row [offset, offset+length) window, left-aligned."""
+    x = ins['X'][0]
+    offset = ins['Offset'][0].reshape(-1).astype(jnp.int32)
+    length = ins['Length'][0].reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = pos + offset[:, None]
+    src_c = jnp.minimum(src, t - 1)
+    if x.ndim == 2:
+        g = jnp.take_along_axis(x, src_c, axis=1)
+    else:
+        g = jnp.take_along_axis(
+            x, src_c[..., None] * jnp.ones((1, 1, x.shape[2]),
+                                           src_c.dtype), axis=1)
+    mask = (pos < length[:, None]).astype(jnp.float32)
+    m = mask
+    while m.ndim < g.ndim:
+        m = m[..., None]
+    return {'Out': [g * m.astype(g.dtype)], 'Mask': [mask]}
+
+
+@register('sequence_erase', no_grad_out_slots=('Out', 'Mask'))
+def sequence_erase(ctx, ins, attrs):
+    """Remove the given token ids from each row (int sequences)."""
+    x = ins['X'][0]
+    mask = _mask_of(ins, x)
+    tokens = attrs.get('tokens', [])
+    keep = mask > 0
+    for tok in tokens:
+        keep &= x != tok
+    out, n = _stable_compact(x, keep)
+    t = x.shape[1]
+    new_mask = (jnp.arange(t)[None, :] < n[:, None]).astype(jnp.float32)
+    return {'Out': [out * new_mask.astype(out.dtype)], 'Mask': [new_mask]}
+
+
+@register('sequence_enumerate', no_grad_out_slots=('Out',))
+def sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of win_size, padded with pad_value past the end."""
+    x = ins['X'][0]                               # [B, T] int
+    mask = _mask_of(ins, x)
+    win = int(attrs['win_size'])
+    pad = attrs.get('pad_value', 0)
+    b, t = x.shape
+    length = jnp.sum(mask, axis=1).astype(jnp.int32)
+    idx = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
+    valid = idx < length[:, None, None]
+    g = jnp.take_along_axis(
+        jnp.broadcast_to(x[:, :, None], (b, t, win)),
+        jnp.minimum(idx, t - 1), axis=1)
+    return {'Out': [jnp.where(valid, g, pad)]}
+
+
+@register('sequence_reverse')
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse each row's valid prefix in place."""
+    x = ins['X'][0]
+    mask = _mask_of(ins, x)
+    t = x.shape[1]
+    length = jnp.sum(mask, axis=1).astype(jnp.int32)
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < length[:, None], length[:, None] - 1 - pos, pos)
+    if x.ndim == 2:
+        out = jnp.take_along_axis(x, src, axis=1)
+    else:
+        out = jnp.take_along_axis(
+            x, src[..., None] * jnp.ones((1, 1, x.shape[2]), src.dtype),
+            axis=1)
+    return {'Y': [out]}
+
+
+@register('sequence_expand_as')
+def sequence_expand_as(ctx, ins, attrs):
+    """Broadcast each row vector over Y's timeline, masked to Y's
+    lengths."""
+    x = ins['X'][0]                               # [B, D]
+    y = ins['Y'][0]                               # [B, T, ...] or [B, T]
+    mask = ins['Mask'][0] if ins.get('Mask') else jnp.ones(
+        y.shape[:2], jnp.float32)
+    out = jnp.broadcast_to(x[:, None, :], (x.shape[0], y.shape[1],
+                                           x.shape[1]))
+    return {'Out': [out * mask[..., None].astype(out.dtype)]}
+
+
+@register('sequence_scatter')
+def sequence_scatter(ctx, ins, attrs):
+    """Scatter-add per-row updates into X at Ids (masked)."""
+    x = ins['X'][0]                               # [N] or [N, D]
+    ids = ins['Ids'][0].astype(jnp.int32)         # [B, T]
+    upd = ins['Updates'][0]                       # [B, T] (+D)
+    mask = _mask_of(ins, ids)
+    flat_ids = ids.reshape(-1)
+    flat_upd = (upd * mask.astype(upd.dtype).reshape(
+        mask.shape + (1,) * (upd.ndim - 2))).reshape(
+        (-1,) + upd.shape[2:])
+    return {'Out': [x.at[flat_ids].add(flat_upd)]}
+
+
+@register('lod_reset')
+def lod_reset(ctx, ins, attrs):
+    """New sequence boundaries = new mask from target lengths."""
+    x = ins['X'][0]
+    if ins.get('Y'):
+        length = ins['Y'][0].reshape(-1)
+    else:
+        length = jnp.asarray(attrs['target_lod'])
+    t = x.shape[1] if x.ndim > 1 else x.shape[0]
+    mask = (jnp.arange(t)[None, :] < length[:, None]).astype(jnp.float32)
+    return {'Out': [x], 'Mask': [mask]}

@@ -1,0 +1,477 @@
+"""3-D convolution/pooling, interpolation, and pixel-rearrangement ops.
+
+Reference: paddle/fluid/operators/ conv_op.cc (conv3d),
+conv_transpose_op.cc (conv3d_transpose), pool_op.cc (pool3d),
+interpolate_op.cc (trilinear_interp), pixel_shuffle? (shuffle_channel_op.cc,
+space_to_depth_op.cc), affine_channel_op.cc, affine_grid_op.cc,
+unfold_op.cc, crop_tensor_op.cc / crop_op.cc, spp_op.cc, roi_pool_op.cc,
+psroi_pool_op.cc, detection/anchor_generator_op.cc,
+detection/density_prior_box_op.cc, detection/box_clip_op.cc,
+detection/bipartite_match_op.cc.
+
+TPU-native notes: convs/pools go straight to lax.conv_general_dilated /
+reduce_window (MXU/VPU); ROI ops are vmapped gather+interp (static
+shapes, no dynamic loops); bipartite match is a host op (sequential
+greedy argmax, CPU in the reference too).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_host
+
+
+def _triple(v):
+    v = list(v) if isinstance(v, (list, tuple)) else [v]
+    if len(v) == 1:
+        v = v * 3
+    return [int(i) for i in v]
+
+
+# ----------------------------------------------------------------- 3-D conv
+
+@register('conv3d')
+def conv3d(ctx, ins, attrs):
+    x = ins['Input'][0]                       # [N, C, D, H, W]
+    w = ins['Filter'][0]                      # [O, I/g, KD, KH, KW]
+    strides = _triple(attrs.get('strides', [1, 1, 1]))
+    dilations = _triple(attrs.get('dilations', [1, 1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    p = attrs.get('paddings', [0, 0, 0])
+    if attrs.get('padding_algorithm') == 'SAME':
+        pad = 'SAME'
+    else:
+        p = _triple(p)
+        pad = [(pi, pi) for pi in p]
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+        precision=(jax.lax.Precision.HIGHEST
+                   if x.dtype == jnp.float32 else None))
+    return {'Output': [out]}
+
+
+@register('conv3d_transpose')
+def conv3d_transpose(ctx, ins, attrs):
+    x = ins['Input'][0]
+    w = ins['Filter'][0]                      # [I, O/g, KD, KH, KW]
+    strides = _triple(attrs.get('strides', [1, 1, 1]))
+    p = _triple(attrs.get('paddings', [0, 0, 0]))
+    k = w.shape[2:]
+    # gradient-of-conv formulation: lhs-dilate by stride, flip kernel
+    pad = [(ki - 1 - pi, ki - 1 - pi) for ki, pi in zip(k, p)]
+    w_fl = jnp.flip(w, axis=(2, 3, 4))
+    w_fl = jnp.swapaxes(w_fl, 0, 1)           # -> [O/g, I, ...]
+    out = jax.lax.conv_general_dilated(
+        x, w_fl, window_strides=[1, 1, 1], padding=pad,
+        lhs_dilation=strides,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    return {'Output': [out]}
+
+
+@register('pool3d')
+def pool3d(ctx, ins, attrs):
+    x = ins['X'][0]                           # [N, C, D, H, W]
+    ptype = attrs.get('pooling_type', 'max')
+    if attrs.get('global_pooling', False):
+        red = jnp.max if ptype == 'max' else jnp.mean
+        return {'Out': [red(x, axis=(2, 3, 4), keepdims=True)]}
+    ksize = _triple(attrs.get('ksize', [2, 2, 2]))
+    strides = _triple(attrs.get('strides', [2, 2, 2]))
+    p = _triple(attrs.get('paddings', [0, 0, 0]))
+    window = [1, 1] + ksize
+    stride5 = [1, 1] + strides
+    pad5 = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    if ptype == 'max':
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                    stride5, pad5)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride5,
+                                  pad5)
+        out = s / float(np.prod(ksize))
+    return {'Out': [out]}
+
+
+@register('trilinear_interp')
+def trilinear_interp(ctx, ins, attrs):
+    x = ins['X'][0]                           # [N, C, D, H, W]
+    out_dhw = [attrs.get('out_d'), attrs.get('out_h'), attrs.get('out_w')]
+    scale = attrs.get('scale')
+    if any(v is None or v <= 0 for v in out_dhw):
+        out_dhw = [int(s * scale) for s in x.shape[2:]]
+    align = attrs.get('align_corners', True)
+    n, c = x.shape[:2]
+
+    def axis_coords(out_len, in_len):
+        if align and out_len > 1:
+            return jnp.linspace(0.0, in_len - 1.0, out_len)
+        ratio = in_len / out_len
+        return jnp.maximum((jnp.arange(out_len) + 0.5) * ratio - 0.5, 0.0)
+
+    coords = [axis_coords(o, i) for o, i in zip(out_dhw, x.shape[2:])]
+    grid = jnp.meshgrid(*coords, indexing='ij')
+    out = jax.vmap(jax.vmap(
+        lambda img: jax.scipy.ndimage.map_coordinates(
+            img, grid, order=1, mode='nearest')))(x)
+    return {'Out': [out]}
+
+
+# ------------------------------------------------------- pixel rearrangement
+
+@register('pixel_shuffle')
+def pixel_shuffle(ctx, ins, attrs):
+    x = ins['X'][0]                           # [N, C*r*r, H, W]
+    r = int(attrs.get('upscale_factor', 1))
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    out = x.reshape(n, oc, r, r, h, w).transpose(0, 1, 4, 2, 5, 3)
+    return {'Out': [out.reshape(n, oc, h * r, w * r)]}
+
+
+@register('shuffle_channel')
+def shuffle_channel(ctx, ins, attrs):
+    x = ins['X'][0]
+    g = int(attrs.get('group', 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+    return {'Out': [out]}
+
+
+@register('space_to_depth')
+def space_to_depth(ctx, ins, attrs):
+    x = ins['X'][0]
+    b = int(attrs.get('blocksize', 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b).transpose(0, 3, 5, 1, 2, 4)
+    return {'Out': [out.reshape(n, c * b * b, h // b, w // b)]}
+
+
+@register('affine_channel')
+def affine_channel(ctx, ins, attrs):
+    x = ins['X'][0]
+    scale = ins['Scale'][0].reshape(-1)
+    bias = ins['Bias'][0].reshape(-1)
+    layout = attrs.get('data_layout', 'NCHW')
+    shape = (1, -1, 1, 1) if layout == 'NCHW' else (1, 1, 1, -1)
+    return {'Out': [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register('affine_grid')
+def affine_grid(ctx, ins, attrs):
+    """affine_grid_op.cc: theta [N,2,3] -> sampling grid [N,H,W,2]."""
+    theta = ins['Theta'][0]
+    if ins.get('OutputShape'):
+        shape = [int(v) for v in np.asarray(ins['OutputShape'][0])]
+    else:
+        shape = [int(v) for v in attrs['output_shape']]
+    n, c, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+    grid = jnp.einsum('hwk,njk->nhwj', base, theta)         # [N,H,W,2]
+    return {'Output': [grid.astype(theta.dtype)]}
+
+
+@register('unfold')
+def unfold(ctx, ins, attrs):
+    """unfold_op.cc (im2col): [N,C,H,W] -> [N, C*kh*kw, L]."""
+    x = ins['X'][0]
+    kh, kw = [int(v) for v in attrs['kernel_sizes']]
+    sh, sw = [int(v) for v in attrs.get('strides', [1, 1])]
+    pads = [int(v) for v in attrs.get('paddings', [0, 0, 0, 0])]
+    if len(pads) == 2:
+        pads = pads * 2
+    dh, dw = [int(v) for v in attrs.get('dilations', [1, 1])]
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                     (pads[1], pads[3])))
+    oh = (h + pads[0] + pads[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + pads[1] + pads[3] - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = jax.lax.slice(
+                xp, (0, 0, i * dh, j * dw),
+                (n, c, i * dh + (oh - 1) * sh + 1,
+                 j * dw + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            cols.append(patch.reshape(n, c, oh * ow))
+    out = jnp.stack(cols, axis=2).reshape(n, c * kh * kw, oh * ow)
+    return {'Y': [out]}
+
+
+@register('crop_tensor')
+def crop_tensor(ctx, ins, attrs):
+    x = ins['X'][0]
+    if ins.get('Offsets'):
+        offsets = [int(v) for v in np.asarray(ins['Offsets'][0])]
+    else:
+        offsets = [int(v) for v in attrs.get('offsets', [0] * x.ndim)]
+    if ins.get('Shape'):
+        shape = [int(v) for v in np.asarray(ins['Shape'][0])]
+    else:
+        shape = [int(v) for v in attrs['shape']]
+    shape = [x.shape[i] if s in (-1, 0) else s
+             for i, s in enumerate(shape)]
+    return {'Out': [jax.lax.slice(
+        x, offsets, [o + s for o, s in zip(offsets, shape)])]}
+
+
+@register('crop')
+def crop(ctx, ins, attrs):
+    return crop_tensor(ctx, ins, attrs)
+
+
+@register('spp')
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (spp_op.cc): pyramid of adaptive pools,
+    flattened + concatenated."""
+    x = ins['X'][0]
+    levels = int(attrs.get('pyramid_height', 1))
+    ptype = attrs.get('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        kh, kw = -(-h // bins), -(-w // bins)      # ceil
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        pad = [(0, 0), (0, 0), (ph, kh * bins - h - ph),
+               (pw, kw * bins - w - pw)]
+        if ptype == 'max':
+            xp = jnp.pad(x, pad, constant_values=-jnp.inf)
+            o = jax.lax.reduce_window(xp, -jnp.inf, jax.lax.max,
+                                      (1, 1, kh, kw), (1, 1, kh, kw),
+                                      'VALID')
+        else:
+            xp = jnp.pad(x, pad)
+            o = jax.lax.reduce_window(xp, 0.0, jax.lax.add,
+                                      (1, 1, kh, kw), (1, 1, kh, kw),
+                                      'VALID') / (kh * kw)
+        outs.append(o.reshape(n, -1))
+    return {'Out': [jnp.concatenate(outs, axis=1)]}
+
+
+# ----------------------------------------------------------------- ROI pools
+
+def _roi_pool_one(img, roi, pooled_h, pooled_w, spatial_scale):
+    """img [C,H,W], roi [4] xyxy.  Max pool each bin via masked max."""
+    c, h, w = img.shape
+    x1, y1, x2, y2 = [roi[i] * spatial_scale for i in range(4)]
+    x1, y1 = jnp.round(x1), jnp.round(y1)
+    x2, y2 = jnp.round(x2), jnp.round(y2)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = rh / pooled_h
+    bin_w = rw / pooled_w
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_bin(ph, pw):
+        ys0 = jnp.floor(y1 + ph * bin_h)
+        ys1 = jnp.ceil(y1 + (ph + 1) * bin_h)
+        xs0 = jnp.floor(x1 + pw * bin_w)
+        xs1 = jnp.ceil(x1 + (pw + 1) * bin_w)
+        m = ((ys[:, None] >= ys0) & (ys[:, None] < ys1) &
+             (xs[None, :] >= xs0) & (xs[None, :] < xs1))
+        neg = jnp.asarray(-jnp.inf, img.dtype)
+        vals = jnp.where(m[None], img, neg)
+        mx = jnp.max(vals, axis=(1, 2))
+        return jnp.where(jnp.isfinite(mx), mx, 0.0)
+
+    ph_idx, pw_idx = jnp.meshgrid(jnp.arange(pooled_h, dtype=jnp.float32),
+                                  jnp.arange(pooled_w, dtype=jnp.float32),
+                                  indexing='ij')
+    out = jax.vmap(jax.vmap(one_bin))(ph_idx, pw_idx)  # [PH,PW,C]
+    return jnp.transpose(out, (2, 0, 1))
+
+
+@register('roi_pool', no_grad_out_slots=('Argmax',))
+def roi_pool(ctx, ins, attrs):
+    """roi_pool_op.cc with dense [R,4] rois + RoisBatch indices."""
+    x = ins['X'][0]
+    rois = ins['ROIs'][0]
+    batch_idx = (ins['RoisBatch'][0].reshape(-1).astype(jnp.int32)
+                 if ins.get('RoisBatch')
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(attrs.get('pooled_height', 1))
+    pw = int(attrs.get('pooled_width', 1))
+    scale = attrs.get('spatial_scale', 1.0)
+    imgs = x[batch_idx]                          # [R, C, H, W]
+    out = jax.vmap(lambda im, r: _roi_pool_one(im, r, ph, pw, scale))(
+        imgs, rois)
+    return {'Out': [out],
+            'Argmax': [jnp.zeros(out.shape, jnp.int64)]}
+
+
+@register('psroi_pool')
+def psroi_pool(ctx, ins, attrs):
+    """psroi_pool_op.cc: position-sensitive average pooling — output
+    channel (c, ph, pw) averages input channel c*PH*PW + ph*PW + pw
+    inside bin (ph, pw)."""
+    x = ins['X'][0]                              # [N, C*PH*PW, H, W]
+    rois = ins['ROIs'][0]
+    batch_idx = (ins['RoisBatch'][0].reshape(-1).astype(jnp.int32)
+                 if ins.get('RoisBatch')
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(attrs.get('pooled_height', 1))
+    pw = int(attrs.get('pooled_width', 1))
+    oc = int(attrs.get('output_channels'))
+    scale = attrs.get('spatial_scale', 1.0)
+    n, c, h, w = x.shape
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(img, roi):
+        x1, y1, x2, y2 = [roi[i] * scale for i in range(4)]
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+
+        def bin_avg(ci, phi, pwi):
+            chan = (ci * ph + phi) * pw + pwi
+            ys0, ys1 = y1 + phi * bh, y1 + (phi + 1) * bh
+            xs0, xs1 = x1 + pwi * bw, x1 + (pwi + 1) * bw
+            m = ((ys[:, None] >= ys0) & (ys[:, None] < ys1) &
+                 (xs[None, :] >= xs0) & (xs[None, :] < xs1)).astype(
+                     img.dtype)
+            v = jnp.sum(img[chan] * m)
+            return v / jnp.maximum(jnp.sum(m), 1.0)
+
+        ci, phi, pwi = jnp.meshgrid(jnp.arange(oc), jnp.arange(ph),
+                                    jnp.arange(pw), indexing='ij')
+        return jax.vmap(jax.vmap(jax.vmap(bin_avg)))(ci, phi, pwi)
+
+    out = jax.vmap(one)(x[batch_idx], rois)
+    return {'Out': [out]}
+
+
+# -------------------------------------------------------------- anchors etc.
+
+@register('anchor_generator',
+          no_grad_out_slots=('Anchors', 'Variances'))
+def anchor_generator(ctx, ins, attrs):
+    """detection/anchor_generator_op.cc: RPN anchors per feature cell."""
+    feat = ins['Input'][0]                        # [N, C, H, W]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs['anchor_sizes']]
+    ratios = [float(r) for r in attrs['aspect_ratios']]
+    variances = [float(v) for v in attrs.get('variances',
+                                             [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in attrs['stride']]
+    offset = attrs.get('offset', 0.5)
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * np.sqrt(1.0 / r)
+            ah = s * np.sqrt(r)
+            anchors.append((aw, ah))
+    boxes = []
+    for aw, ah in anchors:
+        gx, gy = jnp.meshgrid(cx, cy, indexing='xy')
+        boxes.append(jnp.stack([gx - 0.5 * aw, gy - 0.5 * ah,
+                                gx + 0.5 * aw, gy + 0.5 * ah], axis=-1))
+    out = jnp.stack(boxes, axis=2)                # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, feat.dtype),
+                           out.shape)
+    return {'Anchors': [out.astype(feat.dtype)], 'Variances': [var]}
+
+
+@register('density_prior_box',
+          no_grad_out_slots=('Boxes', 'Variances'))
+def density_prior_box(ctx, ins, attrs):
+    """detection/density_prior_box_op.cc: dense grid of prior boxes per
+    cell at several densities."""
+    feat = ins['Input'][0]
+    image = ins['Image'][0]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    fixed_sizes = [float(v) for v in attrs['fixed_sizes']]
+    fixed_ratios = [float(v) for v in attrs['fixed_ratios']]
+    densities = [int(v) for v in attrs['densities']]
+    variances = [float(v) for v in attrs.get('variances',
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = attrs.get('step_w', 0.0) or iw / w
+    step_h = attrs.get('step_h', 0.0) or ih / h
+    offset = attrs.get('offset', 0.5)
+    boxes_per_cell = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    sx = -size / 2.0 + step / 2.0 + dj * step
+                    sy = -size / 2.0 + step / 2.0 + di * step
+                    boxes_per_cell.append((sx, sy, bw, bh))
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    gx, gy = jnp.meshgrid(cx, cy, indexing='xy')
+    outs = []
+    for sx, sy, bw, bh in boxes_per_cell:
+        outs.append(jnp.stack(
+            [(gx + sx - bw / 2.0) / iw, (gy + sy - bh / 2.0) / ih,
+             (gx + sx + bw / 2.0) / iw, (gy + sy + bh / 2.0) / ih],
+            axis=-1))
+    out = jnp.clip(jnp.stack(outs, axis=2), 0.0, 1.0)  # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, feat.dtype), out.shape)
+    return {'Boxes': [out.astype(feat.dtype)], 'Variances': [var]}
+
+
+@register('box_clip')
+def box_clip(ctx, ins, attrs):
+    """detection/box_clip_op.cc: clip boxes to image (im_info h,w,scale)."""
+    boxes = ins['Input'][0]                       # [..., 4]
+    im_info = ins['ImInfo'][0]                    # [N, 3]
+    h = im_info[0, 0] / im_info[0, 2] - 1.0
+    w = im_info[0, 1] / im_info[0, 2] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0.0, w)
+    y1 = jnp.clip(boxes[..., 1], 0.0, h)
+    x2 = jnp.clip(boxes[..., 2], 0.0, w)
+    y2 = jnp.clip(boxes[..., 3], 0.0, h)
+    return {'Output': [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+
+
+@register_host('bipartite_match')
+def bipartite_match(executor, scope, op):
+    """detection/bipartite_match_op.cc: greedy max bipartite matching
+    (sequential argmax — CPU-only in the reference as well)."""
+    from ..fluid import core
+    dist = np.array(core.as_array(
+        scope.find_var(op.input('DistMat')[0])), copy=True)
+    rows, cols = dist.shape
+    match_idx = np.full((1, cols), -1, np.int32)
+    match_dist = np.zeros((1, cols), np.float32)
+    used_rows = set()
+    typ = op.attr('match_type', 'bipartite')
+    while len(used_rows) < min(rows, cols):
+        best = -1.0
+        bi = bj = -1
+        for i in range(rows):
+            if i in used_rows:
+                continue
+            for j in range(cols):
+                if match_idx[0, j] != -1:
+                    continue
+                if dist[i, j] > best:
+                    best, bi, bj = dist[i, j], i, j
+        if bi < 0 or best <= 0:
+            break
+        match_idx[0, bj] = bi
+        match_dist[0, bj] = best
+        used_rows.add(bi)
+    if typ == 'per_prediction':
+        thresh = op.attr('dist_threshold', 0.5)
+        for j in range(cols):
+            if match_idx[0, j] == -1:
+                i = int(np.argmax(dist[:, j]))
+                if dist[i, j] >= thresh:
+                    match_idx[0, j] = i
+                    match_dist[0, j] = dist[i, j]
+    scope.set_var(op.output('ColToRowMatchIndices')[0], match_idx)
+    scope.set_var(op.output('ColToRowMatchDist')[0], match_dist)

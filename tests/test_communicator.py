@@ -1,0 +1,86 @@
+"""Async communicator / GeoSGD / heartbeat failure-detection tests
+(reference: communicator_test.cc + heart_beat_monitor.h semantics)."""
+
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed import (AsyncCommunicator, GeoSgdCommunicator,
+                                    HeartBeatMonitor, ParameterServerStore)
+from paddle_tpu.distributed import heartbeat
+
+
+def test_async_communicator_converges():
+    """3 worker threads minimize ||w - target||^2 through the async
+    send/recv path; bounded staleness must still converge."""
+    rng = np.random.RandomState(0)
+    target = rng.randn(8).astype('float32')
+    server = ParameterServerStore(lr=0.05)
+    server.init_var('w', np.zeros(8, 'float32'))
+    comm = AsyncCommunicator(server, merge_num=4)
+    comm.start()
+
+    def worker():
+        for _ in range(150):
+            w = comm.recv('w')
+            comm.send('w', 2.0 * (w - target))
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    comm.flush()
+    comm.stop()
+    w = server.get('w')
+    assert np.abs(w - target).max() < 0.05, (w, target)
+
+
+def test_geo_sgd_converges_two_trainers():
+    """2 trainers do local SGD and ship deltas every k steps."""
+    rng = np.random.RandomState(1)
+    target = rng.randn(6).astype('float64')
+    server = ParameterServerStore()
+    server.init_var('w', np.zeros(6))
+    comms = [GeoSgdCommunicator(server, trainers=2, geo_need_push_nums=5)
+             for _ in range(2)]
+    for c in comms:
+        c.start()
+    locals_ = [c.init_from_server('w') for c in comms]
+    for it in range(300):
+        for k, c in enumerate(comms):
+            w = locals_[k]
+            w = w - 0.05 * 2.0 * (w - target)     # local sgd step
+            locals_[k] = c.step('w', w)
+    for c in comms:
+        c.stop()
+    w = server.get('w')
+    assert np.abs(w - target).max() < 0.05, (w, target)
+
+
+def test_heartbeat_detects_lost_worker():
+    lost = []
+    mon = HeartBeatMonitor(workers=3, timeout=0.2, check_interval=0.05,
+                           on_lost=lambda wid, age: lost.append(wid))
+    mon.start()
+    try:
+        mon.update(0)
+        mon.update(1)
+        # worker 2 never reports: stays UNINITED, must NOT be flagged
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not mon.lost_workers():
+            mon.update(1)                      # worker 1 keeps beating
+            time.sleep(0.05)
+        assert mon.lost_workers() == [0]       # worker 0 went silent
+        assert lost == [0]
+        assert mon.worker_status(2) == 'UNINITED'
+        # recovery: a new heartbeat clears the lost mark
+        mon.update(0)
+        assert mon.lost_workers() == []
+        mon.update(0, heartbeat.COMPLETED)
+        mon.update(1, heartbeat.COMPLETED)
+        mon.update(2, heartbeat.COMPLETED)
+        assert mon.all_completed()
+    finally:
+        mon.stop()

@@ -1,0 +1,496 @@
+"""OpTest-style checks for the batch-2 ops: losses, misc, vision/3D,
+sequence extras (numpy references, torch cross-check where cheap)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import registry
+from paddle_tpu.ops.registry import LowerCtx
+
+
+def run_op(op_type, ins, attrs=None):
+    d = registry.get(op_type)
+    ctx = LowerCtx(step=jnp.asarray(0, jnp.int32), op_seed=3)
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return d.fn(ctx, ins, dict(attrs or {}))
+
+
+def A(out, slot):
+    return np.asarray(out[slot][0])
+
+
+# ----------------------------------------------------------------- losses
+
+def test_rank_margin_hinge_bpr_huber():
+    rng = np.random.RandomState(0)
+    left = rng.randn(6, 1).astype('f4')
+    right = rng.randn(6, 1).astype('f4')
+    lab = (rng.rand(6, 1) > 0.5).astype('f4')
+    out = run_op('rank_loss', {'Label': [lab], 'Left': [left],
+                               'Right': [right]})
+    d = left - right
+    np.testing.assert_allclose(A(out, 'Out'),
+                               np.log1p(np.exp(d)) - lab * d, rtol=1e-5)
+
+    lab_pm = np.sign(rng.randn(6, 1)).astype('f4')
+    out = run_op('margin_rank_loss',
+                 {'Label': [lab_pm], 'X1': [left], 'X2': [right]},
+                 {'margin': 0.1})
+    want = np.maximum(0, -lab_pm * (left - right) + 0.1)
+    np.testing.assert_allclose(A(out, 'Out'), want, rtol=1e-5)
+
+    out = run_op('hinge_loss', {'Logits': [left], 'Labels': [lab]})
+    np.testing.assert_allclose(
+        A(out, 'Loss'), np.maximum(0, 1 - (2 * lab - 1) * left), rtol=1e-5)
+
+    x = rng.randn(4, 5).astype('f4')
+    y = rng.randint(0, 5, (4, 1)).astype('i8')
+    out = run_op('bpr_loss', {'X': [x], 'Label': [y]})
+    want = np.zeros((4, 1), 'f4')
+    for i in range(4):
+        s = 0.0
+        for j in range(5):
+            if j == y[i, 0]:
+                continue
+            s += -np.log(1.0 + np.exp(x[i, j] - x[i, y[i, 0]]))
+        want[i, 0] = -s / 4
+    np.testing.assert_allclose(A(out, 'Y'), want, rtol=1e-4)
+
+    pred = np.array([[-2.0], [-0.5], [0.5], [2.0]], 'f4')
+    lab01 = np.array([[1.0], [1.0], [0.0], [1.0]], 'f4')
+    out = run_op('modified_huber_loss', {'X': [pred], 'Y': [lab01]})
+    val = (2 * lab01 - 1) * pred
+    want = np.where(val < -1, -4 * val,
+                    np.where(val < 1, (1 - val) ** 2, 0))
+    np.testing.assert_allclose(A(out, 'Out'), want, rtol=1e-5)
+
+
+def test_teacher_student_and_cvm_and_center():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 1).astype('f4')
+    # the four label regimes: -2 (no q, clk 0), -1 (no q, clk 1),
+    # 0.3 (q, clk 0), 1.7 (q, clk 1)
+    lab = np.array([[-2.0], [-1.0], [0.3], [1.7]], 'f4')
+    out = run_op('teacher_student_sigmoid_loss', {'X': [x], 'Label': [lab]})
+    got = A(out, 'Y')
+
+    def ce(xv, z):
+        return max(xv, 0) - xv * z + np.log1p(np.exp(-abs(xv)))
+    want = np.array([[ce(x[0, 0], 0)],
+                     [ce(x[1, 0], 1)],
+                     [ce(x[2, 0], 0) + ce(x[2, 0], 0.3)],
+                     [ce(x[3, 0], 1) + ce(x[3, 0], 0.7)]], 'f4')
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    x = np.abs(rng.randn(3, 6)).astype('f4')
+    out = run_op('cvm', {'X': [x]}, {'use_cvm': True})
+    got = A(out, 'Y')
+    np.testing.assert_allclose(got[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(got[:, 1],
+                               np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got[:, 2:], x[:, 2:])
+    out = run_op('cvm', {'X': [x]}, {'use_cvm': False})
+    assert A(out, 'Y').shape == (3, 4)
+
+    feats = rng.randn(5, 3).astype('f4')
+    labels = np.array([0, 1, 0, 2, 1], 'i8')
+    centers = rng.randn(3, 3).astype('f4')
+    out = run_op('center_loss',
+                 {'X': [feats], 'Label': [labels], 'Centers': [centers],
+                  'CenterUpdateRate': [np.array([0.5], 'f4')]})
+    diff = feats - centers[labels]
+    np.testing.assert_allclose(A(out, 'Loss'),
+                               0.5 * (diff ** 2).sum(1, keepdims=True),
+                               rtol=1e-5)
+    new_c = A(out, 'CentersOut')
+    for c in range(3):
+        idx = labels == c
+        want = centers[c] + 0.5 * diff[idx].sum(0) / (1 + idx.sum())
+        np.testing.assert_allclose(new_c[c], want, rtol=1e-4, atol=1e-6)
+
+
+def test_misc_ops():
+    rng = np.random.RandomState(2)
+    # fsp
+    x = rng.randn(2, 3, 4, 5).astype('f4')
+    y = rng.randn(2, 6, 4, 5).astype('f4')
+    out = run_op('fsp', {'X': [x], 'Y': [y]})
+    want = np.einsum('bchw,bdhw->bcd', x, y) / 20.0
+    np.testing.assert_allclose(A(out, 'Out'), want, rtol=1e-4)
+    # l1_norm
+    out = run_op('l1_norm', {'X': [x]})
+    np.testing.assert_allclose(A(out, 'Out'), [np.abs(x).sum()], rtol=1e-5)
+    # mean_iou
+    pred = np.array([0, 1, 1, 2, 2, 2], 'i4')
+    lab = np.array([0, 1, 2, 2, 2, 1], 'i4')
+    out = run_op('mean_iou', {'Predictions': [pred], 'Labels': [lab]},
+                 {'num_classes': 3})
+    # class0: i1 u1; class1: i1 u3; class2: i2 u4
+    np.testing.assert_allclose(A(out, 'OutMeanIou')[0],
+                               (1 + 1 / 3 + 0.5) / 3, rtol=1e-5)
+    # shard_index
+    ids = np.array([[0], [5], [9], [13]], 'i8')
+    out = run_op('shard_index', {'X': [ids]},
+                 {'index_num': 16, 'nshards': 2, 'shard_id': 1,
+                  'ignore_value': -1})
+    np.testing.assert_array_equal(A(out, 'Out'),
+                                  [[-1], [-1], [1], [5]])
+    # multiplex
+    x1 = rng.randn(4, 3).astype('f4')
+    x2 = rng.randn(4, 3).astype('f4')
+    ids = np.array([[0], [1], [0], [1]], 'i4')
+    out = run_op('multiplex', {'Ids': [ids], 'X': [x1, x2]})
+    want = np.where(ids == 0, x1, x2)
+    np.testing.assert_allclose(A(out, 'Out'), want)
+    # bilinear_tensor_product
+    xb = rng.randn(3, 4).astype('f4')
+    yb = rng.randn(3, 5).astype('f4')
+    wb = rng.randn(2, 4, 5).astype('f4')
+    out = run_op('bilinear_tensor_product',
+                 {'X': [xb], 'Y': [yb], 'Weight': [wb]})
+    np.testing.assert_allclose(A(out, 'Out'),
+                               np.einsum('bm,kmn,bn->bk', xb, wb, yb),
+                               rtol=1e-4)
+    # scatter_nd_add
+    base = np.zeros((3, 4), 'f4')
+    index = np.array([[0, 1], [2, 3], [0, 1]], 'i4')
+    upd = np.array([1.0, 2.0, 3.0], 'f4')
+    out = run_op('scatter_nd_add',
+                 {'X': [base], 'Index': [index], 'Updates': [upd]})
+    want = base.copy()
+    want[0, 1] += 4.0
+    want[2, 3] += 2.0
+    np.testing.assert_allclose(A(out, 'Out'), want)
+    # pad_constant_like
+    big = np.zeros((3, 5), 'f4')
+    small = np.ones((2, 3), 'f4')
+    out = run_op('pad_constant_like', {'X': [big], 'Y': [small]},
+                 {'pad_value': 9.0})
+    got = A(out, 'Out')
+    assert got.shape == (3, 5)
+    assert (got[:2, :3] == 1).all() and (got[2] == 9).all()
+    # size
+    out = run_op('size', {'Input': [big]})
+    assert int(A(out, 'Out')[0]) == 15
+
+
+def test_spectral_and_data_norm_and_sampling():
+    rng = np.random.RandomState(3)
+    w = rng.randn(4, 6).astype('f4')
+    u = rng.randn(4).astype('f4')
+    v = rng.randn(6).astype('f4')
+    out = run_op('spectral_norm', {'Weight': [w], 'U': [u], 'V': [v]},
+                 {'power_iters': 30, 'dim': 0})
+    got = A(out, 'Out')
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(got, w / sigma, rtol=1e-3, atol=1e-4)
+
+    x = rng.randn(5, 3).astype('f4')
+    bsize = np.full((3,), 10.0, 'f4')
+    bsum = rng.randn(3).astype('f4') * 10
+    bsqr = bsize * 1.0 + bsum ** 2 / 10.0   # variance 1
+    out = run_op('data_norm', {'X': [x], 'BatchSize': [bsize],
+                               'BatchSum': [bsum],
+                               'BatchSquareSum': [bsqr]})
+    means = bsum / 10.0
+    np.testing.assert_allclose(A(out, 'Means'), means, rtol=1e-5)
+    np.testing.assert_allclose(A(out, 'Y'), (x - means), rtol=1e-3,
+                               atol=1e-3)
+
+    probs = np.array([[1.0, 0.0, 0.0], [0.0, 0.0, 1.0]], 'f4')
+    out = run_op('sampling_id', {'X': [probs]})
+    np.testing.assert_array_equal(A(out, 'Out'), [0, 2])
+
+
+def test_activations_new():
+    x = np.array([-2.0, -0.5, 0.0, 0.7, 3.0], 'f4')
+    out = run_op('selu', {'X': [x]})
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    want = scale * np.where(x > 0, x, alpha * np.expm1(x))
+    np.testing.assert_allclose(A(out, 'Out'), want, rtol=1e-5)
+    out = run_op('stanh', {'X': [x]}, {'scale_a': 0.67, 'scale_b': 1.7159})
+    np.testing.assert_allclose(A(out, 'Out'), 1.7159 * np.tanh(0.67 * x),
+                               rtol=1e-5)
+    out = run_op('brelu', {'X': [x]}, {'t_min': -1.0, 't_max': 1.0})
+    np.testing.assert_allclose(A(out, 'Out'), np.clip(x, -1, 1))
+    out = run_op('logsigmoid', {'X': [x]})
+    np.testing.assert_allclose(A(out, 'Out'),
+                               -np.log1p(np.exp(-x)), rtol=1e-4)
+    out = run_op('tanh_shrink', {'X': [x]})
+    np.testing.assert_allclose(A(out, 'Out'), x - np.tanh(x), rtol=1e-5)
+
+
+# ----------------------------------------------------------------- vision/3D
+
+def test_conv3d_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 5, 6, 7).astype('f4')
+    w = rng.randn(4, 3, 2, 3, 3).astype('f4')
+    out = run_op('conv3d', {'Input': [x], 'Filter': [w]},
+                 {'strides': [1, 2, 1], 'paddings': [1, 0, 1]})
+    want = F.conv3d(torch.tensor(x), torch.tensor(w),
+                    stride=(1, 2, 1), padding=(1, 0, 1)).numpy()
+    np.testing.assert_allclose(A(out, 'Output'), want, rtol=2e-3,
+                               atol=2e-4)
+
+
+def test_conv3d_transpose_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 3, 4, 4, 4).astype('f4')
+    w = rng.randn(3, 2, 3, 3, 3).astype('f4')   # [in, out, k, k, k]
+    out = run_op('conv3d_transpose', {'Input': [x], 'Filter': [w]},
+                 {'strides': [2, 2, 2], 'paddings': [1, 1, 1]})
+    want = F.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                              stride=2, padding=1).numpy()
+    np.testing.assert_allclose(A(out, 'Output'), want, rtol=2e-3,
+                               atol=2e-4)
+
+
+def test_pool3d_and_trilinear():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 4, 6, 6).astype('f4')
+    out = run_op('pool3d', {'X': [x]},
+                 {'pooling_type': 'avg', 'ksize': [2, 2, 2],
+                  'strides': [2, 2, 2]})
+    want = F.avg_pool3d(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(A(out, 'Out'), want, rtol=1e-4)
+
+    out = run_op('trilinear_interp', {'X': [x]},
+                 {'out_d': 8, 'out_h': 12, 'out_w': 12,
+                  'align_corners': True})
+    want = F.interpolate(torch.tensor(x), size=(8, 12, 12),
+                         mode='trilinear', align_corners=True).numpy()
+    np.testing.assert_allclose(A(out, 'Out'), want, rtol=1e-3, atol=1e-4)
+
+
+def test_pixel_rearrange_ops():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 8, 3, 4).astype('f4')
+    out = run_op('pixel_shuffle', {'X': [x]}, {'upscale_factor': 2})
+    want = F.pixel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(A(out, 'Out'), want)
+
+    out = run_op('shuffle_channel', {'X': [x]}, {'group': 4})
+    want = x.reshape(2, 4, 2, 3, 4).swapaxes(1, 2).reshape(2, 8, 3, 4)
+    np.testing.assert_allclose(A(out, 'Out'), want)
+
+    x2 = rng.randn(2, 3, 4, 6).astype('f4')
+    out = run_op('space_to_depth', {'X': [x2]}, {'blocksize': 2})
+    assert A(out, 'Out').shape == (2, 12, 2, 3)
+
+    scale = rng.randn(3).astype('f4')
+    bias = rng.randn(3).astype('f4')
+    out = run_op('affine_channel', {'X': [x2], 'Scale': [scale],
+                                    'Bias': [bias]})
+    np.testing.assert_allclose(
+        A(out, 'Out'), x2 * scale[None, :, None, None]
+        + bias[None, :, None, None], rtol=1e-5)
+
+
+def test_affine_grid_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(8)
+    theta = rng.randn(2, 2, 3).astype('f4')
+    out = run_op('affine_grid', {'Theta': [theta]},
+                 {'output_shape': [2, 3, 4, 5]})
+    want = F.affine_grid(torch.tensor(theta), (2, 3, 4, 5),
+                         align_corners=True).numpy()
+    np.testing.assert_allclose(A(out, 'Output'), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unfold_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 3, 6, 7).astype('f4')
+    out = run_op('unfold', {'X': [x]},
+                 {'kernel_sizes': [2, 3], 'strides': [2, 1],
+                  'paddings': [1, 0], 'dilations': [1, 1]})
+    want = F.unfold(torch.tensor(x), (2, 3), stride=(2, 1),
+                    padding=(1, 0)).numpy()
+    np.testing.assert_allclose(A(out, 'Y'), want, rtol=1e-5)
+
+
+def test_crop_and_spp_and_roi_pool():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 3, 6, 6).astype('f4')
+    out = run_op('crop_tensor', {'X': [x]},
+                 {'offsets': [0, 1, 2, 2], 'shape': [2, 2, 3, 3]})
+    np.testing.assert_allclose(A(out, 'Out'), x[:, 1:3, 2:5, 2:5])
+
+    out = run_op('spp', {'X': [x]}, {'pyramid_height': 2,
+                                     'pooling_type': 'max'})
+    got = A(out, 'Out')
+    assert got.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(got[:, :3], x.max((2, 3)), rtol=1e-5)
+
+    # roi_pool on a 1x1 grid == max over the roi box
+    img = np.arange(36, dtype='f4').reshape(1, 1, 6, 6)
+    rois = np.array([[0.0, 0.0, 2.0, 2.0]], 'f4')
+    out = run_op('roi_pool', {'X': [img], 'ROIs': [rois]},
+                 {'pooled_height': 1, 'pooled_width': 1,
+                  'spatial_scale': 1.0})
+    assert float(A(out, 'Out')[0, 0, 0, 0]) == img[0, 0, :3, :3].max()
+
+
+def test_anchor_ops():
+    feat = np.zeros((1, 8, 2, 3), 'f4')
+    out = run_op('anchor_generator', {'Input': [feat]},
+                 {'anchor_sizes': [64.0], 'aspect_ratios': [1.0],
+                  'stride': [16.0, 16.0], 'offset': 0.5})
+    anchors = A(out, 'Anchors')
+    assert anchors.shape == (2, 3, 1, 4)
+    # first cell center is (8, 8), box 64x64
+    np.testing.assert_allclose(anchors[0, 0, 0], [-24, -24, 40, 40])
+
+    img = np.zeros((1, 3, 32, 48), 'f4')
+    out = run_op('density_prior_box', {'Input': [feat], 'Image': [img]},
+                 {'fixed_sizes': [8.0], 'fixed_ratios': [1.0],
+                  'densities': [2]})
+    boxes = A(out, 'Boxes')
+    assert boxes.shape == (2, 3, 4, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+
+    b = np.array([[[-5.0, -5.0, 100.0, 100.0]]], 'f4')
+    im_info = np.array([[32.0, 48.0, 1.0]], 'f4')
+    out = run_op('box_clip', {'Input': [b], 'ImInfo': [im_info]})
+    np.testing.assert_allclose(A(out, 'Output')[0, 0], [0, 0, 47, 31])
+
+
+# ------------------------------------------------------------- sequence
+
+def test_sequence_extras():
+    rng = np.random.RandomState(11)
+    x = np.array([[1, 2, 3, 0], [4, 5, 0, 0]], 'i4')
+    mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0]], 'f4')
+
+    out = run_op('sequence_reverse', {'X': [x], 'Mask': [mask]})
+    np.testing.assert_array_equal(A(out, 'Y'),
+                                  [[3, 2, 1, 0], [5, 4, 0, 0]])
+
+    out = run_op('sequence_erase', {'X': [x], 'Mask': [mask]},
+                 {'tokens': [2, 4]})
+    np.testing.assert_array_equal(A(out, 'Out'),
+                                  [[1, 3, 0, 0], [5, 0, 0, 0]])
+
+    out = run_op('sequence_enumerate', {'X': [x], 'Mask': [mask]},
+                 {'win_size': 2, 'pad_value': -1})
+    got = A(out, 'Out')
+    np.testing.assert_array_equal(got[0], [[1, 2], [2, 3], [3, -1],
+                                           [-1, -1]])
+
+    xf = rng.randn(2, 4, 3).astype('f4')
+    out = run_op('sequence_pad',
+                 {'X': [xf], 'Mask': [mask],
+                  'PadValue': [np.array([9.0], 'f4')]})
+    got = A(out, 'Out')
+    assert (got[0, 3] == 9).all() and (got[1, 2:] == 9).all()
+    np.testing.assert_array_equal(A(out, 'Length'), [3, 2])
+
+    out = run_op('sequence_unpad',
+                 {'X': [xf], 'Length': [np.array([3, 2], 'i4')]})
+    np.testing.assert_array_equal(A(out, 'Mask'), mask)
+
+    a = np.array([[1, 2, 0], [3, 0, 0]], 'i4')
+    am = np.array([[1, 1, 0], [1, 0, 0]], 'f4')
+    b = np.array([[7, 8], [9, 0]], 'i4')
+    bm = np.array([[1, 1], [1, 0]], 'f4')
+    out = run_op('sequence_concat', {'X': [a, b], 'Mask': [am, bm]})
+    np.testing.assert_array_equal(A(out, 'Out'),
+                                  [[1, 2, 7, 8, 0], [3, 9, 0, 0, 0]])
+
+    out = run_op('sequence_slice',
+                 {'X': [x], 'Offset': [np.array([1, 0], 'i4')],
+                  'Length': [np.array([2, 1], 'i4')]})
+    np.testing.assert_array_equal(A(out, 'Out'),
+                                  [[2, 3, 0, 0], [4, 0, 0, 0]])
+
+    xv = rng.randn(2, 3).astype('f4')
+    y = np.zeros((2, 4), 'f4')
+    out = run_op('sequence_expand_as', {'X': [xv], 'Y': [y],
+                                        'Mask': [mask]})
+    got = A(out, 'Out')
+    np.testing.assert_allclose(got[0, 2], xv[0])
+    assert (got[0, 3] == 0).all()
+
+    base = np.zeros((6,), 'f4')
+    ids = np.array([[0, 2], [4, 0]], 'i4')
+    upd = np.array([[1.0, 2.0], [3.0, 9.0]], 'f4')
+    m2 = np.array([[1, 1], [1, 0]], 'f4')
+    out = run_op('sequence_scatter', {'X': [base], 'Ids': [ids],
+                                      'Updates': [upd], 'Mask': [m2]})
+    np.testing.assert_allclose(A(out, 'Out'), [1, 0, 2, 0, 3, 0])
+
+    out = run_op('lod_reset', {'X': [x], 'Y': [np.array([2, 4], 'i4')]})
+    np.testing.assert_array_equal(A(out, 'Mask'),
+                                  [[1, 1, 0, 0], [1, 1, 1, 1]])
+
+
+def test_unique_with_counts_host():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='int64')
+        out = main.global_block().create_var(name='uniq', dtype='int64',
+                                             shape=())
+        idx = main.global_block().create_var(name='uidx', dtype='int32',
+                                             shape=())
+        cnt = main.global_block().create_var(name='ucnt', dtype='int32',
+                                             shape=())
+        main.global_block().append_op(
+            'unique_with_counts', inputs={'X': x},
+            outputs={'Out': out, 'Index': idx, 'Count': cnt})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        u, c = exe.run(main,
+                       feed={'x': np.array([[3, 1, 3, 2, 1, 3, 7, 7]],
+                                           'int64')},
+                       fetch_list=[out, cnt])
+    np.testing.assert_array_equal(np.asarray(u), [1, 2, 3, 7])
+    np.testing.assert_array_equal(np.asarray(c), [2, 1, 3, 2])
+
+
+def test_conv3d_layer_trains():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2, 4, 6, 6], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        h = fluid.layers.conv3d(x, 4, 3, padding=1, act='relu')
+        h = fluid.layers.pool3d(h, 2, 'avg')
+        h = fluid.layers.reshape(h, [-1, int(np.prod(h.shape[1:]))])
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(12)
+
+    def batch(n=8):
+        xs = rng.randn(n, 2, 4, 6, 6).astype('f4')
+        return {'x': xs, 'y': xs.mean((1, 2, 3, 4), keepdims=False)
+                .reshape(n, 1) * 3.0}
+
+    with __import__('paddle_tpu').fluid.scope_guard(
+            __import__('paddle_tpu').fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            l, = exe.run(main, feed=batch(), fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0], losses
