@@ -275,3 +275,133 @@ def generate_proposals(ctx, ins, attrs):
     raise NotImplementedError(
         'generate_proposals: compose yolo_box/box_coder + '
         'multiclass_nms fixed-size variants')
+
+
+@register('sigmoid_focal_loss')
+def sigmoid_focal_loss(ctx, ins, attrs):
+    """Reference operators/detection/sigmoid_focal_loss_op.cc:
+    elementwise focal loss over [N, C] logits; Label [N,1] in
+    [0, C] (0 = background), FgNum normalizes."""
+    x = ins['X'][0]
+    label = ins['Label'][0].reshape(-1).astype(jnp.int32)
+    fg = jnp.maximum(ins['FgNum'][0].reshape(()).astype(x.dtype), 1.0)
+    gamma = attrs.get('gamma', 2.0)
+    alpha = attrs.get('alpha', 0.25)
+    n, ncls = x.shape
+    # target[i, c] = 1 iff label[i] == c+1
+    tgt = (label[:, None] == jnp.arange(1, ncls + 1)[None, :]
+           ).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = -(tgt * jax.nn.log_sigmoid(x) +
+           (1 - tgt) * jax.nn.log_sigmoid(-x))
+    w = tgt * alpha * jnp.power(1 - p, gamma) + \
+        (1 - tgt) * (1 - alpha) * jnp.power(p, gamma)
+    return {'Out': [w * ce / fg]}
+
+
+@register('yolov3_loss', no_grad_out_slots=('ObjectnessMask',
+                                            'GTMatchMask'))
+def yolov3_loss(ctx, ins, attrs):
+    """Reference operators/detection/yolov3_loss_op.h, dense TPU form.
+
+    X [N, A*(5+cls), H, W] raw predictions for the anchors in
+    `anchor_mask`; GTBox [N, B, 4] (cx,cy,w,h normalized to [0,1],
+    zero-padded), GTLabel [N, B].  All matching is masked dense math:
+    every gt slot scores every anchor, argmax picks the responsible
+    anchor, and invalid slots contribute zero loss.
+    """
+    x = ins['X'][0]
+    gtbox = ins['GTBox'][0].astype(jnp.float32)
+    gtlabel = ins['GTLabel'][0].astype(jnp.int32)
+    anchors = np.asarray(attrs['anchors'], np.float32).reshape(-1, 2)
+    mask_idx = np.asarray(attrs.get('anchor_mask',
+                                    list(range(len(anchors)))), np.int64)
+    cls = attrs['class_num']
+    ignore = attrs.get('ignore_thresh', 0.7)
+    down = attrs.get('downsample_ratio', 32)
+    n, _, h, w = x.shape
+    a = len(mask_idx)
+    input_size = down * h
+    p = x.reshape(n, a, 5 + cls, h, w)
+    px, py = p[:, :, 0], p[:, :, 1]        # [N,A,H,W]
+    pw, ph = p[:, :, 2], p[:, :, 3]
+    pobj = p[:, :, 4]
+    pcls = p[:, :, 5:]                     # [N,A,cls,H,W]
+    valid = (gtbox[:, :, 2] > 1e-8).astype(jnp.float32)  # [N,B]
+
+    # --- responsible anchor per gt: best wh-iou over ALL anchors
+    gw = gtbox[:, :, 2] * input_size       # [N,B] in pixels
+    gh = gtbox[:, :, 3] * input_size
+    aw = jnp.asarray(anchors[:, 0])        # [An]
+    ah = jnp.asarray(anchors[:, 1])
+    inter = jnp.minimum(gw[:, :, None], aw) * jnp.minimum(
+        gh[:, :, None], ah)
+    union = gw[:, :, None] * gh[:, :, None] + aw * ah - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)  # [N,B,An]
+    best = jnp.argmax(an_iou, -1)          # [N,B]
+    # position inside anchor_mask (or -1)
+    match = -jnp.ones_like(best)
+    for k, am in enumerate(mask_idx):
+        match = jnp.where(best == am, k, match)  # [N,B]
+    matched = (match >= 0) & (valid > 0)
+
+    gi = jnp.clip((gtbox[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gtbox[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    tx = gtbox[:, :, 0] * w - gi
+    ty = gtbox[:, :, 1] * h - gj
+    tw = jnp.log(jnp.maximum(
+        gw / jnp.maximum(aw[jnp.clip(best, 0, len(anchors) - 1)], 1e-8),
+        1e-9))
+    th = jnp.log(jnp.maximum(
+        gh / jnp.maximum(ah[jnp.clip(best, 0, len(anchors) - 1)], 1e-8),
+        1e-9))
+    scale = 2.0 - gtbox[:, :, 2] * gtbox[:, :, 3]
+
+    def bce(logit, t):
+        return -(t * jax.nn.log_sigmoid(logit) +
+                 (1 - t) * jax.nn.log_sigmoid(-logit))
+
+    bidx = jnp.arange(n)[:, None]
+    sel = lambda t: t[bidx, jnp.maximum(match, 0), gj, gi]  # [N,B]
+    mf = matched.astype(jnp.float32)
+    loss_xy = (bce(sel(px), tx) + bce(sel(py), ty)) * scale * mf
+    loss_wh = (jnp.square(sel(pw) - tw) +
+               jnp.square(sel(ph) - th)) * 0.5 * scale * mf
+    tgt_cls = jax.nn.one_hot(gtlabel, cls)           # [N,B,cls]
+    pc = pcls[bidx[:, :, None], jnp.maximum(match, 0)[:, :, None],
+              jnp.arange(cls)[None, None, :], gj[:, :, None],
+              gi[:, :, None]]
+    loss_cls = (bce(pc, tgt_cls).sum(-1)) * mf
+
+    # --- objectness: positives at matched cells, negatives elsewhere
+    # unless the predicted box overlaps some gt above ignore_thresh
+    grid_x = (jnp.arange(w)[None, None, None, :] + jax.nn.sigmoid(px)) / w
+    grid_y = (jnp.arange(h)[None, None, :, None] + jax.nn.sigmoid(py)) / h
+    bw = jnp.exp(pw) * aw[mask_idx][None, :, None, None] / input_size
+    bh = jnp.exp(ph) * ah[mask_idx][None, :, None, None] / input_size
+
+    def box_iou(cx1, cy1, w1, h1, cx2, cy2, w2, h2):
+        l = jnp.maximum(cx1 - w1 / 2, cx2 - w2 / 2)
+        rr = jnp.minimum(cx1 + w1 / 2, cx2 + w2 / 2)
+        t = jnp.maximum(cy1 - h1 / 2, cy2 - h2 / 2)
+        bb = jnp.minimum(cy1 + h1 / 2, cy2 + h2 / 2)
+        iw = jnp.maximum(rr - l, 0)
+        ih = jnp.maximum(bb - t, 0)
+        i = iw * ih
+        return i / jnp.maximum(w1 * h1 + w2 * h2 - i, 1e-10)
+
+    ious = box_iou(
+        grid_x[:, :, :, :, None], grid_y[:, :, :, :, None],
+        bw[:, :, :, :, None], bh[:, :, :, :, None],
+        gtbox[:, None, None, None, :, 0], gtbox[:, None, None, None, :, 1],
+        gtbox[:, None, None, None, :, 2], gtbox[:, None, None, None, :, 3])
+    ious = ious * valid[:, None, None, None, :]
+    best_iou = jnp.max(ious, -1)                     # [N,A,H,W]
+    pos = jnp.zeros((n, a, h, w))
+    pos = pos.at[bidx, jnp.maximum(match, 0), gj, gi].max(mf)
+    neg = (1 - pos) * (best_iou < ignore).astype(jnp.float32)
+    loss_obj = (bce(pobj, 1.0) * pos + bce(pobj, 0.0) * neg).sum((1, 2, 3))
+
+    loss = (loss_xy + loss_wh + loss_cls).sum(1) + loss_obj
+    return {'Loss': [loss], 'ObjectnessMask': [pos - neg],
+            'GTMatchMask': [match.astype(jnp.int32)]}

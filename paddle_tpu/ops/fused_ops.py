@@ -46,3 +46,136 @@ def fused_elemwise_activation(ctx, ins, attrs):
     else:
         raise NotImplementedError(unary)
     return {'Out': [out], 'IntermediateOut': [vals[binary]]}
+
+
+# ---------------------------------------------------------------------------
+# CPU fusion-op parity (reference operators/fused/fusion_*.cc).  On TPU
+# these compose existing lowerings — XLA refuses the composition apart;
+# registering them keeps transpiled/saved reference programs loadable.
+# ---------------------------------------------------------------------------
+
+
+def _call(op, ins, attrs, ctx):
+    from .registry import get
+    return get(op).fn(ctx, ins, attrs)
+
+
+@register('fusion_gru', no_grad_out_slots=('XX',))
+def fusion_gru(ctx, ins, attrs):
+    """x@Wx + bias, then the gru scan: X [B,T,D], WeightX [D,3H],
+    WeightH [H,3H] (reference operators/fused/fusion_gru_op.cc)."""
+    x = ins['X'][0]
+    xx = x @ ins['WeightX'][0]
+    if ins.get('Bias'):
+        xx = xx + ins['Bias'][0].reshape(1, 1, -1)
+    sub = {'Input': [xx], 'Weight': ins['WeightH']}
+    if ins.get('H0'):
+        sub['H0'] = ins['H0']
+    if ins.get('Mask'):
+        sub['Mask'] = ins['Mask']
+    out = _call('gru', sub, attrs, ctx)
+    return {'Hidden': out['Hidden'], 'XX': [xx]}
+
+
+@register('fusion_lstm', no_grad_out_slots=('XX',))
+def fusion_lstm(ctx, ins, attrs):
+    x = ins['X'][0]
+    xx = x @ ins['WeightX'][0]
+    if ins.get('Bias'):
+        xx = xx + ins['Bias'][0].reshape(1, 1, -1)
+    sub = {'Input': [xx], 'Weight': ins['WeightH']}
+    for s in ('H0', 'C0', 'Mask'):
+        if ins.get(s):
+            sub[s] = ins[s]
+    out = _call('lstm', sub, attrs, ctx)
+    return {'Hidden': out['Hidden'], 'Cell': out['Cell'], 'XX': [xx]}
+
+
+@register('fused_embedding_fc_lstm')
+def fused_embedding_fc_lstm(ctx, ins, attrs):
+    """Ids [B,T] -> embedding rows (already x@Wx-fused in the table,
+    reference operators/fused/fused_embedding_fc_lstm_op.cc) -> lstm."""
+    ids = ins['Ids'][0].astype(jnp.int32)
+    emb = ins['Embeddings'][0]          # [V, 4H]
+    xx = emb[ids.reshape(ids.shape[:2])]
+    if ins.get('Bias'):
+        xx = xx + ins['Bias'][0].reshape(1, 1, -1)
+    sub = {'Input': [xx], 'Weight': ins['WeightH']}
+    for s in ('H0', 'C0', 'Mask'):
+        if ins.get(s):
+            sub[s] = ins[s]
+    out = _call('lstm', sub, attrs, ctx)
+    return {'Hidden': out['Hidden'], 'Cell': out['Cell']}
+
+
+@register('fusion_repeated_fc_relu')
+def fusion_repeated_fc_relu(ctx, ins, attrs):
+    """Chain of (fc -> relu) (reference fusion_repeated_fc_relu_op.cc —
+    the fuse pass only matches consecutive fc+relu pairs, so every
+    layer including the last is ReLU'd)."""
+    import jax
+    x = ins['X'][0]
+    for w, b in zip(ins['W'], ins['Bias']):
+        x = jax.nn.relu(x @ w + b.reshape(1, -1))
+    return {'Out': [x], 'ReluOut': [x]}
+
+
+@register('fusion_seqconv_eltadd_relu')
+def fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    import jax
+    sub = {'X': ins['X'], 'Filter': ins['Filter']}
+    if ins.get('Mask'):
+        sub['Mask'] = ins['Mask']
+    conv = _call('sequence_conv', sub, attrs, ctx)['Out'][0]
+    out = jax.nn.relu(conv + ins['Bias'][0].reshape(1, 1, -1))
+    return {'Out': [out], 'ColMat': [conv]}
+
+
+@register('fusion_seqexpand_concat_fc')
+def fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """Refs fusion_seqexpand_concat_fc_op.cc: broadcast per-batch vectors
+    over time, concat with X, one fc + act.  X[0] is [B,T,D]; the rest
+    are [B,Dk]."""
+    import jax
+    xs = ins['X']
+    seq = xs[0]
+    b, t = seq.shape[:2]
+    parts = [seq] + [jnp.broadcast_to(v[:, None, :], (b, t, v.shape[-1]))
+                     for v in xs[1:]]
+    cat = jnp.concatenate(parts, -1)
+    out = cat @ ins['FCWeight'][0]
+    if ins.get('FCBias'):
+        out = out + ins['FCBias'][0].reshape(1, 1, -1)
+    act = attrs.get('fc_activation', 'relu')
+    if act == 'relu':
+        out = jax.nn.relu(out)
+    elif act == 'tanh':
+        out = jnp.tanh(out)
+    return {'Out': [out], 'FCOut': [out]}
+
+
+@register('fusion_seqpool_concat')
+def fusion_seqpool_concat(ctx, ins, attrs):
+    """Pool each input over time and concat (fusion_seqpool_concat_op)."""
+    pooled = []
+    n_mask = len(ins.get('Mask', []))
+    for k, x in enumerate(ins['X']):
+        sub = {'X': [x]}
+        if k < n_mask:
+            sub['Mask'] = [ins['Mask'][k]]
+        pooled.append(_call('sequence_pool', sub,
+                            {'pooltype': attrs.get('pooltype', 'SUM')},
+                            ctx)['Out'][0])
+    return {'Out': [jnp.concatenate(pooled, -1)]}
+
+
+@register('fusion_squared_mat_sub')
+def fusion_squared_mat_sub(ctx, ins, attrs):
+    """(x@y)^2 - x^2@y^2, scaled (fusion_squared_mat_sub_op.cc)."""
+    x, y = ins['X'][0], ins['Y'][0]
+    scalar = attrs.get('scalar', 1.0)
+    sq_xy = jnp.square(x @ y)
+    x2y2 = jnp.square(x) @ jnp.square(y)
+    return {'Out': [scalar * (sq_xy - x2y2)],
+            'SquaredXY': [sq_xy], 'SquaredX': [jnp.square(x)],
+            'SquaredY': [jnp.square(y)]}

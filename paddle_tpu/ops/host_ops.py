@@ -96,3 +96,84 @@ def py_func(executor, scope, op):
         outs = [outs]
     for name, val in zip(op.output('Out'), outs):
         scope.set_var(name, np.asarray(val))
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows utilities + parameter-server id sharding (host side).
+# Reference: operators/get_tensor_from_selected_rows_op.cc,
+# merge/split_selected_rows, operators/distributed_ops/{merge,split}_ids
+# — PS-path ops stay host-side numpy (dynamic row counts are fine off
+# the accelerator).
+# ---------------------------------------------------------------------------
+
+
+@register_host('get_tensor_from_selected_rows')
+def get_tensor_from_selected_rows(executor, scope, op):
+    from ..fluid import core
+    sr = scope.find_var(op.input('X')[0])
+    scope.set_var(op.output('Out')[0], np.asarray(sr.value))
+
+
+@register_host('merge_selected_rows')
+def merge_selected_rows(executor, scope, op):
+    """Sum duplicate rows (selected_rows_functor MergeAdd analog)."""
+    from ..fluid import core
+    sr = scope.find_var(op.input('X')[0])
+    rows = np.asarray(sr.rows)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    val = np.zeros((len(uniq),) + np.asarray(sr.value).shape[1:],
+                   np.asarray(sr.value).dtype)
+    np.add.at(val, inv, np.asarray(sr.value))
+    scope.set_var(op.output('Out')[0],
+                  core.SelectedRows(uniq, val, sr.height))
+
+
+@register_host('split_selected_rows')
+def split_selected_rows(executor, scope, op):
+    """Split by height sections round-robin over output vars."""
+    from ..fluid import core
+    sr = scope.find_var(op.input('X')[0])
+    outs = op.output('Out')
+    heights = op.attr('height_sections')
+    if not heights:
+        base = sr.height // len(outs)
+        heights = [base] * len(outs)
+        heights[-1] += sr.height - base * len(outs)
+    rows = np.asarray(sr.rows)
+    val = np.asarray(sr.value)
+    start = 0
+    for name, h in zip(outs, heights):
+        sel = (rows >= start) & (rows < start + h)
+        scope.set_var(name, core.SelectedRows(
+            rows[sel] - start, val[sel], h))
+        start += h
+
+
+@register_host('split_ids')
+def split_ids(executor, scope, op):
+    from ..fluid import core
+    ids = np.asarray(core.as_array(
+        scope.find_var(op.input('Ids')[0]))).reshape(-1)
+    outs = op.output('Out')
+    for k, name in enumerate(outs):
+        scope.set_var(name, ids[ids % len(outs) == k])
+
+
+@register_host('merge_ids')
+def merge_ids(executor, scope, op):
+    """Reassemble rows fetched from the id shards back into the original
+    id order (trainer side of the PS embedding prefetch)."""
+    from ..fluid import core
+    ids = np.asarray(core.as_array(
+        scope.find_var(op.input('Ids')[0]))).reshape(-1)
+    shards = [np.asarray(core.as_array(scope.find_var(n)))
+              for n in op.input('X')]
+    n_shard = len(shards)
+    dim = shards[0].shape[-1] if shards[0].ndim > 1 else 1
+    out = np.zeros((len(ids), dim), shards[0].dtype)
+    counters = [0] * n_shard
+    for i, idv in enumerate(ids):
+        s = int(idv) % n_shard
+        out[i] = shards[s][counters[s]]
+        counters[s] += 1
+    scope.set_var(op.output('Out')[0], out)

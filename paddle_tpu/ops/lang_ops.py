@@ -501,3 +501,154 @@ def gather_tree(ctx, ins, attrs):
     init = jnp.tile(jnp.arange(k, dtype=jnp.int32)[None], (b, 1))
     _, outs = jax.lax.scan(body, init, (ids, parents), reverse=True)
     return {'Out': [outs.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# Sampled softmax / hashing / tag filtering / structured convs
+# ---------------------------------------------------------------------------
+
+
+@register('sample_logits', no_grad_out_slots=('Samples', 'Probabilities',
+                                              'SampledLabels'))
+def sample_logits(ctx, ins, attrs):
+    """Reference operators/sample_logits_op.cc: subsample the softmax
+    over classes — true labels + `num_samples` log-uniform negatives,
+    logits corrected by -log(expected count) (sampled-softmax math)."""
+    logits = ins['Logits'][0]            # [N, K]
+    labels = ins['Labels'][0].astype(jnp.int32)  # [N, NT]
+    num_samples = attrs.get('num_samples', 10)
+    n, k = logits.shape
+    nt = labels.shape[1]
+    # log-uniform (Zipf) negative sampling, shared across the batch
+    u = jax.random.uniform(ctx.rng(), (num_samples,), minval=1e-6,
+                           maxval=1.0)
+    neg = (jnp.exp(u * jnp.log(k + 1.0)) - 1.0).astype(jnp.int32)
+    neg = jnp.clip(neg, 0, k - 1)                 # [S]
+    samples = jnp.concatenate(
+        [labels, jnp.broadcast_to(neg, (n, num_samples))], -1)
+    logq = jnp.log((jnp.log(samples + 2.0) - jnp.log(samples + 1.0)) /
+                   jnp.log(k + 1.0))
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    if not attrs.get('uniq', True):
+        logq = jnp.zeros_like(logq)
+    sampled = sampled - logq.astype(sampled.dtype)
+    # accidental hits: negative equal to a true label -> -inf
+    hit = (samples[:, nt:, None] == labels[:, None, :]).any(-1)
+    if attrs.get('remove_accidental_hits', True):
+        sampled = sampled.at[:, nt:].add(
+            jnp.where(hit, -1e20, 0.0).astype(sampled.dtype))
+    return {'SampledLogits': [sampled], 'Samples': [samples],
+            'Probabilities': [jnp.exp(logq)],
+            'SampledLabels': [jnp.broadcast_to(
+                jnp.arange(nt, dtype=jnp.int32), (n, nt))]}
+
+
+@register('pyramid_hash', no_grad_out_slots=('DropPos', 'X_Temp_Out'))
+def pyramid_hash(ctx, ins, attrs):
+    """Reference operators/pyramid_hash_op.cc (text n-gram hash
+    embedding): each n-gram (n = 2..max_pyramid) of input token ids is
+    hashed into [0, space_len) and the matching embedding rows are
+    summed per position.  Hashing is a fixed multiplicative mix instead
+    of the reference's xxhash (host-free, XLA-traceable)."""
+    x = ins['X'][0].astype(jnp.int32)    # [B, T]
+    w = ins['W'][0]                      # [space_len, emb]
+    num_emb = attrs.get('num_emb', w.shape[1])
+    space = w.shape[0]
+    pyramid = attrs.get('pyramid_layer', 2)
+    b, t = x.shape
+    mask = ins['Mask'][0] if ins.get('Mask') else jnp.ones((b, t))
+    out = jnp.zeros((b, t, num_emb), w.dtype)
+    h = x.astype(jnp.uint32)
+    valid = mask.astype(jnp.float32)
+    run = valid
+    for n in range(2, pyramid + 1):
+        nxt = jnp.roll(x, -(n - 1), axis=1).astype(jnp.uint32)
+        h = h * jnp.uint32(2654435761) + nxt * jnp.uint32(40503)
+        run = run * jnp.roll(valid, -(n - 1), axis=1)
+        ok = run * (jnp.arange(t) < t - (n - 1)).astype(jnp.float32)
+        idx = (h % jnp.uint32(space)).astype(jnp.int32)
+        out = out + w[idx] * ok[:, :, None].astype(w.dtype)
+    return {'Out': [out], 'DropPos': [jnp.zeros((b, t), jnp.int32)],
+            'X_Temp_Out': [x]}
+
+
+@register('filter_by_instag', no_grad_out_slots=('LossWeight', 'IndexMap'))
+def filter_by_instag(ctx, ins, attrs):
+    """Reference operators/filter_by_instag_op.cc keeps rows whose tag
+    set intersects Filter_tag (dynamic row count).  Dense TPU form:
+    shape-stable masking — non-matching rows are zeroed and LossWeight
+    carries the 0/1 row mask."""
+    x = ins['Ins'][0]                    # [B, D]
+    tags = ins['Ins_tag'][0].astype(jnp.int32)   # [B] one tag per row
+    filt = ins['Filter_tag'][0].astype(jnp.int32)  # [K]
+    keep = (tags[:, None] == filt[None, :]).any(-1)
+    lw = keep.astype(jnp.float32)
+    out = x * lw.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    idx = jnp.arange(x.shape[0], dtype=jnp.int32)
+    return {'Out': [out], 'LossWeight': [lw[:, None]],
+            'IndexMap': [jnp.stack([idx, idx], -1)]}
+
+
+@register('var_conv_2d')
+def var_conv_2d(ctx, ins, attrs):
+    """Reference operators/var_conv_2d_op.cc convolves per-sample
+    variable [H_i, W_i] match matrices.  Dense form: inputs are padded
+    to the bucket [B, 1, H, W] with Mask zeroing the padding before and
+    after the conv."""
+    x = ins['X'][0]
+    w = ins['W'][0]                      # [out_c, in_c*kh*kw]
+    out_c = attrs.get('output_channel', w.shape[0])
+    in_c = attrs.get('input_channel', x.shape[1])
+    kh = attrs.get('kernel_h', 3)
+    kw = attrs.get('kernel_w', 3)
+    sh = attrs.get('stride_h', 1)
+    sw = attrs.get('stride_w', 1)
+    if ins.get('Mask'):
+        x = x * ins['Mask'][0].astype(x.dtype)
+    wf = w.reshape(out_c, in_c, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, wf, window_strides=(sh, sw),
+        padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    if ins.get('Mask') and out.shape[2:] == x.shape[2:]:
+        out = out * ins['Mask'][0].astype(out.dtype)
+    return {'Out': [out]}
+
+
+@register('tree_conv')
+def tree_conv(ctx, ins, attrs):
+    """Reference operators/tree_conv_op.cc (TBCNN, depth-1 windows):
+    each node aggregates itself and its children with three weight
+    matrices mixed by position coefficients eta_t (self), eta_l/eta_r
+    (child slot, linear in the sibling index).
+
+    NodesVector [B, N, F]; EdgeSet [B, E, 2] (parent, child) with
+    negative padding; Filter [F, 3, hidden, channels]."""
+    nodes = ins['NodesVector'][0]
+    edges = ins['EdgeSet'][0].astype(jnp.int32)
+    w = ins['Filter'][0]                 # [F, 3, H, C]
+    b, n, f = nodes.shape
+    e = edges.shape[1]
+    wt, wl, wr = w[:, 0], w[:, 1], w[:, 2]   # each [F, H, C]
+    par, chi = edges[:, :, 0], edges[:, :, 1]
+    ok = ((par >= 0) & (chi >= 0)).astype(jnp.float32)
+    # sibling order/count per edge: O(E^2) masked compare (E static)
+    same = (par[:, :, None] == par[:, None, :]).astype(jnp.float32) * \
+        ok[:, :, None] * ok[:, None, :]
+    order = jnp.sum(same * (jnp.arange(e)[None, None, :] <
+                            jnp.arange(e)[None, :, None]), -1)
+    count = jnp.sum(same, -1)
+    eta_r = jnp.where(count > 1, order / jnp.maximum(count - 1, 1.0), 0.5)
+    eta_l = 1.0 - eta_r
+
+    cvec = jnp.take_along_axis(nodes, jnp.maximum(chi, 0)[:, :, None],
+                               axis=1)      # [B,E,F]
+    contrib = (jnp.einsum('bef,fhc->behc', cvec, wl) *
+               eta_l[:, :, None, None] +
+               jnp.einsum('bef,fhc->behc', cvec, wr) *
+               eta_r[:, :, None, None]) * ok[:, :, None, None]
+    agg = jnp.zeros((b, n) + contrib.shape[2:], contrib.dtype)
+    agg = agg.at[jnp.arange(b)[:, None], jnp.maximum(par, 0)].add(contrib)
+    self_term = jnp.einsum('bnf,fhc->bnhc', nodes, wt)
+    out = jnp.tanh(self_term + agg)
+    return {'Out': [out.reshape(b, n, -1)]}

@@ -498,3 +498,221 @@ def temporal_shift(ctx, ins, attrs):
     rest = xr[:, :, c2:]
     return {'Out': [jnp.concatenate([pre, post, rest],
                                     axis=2).reshape(nt, c, h, w)]}
+
+
+# ---------------------------------------------------------------------------
+# Parity batch: lrn / indexed pooling / unpool / conv variants
+# ---------------------------------------------------------------------------
+
+
+@register('lrn', no_grad_out_slots=('MidOut',))
+def lrn(ctx, ins, attrs):
+    """Reference operators/lrn_op.cc: cross-channel local response norm,
+    out = x / (k + alpha * sum_{local n channels} x^2) ^ beta."""
+    x = ins['X'][0]  # NCHW
+    n = attrs.get('n', 5)
+    k = attrs.get('k', 1.0)
+    alpha = attrs.get('alpha', 1e-4)
+    beta = attrs.get('beta', 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {'Out': [x * jnp.power(mid, -beta)], 'MidOut': [mid]}
+
+
+def _pool_patches(x, ksize, strides, paddings, neg):
+    """[N,C,H,W] -> (patches [N,C,OH,OW,kh*kw], flat index [kh*kw] maps).
+    Static unroll over the small kernel window."""
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    cols, idx = [], []
+    for i in range(kh):
+        for j in range(kw):
+            sl = jax.lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            cols.append(sl)
+            # global (unpadded) flat h*w index of this tap per output pos
+            hh = jnp.arange(oh) * sh + i - ph
+            ww = jnp.arange(ow) * sw + j - pw
+            idx.append(hh[:, None] * w + ww[None, :])
+    return jnp.stack(cols, -1), jnp.stack(idx, -1)  # [...,K],[OH,OW,K]
+
+
+@register('max_pool2d_with_index', no_grad_out_slots=('Mask',))
+def max_pool2d_with_index(ctx, ins, attrs):
+    """Reference operators/pool_with_index_op.cc: max pool + argmax
+    (flat h*w index) used by unpool."""
+    x = ins['X'][0]
+    ksize = attrs.get('ksize', [2, 2])
+    strides = attrs.get('strides', ksize)
+    pads = attrs.get('paddings', [0, 0])
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    patches, fidx = _pool_patches(x, ksize, strides, pads, neg)
+    am = jnp.argmax(patches, axis=-1)
+    out = jnp.max(patches, axis=-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(fidx, am.shape + (fidx.shape[-1],)),
+        am[..., None], axis=-1)[..., 0]
+    return {'Out': [out], 'Mask': [mask.astype(jnp.int32)]}
+
+
+@register('max_pool3d_with_index', no_grad_out_slots=('Mask',))
+def max_pool3d_with_index(ctx, ins, attrs):
+    """3-D variant: unroll over the (small, static) kd*kh*kw window."""
+    x = ins['X'][0]  # NCDHW
+    kd, kh, kw = attrs.get('ksize', [2, 2, 2])
+    strides = attrs.get('strides', [kd, kh, kw])
+    pd, ph, pw = attrs.get('paddings', [0, 0, 0])
+    sd, sh, sw = strides
+    n, c, d, h, w = x.shape
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    od = (d + 2 * pd - kd) // sd + 1
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    cols, idx = [], []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                sl = jax.lax.slice(
+                    xp, (0, 0, a, i, j),
+                    (n, c, a + (od - 1) * sd + 1, i + (oh - 1) * sh + 1,
+                     j + (ow - 1) * sw + 1), (1, 1, sd, sh, sw))
+                cols.append(sl)
+                dd = jnp.arange(od) * sd + a - pd
+                hh = jnp.arange(oh) * sh + i - ph
+                ww = jnp.arange(ow) * sw + j - pw
+                idx.append(dd[:, None, None] * (h * w) +
+                           hh[None, :, None] * w + ww[None, None, :])
+    patches = jnp.stack(cols, -1)
+    fidx = jnp.stack(idx, -1)
+    am = jnp.argmax(patches, axis=-1)
+    out = jnp.max(patches, axis=-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(fidx, am.shape + (fidx.shape[-1],)),
+        am[..., None], axis=-1)[..., 0]
+    return {'Out': [out], 'Mask': [mask.astype(jnp.int32)]}
+
+
+@register('unpool')
+def unpool(ctx, ins, attrs):
+    """Reference operators/unpool_op.cc: scatter pooled values back to
+    the argmax positions (indices from max_pool2d_with_index)."""
+    x = ins['X'][0]           # [N,C,h,w]
+    indices = ins['Indices'][0]
+    if attrs.get('unpooled_size'):
+        oh, ow = attrs['unpooled_size']
+    else:  # reference formula: (in-1)*stride - 2*pad + ksize
+        kh, kw = attrs.get('ksize', [2, 2])
+        sh, sw = attrs.get('strides', [kh, kw])
+        ph, pw = attrs.get('paddings', [0, 0])
+        oh = (x.shape[2] - 1) * sh - 2 * ph + kh
+        ow = (x.shape[3] - 1) * sw - 2 * pw + kw
+    n, c = x.shape[:2]
+    vals = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = out.at[jnp.arange(n)[:, None, None],
+                 jnp.arange(c)[None, :, None], idx].set(vals)
+    return {'Out': [out.reshape(n, c, oh, ow)]}
+
+
+@register('depthwise_conv2d_transpose')
+def depthwise_conv2d_transpose(ctx, ins, attrs):
+    """Grouped transpose conv via lhs-dilated conv_general_dilated
+    (conv_transpose lacks a groups parameter)."""
+    x = ins['Input'][0]
+    w = ins['Filter'][0]  # [in_c, 1, kh, kw], groups == in_c
+    strides = _pair(attrs.get('strides', [1, 1]))
+    dilations = _pair(attrs.get('dilations', [1, 1]))
+    p = _pair(attrs.get('paddings', [0, 0]))
+    groups = attrs.get('groups', x.shape[1]) or x.shape[1]
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    pad = [(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])]
+    # flip spatially and swap io: [in_c,1,kh,kw] -> OIHW with O=in_c
+    wf = jnp.flip(w, axis=(2, 3))
+    out = jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1, 1), padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        feature_group_count=groups)
+    return {'Output': [out]}
+
+
+@register('sync_batch_norm', no_grad_out_slots=('MeanOut', 'VarianceOut',
+                                                'SavedMean',
+                                                'SavedVariance'))
+def sync_batch_norm(ctx, ins, attrs):
+    """Reference operators/sync_batch_norm_op.cu (ncclAllReduce of
+    partial sums).  TPU-native: psum the per-device moments over the
+    data-parallel mesh axis when tracing inside shard_map; identical to
+    batch_norm outside one."""
+    if attrs.get('is_test', False) or attrs.get('use_global_stats', False):
+        return batch_norm(ctx, ins, attrs)   # running stats, no psum
+    axis = attrs.get('mesh_axis', 'dp')
+    try:
+        jax.lax.axis_index(axis)  # raises NameError outside shard_map
+    except NameError:
+        return batch_norm(ctx, ins, attrs)
+    x = ins['X'][0]
+    layout = attrs.get('data_layout', 'NCHW')
+    caxis = 1 if layout in ('NCHW', 'AnyLayout') else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != caxis)
+    xf = x.astype(jnp.float32)
+    n_local = np.prod([x.shape[i] for i in red])
+    s1 = jax.lax.psum(jnp.sum(xf, axis=red), axis)
+    s2 = jax.lax.psum(jnp.sum(jnp.square(xf), axis=red), axis)
+    n = n_local * jax.lax.psum(1, axis)
+    m = s1 / n
+    v = s2 / n - jnp.square(m)
+    eps = attrs.get('epsilon', 1e-5)
+    momentum = attrs.get('momentum', 0.9)
+    bshape = tuple(x.shape[caxis] if i == caxis else 1
+                   for i in range(x.ndim))
+    inv = jax.lax.rsqrt(v + eps)
+    y = (xf - m.reshape(bshape)) * inv.reshape(bshape)
+    y = y * ins['Scale'][0].reshape(bshape) + ins['Bias'][0].reshape(bshape)
+    unbiased = v * (n / jnp.maximum(n - 1.0, 1.0))
+    mean_out = momentum * ins['Mean'][0] + (1 - momentum) * m
+    var_out = momentum * ins['Variance'][0] + (1 - momentum) * unbiased
+    return {'Y': [y.astype(x.dtype)], 'MeanOut': [mean_out],
+            'VarianceOut': [var_out], 'SavedMean': [m],
+            'SavedVariance': [inv]}
+
+
+@register('row_conv')
+def row_conv(ctx, ins, attrs):
+    """Reference operators/row_conv_op.cc: lookahead convolution
+    (DeepSpeech2) — out[b,t] = sum_{j<ctx} x[b,t+j] * w[j]."""
+    x = ins['X'][0]           # [B,T,D]
+    w = ins['Filter'][0]      # [future_context, D]
+    fc = w.shape[0]
+    t = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, fc - 1), (0, 0)))
+    out = sum(xp[:, j:j + t] * w[j] for j in range(fc))
+    return {'Out': [out]}
+
+
+@register('conv_shift')
+def conv_shift(ctx, ins, attrs):
+    """Reference operators/conv_shift_op.cc: circular convolution
+    out[b,i] = sum_j x[b, (i + j - m//2) % n] * y[b, j]."""
+    x = ins['X'][0]  # [B,N]
+    y = ins['Y'][0]  # [B,M], M odd, M <= N
+    m = y.shape[1]
+    half = m // 2
+    out = sum(jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+              for j in range(m))
+    return {'Out': [out]}

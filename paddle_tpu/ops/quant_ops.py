@@ -82,3 +82,36 @@ def moving_average_abs_max_scale(ctx, ins, attrs):
     return {'Out': [x],
             'OutScale': [(rate * in_scale
                           + (1 - rate) * cur).reshape(1)]}
+
+
+# ---------------------------------------------------------------------------
+# INT8 inference quantization (reference operators/mkldnn
+# quantize/dequantize/requantize_mkldnn_op.cc — here plain XLA casts;
+# TPU int8 matmuls consume these via lax.dot int8 inputs)
+# ---------------------------------------------------------------------------
+
+
+@register('quantize', no_grad_out_slots=('Output',))
+def quantize(ctx, ins, attrs):
+    x = ins['Input'][0]
+    scale = attrs.get('Scale', 1.0)
+    shift = attrs.get('Shift', 0.0)
+    q = jnp.round(x * scale + shift)
+    return {'Output': [jnp.clip(q, -128, 127).astype(jnp.int8)]}
+
+
+@register('dequantize', no_grad_out_slots=('Output',))
+def dequantize(ctx, ins, attrs):
+    x = ins['Input'][0]
+    scale = attrs.get('Scale', 1.0)
+    shift = attrs.get('Shift', 0.0)
+    return {'Output': [(x.astype(jnp.float32) - shift) / scale]}
+
+
+@register('requantize', no_grad_out_slots=('Output',))
+def requantize(ctx, ins, attrs):
+    x = ins['Input'][0]
+    s_in = attrs.get('Scale_in', 1.0)
+    s_out = attrs.get('Scale_out', 1.0)
+    q = jnp.round(x.astype(jnp.float32) * (s_out / s_in))
+    return {'Output': [jnp.clip(q, -128, 127).astype(jnp.int8)]}

@@ -109,3 +109,180 @@ def gru(ctx, ins, attrs):
     if is_reverse:
         hs = jnp.flip(hs, 1)
     return {'Hidden': [hs], 'LastH': [last_h]}
+
+
+# ---------------------------------------------------------------------------
+# Single-step cells + projection / multi-layer variants
+# ---------------------------------------------------------------------------
+
+
+@register('gru_unit', no_grad_out_slots=('Gate', 'ResetHiddenPrev'))
+def gru_unit(ctx, ins, attrs):
+    """One GRU step (reference operators/gru_unit_op.h).
+    Input [B,3H] = x@Wx (pre-projected), HiddenPrev [B,H],
+    Weight [H,3H] (cols: update|reset gates, then candidate)."""
+    x = ins['Input'][0]
+    hp = ins['HiddenPrev'][0]
+    w = ins['Weight'][0]
+    h = hp.shape[-1]
+    if ins.get('Bias'):
+        x = x + ins['Bias'][0].reshape(1, -1)
+    zr = jax.nn.sigmoid(x[:, :2 * h] + hp @ w[:, :2 * h])
+    z, r = zr[:, :h], zr[:, h:]
+    rhp = r * hp
+    c = jnp.tanh(x[:, 2 * h:] + rhp @ w[:, 2 * h:])
+    out = (1 - z) * hp + z * c
+    return {'Hidden': [out], 'Gate': [jnp.concatenate([zr, c], -1)],
+            'ResetHiddenPrev': [rhp]}
+
+
+@register('lstm_unit')
+def lstm_unit(ctx, ins, attrs):
+    """One LSTM step (reference operators/lstm_unit_op.h): X [B,4H]
+    gate order i|f|o|g, C_prev [B,H], forget_bias attr."""
+    x = ins['X'][0]
+    cp = ins['C_prev'][0]
+    h = cp.shape[-1]
+    fb = attrs.get('forget_bias', 0.0)
+    i = jax.nn.sigmoid(x[:, :h])
+    f = jax.nn.sigmoid(x[:, h:2 * h] + fb)
+    o = jax.nn.sigmoid(x[:, 2 * h:3 * h])
+    g = jnp.tanh(x[:, 3 * h:])
+    c = f * cp + i * g
+    return {'C': [c], 'H': [o * jnp.tanh(c)]}
+
+
+@register('lstmp', no_grad_out_slots=('LastH', 'LastC'))
+def lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (reference operators/lstmp_op.h):
+    Input [B,T,4H] pre-projected, Weight [P,4H] (recurrence over the
+    projected state), ProjWeight [H,P].  Outputs Projection [B,T,P]."""
+    x = ins['Input'][0]
+    w = ins['Weight'][0]
+    wp = ins['ProjWeight'][0]
+    b, t, h4 = x.shape
+    h = h4 // 4
+    p = wp.shape[1]
+    mask = ins['Mask'][0] if ins.get('Mask') else None
+    r0 = jnp.zeros((b, p), x.dtype)
+    c0 = ins['C0'][0] if ins.get('C0') else jnp.zeros((b, h), x.dtype)
+
+    def step(carry, inp):
+        rp, cp, ti = carry
+        gates = inp + rp @ w
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * cp + jax.nn.sigmoid(i) * jnp.tanh(g)
+        hh = jax.nn.sigmoid(o) * jnp.tanh(c)
+        r = hh @ wp
+        if mask is not None:
+            m = jax.lax.dynamic_index_in_dim(mask, ti, 1,
+                                             keepdims=False)[:, None]
+            m = m.astype(r.dtype)
+            r = m * r + (1 - m) * rp
+            c = m * c + (1 - m) * cp
+        return (r, c, ti + 1), (r, c)
+
+    (last_r, last_c, _), (rs, cs) = jax.lax.scan(
+        step, (r0, c0, 0), jnp.swapaxes(x, 0, 1))
+    return {'Projection': [jnp.swapaxes(rs, 0, 1)],
+            'Cell': [jnp.swapaxes(cs, 0, 1)],
+            'LastH': [last_r], 'LastC': [last_c]}
+
+
+@register('cudnn_lstm', no_grad_out_slots=('LastH', 'LastC'))
+def cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer (bi)LSTM from one flat weight blob (reference
+    operators/cudnn_lstm_op.cu delegates to cuDNN).  TPU-native: the
+    blob layout is per (layer, direction): Wx [D,4H] | Wh [H,4H] |
+    bias [4H]; the time loop is lax.scan per layer.
+    Input [T,B,D] (time-major, as the reference), InitH/InitC
+    [L*dirs,B,H]."""
+    x = ins['Input'][0]
+    w = ins['W'][0].reshape(-1)
+    hidden = attrs['hidden_size']
+    layers = attrs.get('num_layers', 1)
+    bidirec = attrs.get('is_bidirec', False)
+    dirs = 2 if bidirec else 1
+    t, b, d_in = x.shape
+    h0 = ins['InitH'][0] if ins.get('InitH') else \
+        jnp.zeros((layers * dirs, b, hidden), x.dtype)
+    c0 = ins['InitC'][0] if ins.get('InitC') else \
+        jnp.zeros((layers * dirs, b, hidden), x.dtype)
+
+    def run_dir(xs, wx, wh, bias, h_init, c_init, rev):
+        if rev:
+            xs = jnp.flip(xs, 0)
+
+        def step(carry, xt):
+            hp, cp = carry
+            gates = xt @ wx + hp @ wh + bias
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * cp + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hh = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (hh, c), hh
+
+        (lh, lc), hs = jax.lax.scan(step, (h_init, c_init), xs)
+        if rev:
+            hs = jnp.flip(hs, 0)
+        return hs, lh, lc
+
+    off = 0
+    outs = x
+    last_h, last_c = [], []
+    for layer in range(layers):
+        din = outs.shape[-1]
+        per_dir = []
+        for dr in range(dirs):
+            nwx, nwh = din * 4 * hidden, hidden * 4 * hidden
+            wx = w[off:off + nwx].reshape(din, 4 * hidden); off += nwx
+            wh = w[off:off + nwh].reshape(hidden, 4 * hidden); off += nwh
+            bias = w[off:off + 4 * hidden]; off += 4 * hidden
+            idx = layer * dirs + dr
+            hs, lh, lc = run_dir(outs, wx, wh, bias, h0[idx], c0[idx],
+                                 rev=(dr == 1))
+            per_dir.append(hs)
+            last_h.append(lh)
+            last_c.append(lc)
+        outs = jnp.concatenate(per_dir, -1) if dirs == 2 else per_dir[0]
+    return {'Out': [outs], 'LastH': [jnp.stack(last_h)],
+            'LastC': [jnp.stack(last_c)]}
+
+
+@register('attention_lstm', no_grad_out_slots=('AttentionedX',))
+def attention_lstm(ctx, ins, attrs):
+    """Reference operators/fused/attention_lstm_op.cc: per step, score
+    every timestep against the previous hidden, softmax over T, and feed
+    the attended context vector through an LSTM cell."""
+    x = ins['X'][0]                     # [B,T,M]
+    c0 = ins['C0'][0]                   # [B,D]
+    h0 = ins['H0'][0] if ins.get('H0') else jnp.zeros_like(c0)
+    att_w = ins['AttentionWeight'][0]   # [M+D,1]
+    att_b = ins['AttentionBias'][0] if ins.get('AttentionBias') else None
+    lstm_w = ins['LSTMWeight'][0]       # [M+D,4D]
+    lstm_b = ins['LSTMBias'][0]         # [1,4D]
+    mask = ins['Mask'][0] if ins.get('Mask') else None
+    b, t, m = x.shape
+    d = c0.shape[-1]
+
+    def step(carry, _):
+        hp, cp = carry
+        hexp = jnp.broadcast_to(hp[:, None, :], (b, t, d))
+        e = jnp.concatenate([x, hexp], -1) @ att_w  # [B,T,1]
+        if att_b is not None:
+            e = e + att_b.reshape(1, 1, -1)
+        e = e[..., 0]
+        if mask is not None:
+            e = jnp.where(mask > 0, e, -1e9)
+        a = jax.nn.softmax(e, axis=1)
+        ctx_vec = jnp.einsum('bt,btm->bm', a, x)
+        gates = jnp.concatenate([ctx_vec, hp], -1) @ lstm_w + \
+            lstm_b.reshape(1, -1)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * cp + jax.nn.sigmoid(i) * jnp.tanh(g)
+        hh = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (hh, c), (hh, c)
+
+    (lh, lc), (hs, cs) = jax.lax.scan(step, (h0, c0), None, length=t)
+    return {'Hidden': [jnp.swapaxes(hs, 0, 1)],
+            'Cell': [jnp.swapaxes(cs, 0, 1)],
+            'AttentionedX': [x]}

@@ -458,3 +458,164 @@ def assign_value(ctx, ins, attrs):
     vals = np.asarray(attrs['values'], dtype=dtype).reshape(
         tuple(int(s) for s in attrs['shape']))
     return {'Out': [jnp.asarray(vals)]}
+
+
+# ---------------------------------------------------------------------------
+# v1-style shape ops (no XShape output) + misc parity ops
+# ---------------------------------------------------------------------------
+
+
+@register('squeeze')
+def squeeze(ctx, ins, attrs):
+    """Reference operators/squeeze_op.cc (v1: no XShape output)."""
+    x = _x(ins)
+    axes = attrs.get('axes', [])
+    if axes:
+        shape = [d for i, d in enumerate(x.shape)
+                 if not (d == 1 and (i in axes or i - x.ndim in axes))]
+    else:
+        shape = [d for d in x.shape if d != 1]
+    return {'Out': [x.reshape(shape)]}
+
+
+@register('flatten')
+def flatten(ctx, ins, attrs):
+    """Reference operators/flatten_op.cc (v1): fold dims up to `axis`."""
+    x = _x(ins)
+    axis = attrs.get('axis', 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {'Out': [x.reshape(lead, -1)]}
+
+
+@register('reverse')
+def reverse(ctx, ins, attrs):
+    """Reference operators/reverse_op.cc: flip along `axis` list."""
+    x = _x(ins)
+    return {'Out': [jnp.flip(x, axis=tuple(attrs.get('axis', [0])))]}
+
+
+@register('coalesce_tensor', no_grad_out_slots=('FusedOutput',))
+def coalesce_tensor(ctx, ins, attrs):
+    """Reference operators/coalesce_tensor_op.cc: fuse a list of grads
+    into one contiguous buffer for a single fused collective
+    (coalesce_grad_tensor_pass analog).  On XLA the flat buffer is a
+    concat of the flattened inputs; outputs alias the inputs."""
+    xs = ins['Input']
+    flat = jnp.concatenate([v.reshape(-1) for v in xs])
+    return {'Output': list(xs), 'FusedOutput': [flat]}
+
+
+@register('shuffle_batch', no_grad_out_slots=('ShuffleIdx', 'SeedOut'))
+def shuffle_batch(ctx, ins, attrs):
+    """Reference operators/shuffle_batch_op.cc: random row permutation.
+    Permutation is a pure function of (op_seed, step) via ctx.rng."""
+    x = _x(ins)
+    idx = jax.random.permutation(ctx.rng(), x.shape[0])
+    return {'Out': [x[idx]], 'ShuffleIdx': [idx.astype(jnp.int64)],
+            'SeedOut': [jnp.asarray([ctx.op_seed], jnp.int32)]}
+
+
+@register('minus')
+def minus(ctx, ins, attrs):
+    """Reference operators/minus_op.cc."""
+    return {'Out': [ins['X'][0] - ins['Y'][0]]}
+
+
+# ---------------------------------------------------------------------------
+# Tensor-array family (reference operators/controlflow/tensor_array_*,
+# lod_tensor_to_array_op.cc, shrink_rnn_memory_op.cc).
+#
+# TPU-native re-design: a LoDTensorArray of T same-shaped items is a
+# stacked dense tensor with leading time axis [T, ...]; reads/writes are
+# lax dynamic slicing so the whole RNN unrolls inside one XLA
+# computation (dynamic-length python lists cannot be traced).
+# ---------------------------------------------------------------------------
+
+
+@register('write_to_array')
+def write_to_array(ctx, ins, attrs):
+    x = _x(ins)
+    i = ins['I'][0].reshape(()).astype(jnp.int32)
+    arr = ins['Array'][0]
+    return {'Out': [jax.lax.dynamic_update_index_in_dim(
+        arr, x.astype(arr.dtype), i, 0)]}
+
+
+@register('read_from_array')
+def read_from_array(ctx, ins, attrs):
+    arr = _x(ins)
+    i = ins['I'][0].reshape(()).astype(jnp.int32)
+    return {'Out': [jax.lax.dynamic_index_in_dim(arr, i, 0,
+                                                 keepdims=False)]}
+
+
+@register('lod_tensor_to_array')
+def lod_tensor_to_array(ctx, ins, attrs):
+    """[B, T, ...] batch -> time-major stack [T, B, ...] (the reference
+    splits by LoD rank table; padded+mask makes it a transpose)."""
+    x = _x(ins)
+    return {'Out': [jnp.swapaxes(x, 0, 1)]}
+
+
+@register('array_to_lod_tensor')
+def array_to_lod_tensor(ctx, ins, attrs):
+    x = _x(ins)
+    return {'Out': [jnp.swapaxes(x, 0, 1)]}
+
+
+@register('shrink_rnn_memory')
+def shrink_rnn_memory(ctx, ins, attrs):
+    """Reference operators/shrink_rnn_memory_op.cc keeps the first
+    `rank_table[i]` rows at step I.  Dense form: zero out finished rows
+    (RankTable -> per-row lengths vector)."""
+    x = _x(ins)
+    i = ins['I'][0].reshape(()).astype(jnp.int32)
+    lengths = ins['RankTable'][0].astype(jnp.int32)
+    keep = (lengths > i).astype(x.dtype)
+    return {'Out': [x * keep.reshape((-1,) + (1,) * (x.ndim - 1))]}
+
+
+@register('split_lod_tensor')
+def split_lod_tensor(ctx, ins, attrs):
+    """Dense form of operators/controlflow/split_lod_tensor_op.cc: both
+    branches get the full tensor with non-selected rows zeroed."""
+    x = _x(ins)
+    m = ins['Mask'][0].reshape((-1,) + (1,) * (x.ndim - 1))
+    m = m.astype(x.dtype)
+    return {'OutTrue': [x * m], 'OutFalse': [x * (1 - m)]}
+
+
+@register('merge_lod_tensor')
+def merge_lod_tensor(ctx, ins, attrs):
+    x_t = ins['InTrue'][0]
+    x_f = ins['InFalse'][0]
+    m = ins['Mask'][0].reshape((-1,) + (1,) * (x_t.ndim - 1))
+    return {'Out': [jnp.where(m.astype(bool), x_t, x_f)]}
+
+
+@register('select_input')
+def select_input(ctx, ins, attrs):
+    """Reference operators/controlflow/select_input_op.cc: Out = X[mask].
+    Dense: stack the candidates and index with the traced scalar."""
+    xs = jnp.stack(ins['X'])
+    m = ins['Mask'][0].reshape(()).astype(jnp.int32)
+    return {'Out': [jax.lax.dynamic_index_in_dim(xs, m, 0,
+                                                 keepdims=False)]}
+
+
+@register('select_output')
+def select_output(ctx, ins, attrs):
+    """Route X to branch `mask`; unselected branches read zeros."""
+    x = _x(ins)
+    m = ins['Mask'][0].reshape(()).astype(jnp.int32)
+    n = attrs.get('branches', 2)
+    return {'Out': [jnp.where(m == k, x, jnp.zeros_like(x))
+                    for k in range(n)]}
+
+
+@register('split_byref')
+def split_byref(ctx, ins, attrs):
+    """Reference operators/split_byref_op.cc — same math as split, the
+    by-ref aliasing is meaningless under XLA's value semantics."""
+    from .tensor_ops import split as _split
+    return _split(ctx, ins, attrs)

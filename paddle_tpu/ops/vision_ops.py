@@ -475,3 +475,128 @@ def bipartite_match(executor, scope, op):
                     match_dist[0, j] = dist[i, j]
     scope.set_var(op.output('ColToRowMatchIndices')[0], match_idx)
     scope.set_var(op.output('ColToRowMatchDist')[0], match_dist)
+
+
+# ---------------------------------------------------------------------------
+# Deformable conv family + precise RoI pooling
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_at(img, py, px):
+    """img [C,Hp,Wp] (zero outside), py/px [...] float coords ->
+    [C, ...] bilinearly interpolated, zero outside the map."""
+    c, h, w = img.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+    out = 0.
+    for dy, fy in ((0, 1 - wy), (1, wy)):
+        for dx, fx in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            valid = ((yy >= 0) & (yy < h) & (xx >= 0) &
+                     (xx < w)).astype(img.dtype)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yc, xc]  # [C, ...]
+            out = out + v * (fy * fx * valid).astype(img.dtype)[None]
+    return out
+
+
+def _deformable_conv(ctx, ins, attrs, modulated):
+    """Reference operators/deformable_conv_op.cc (v2, modulated) and
+    deformable_conv_v1_op.cc: per-tap learned offsets, bilinear
+    sampling, then a dense matmul with the filter (MXU-friendly: the
+    gather produces im2col columns and the contraction is one einsum)."""
+    x = ins['Input'][0]          # [N,C,H,W]
+    offset = ins['Offset'][0]    # [N, 2*dg*K, OH, OW]
+    w = ins['Filter'][0]         # [O, C/groups, kh, kw]
+    groups = attrs.get('groups', 1) or 1
+    dg = attrs.get('deformable_groups', 1) or 1
+    sh, sw = attrs.get('strides', [1, 1])
+    ph, pw = attrs.get('paddings', [0, 0])
+    dh, dw = attrs.get('dilations', [1, 1])
+    n, c, h_in, w_in = x.shape
+    o_c, _, kh, kw = w.shape
+    k = kh * kw
+    oh = (h_in + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w_in + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    off = offset.reshape(n, dg, k, 2, oh, ow)
+    mask = (ins['Mask'][0].reshape(n, dg, k, oh, ow)
+            if modulated and ins.get('Mask') else None)
+    base_y = jnp.arange(oh) * sh - ph
+    base_x = jnp.arange(ow) * sw - pw
+    cg = c // dg
+
+    def sample_one(img, off_b, mask_b):
+        # img [C,H,W]; off_b [dg,K,2,OH,OW]
+        cols = []
+        for g in range(dg):
+            ch = img[g * cg:(g + 1) * cg]
+            taps = []
+            for t in range(k):
+                i, j = divmod(t, kw)
+                py = base_y[:, None] + i * dh + off_b[g, t, 0]
+                px = base_x[None, :] + j * dw + off_b[g, t, 1]
+                v = _bilinear_at(ch, py, px)  # [cg,OH,OW]
+                if mask_b is not None:
+                    v = v * mask_b[g, t][None]
+                taps.append(v)
+            cols.append(jnp.stack(taps, 1))  # [cg,K,OH,OW]
+        return jnp.concatenate(cols, 0)      # [C,K,OH,OW]
+
+    if mask is not None:
+        cols = jax.vmap(sample_one)(x, off, mask)
+    else:  # v1, or modulated with no Mask input (all-ones modulation)
+        cols = jax.vmap(lambda a, b: sample_one(a, b, None))(x, off)
+    wg = w.reshape(groups, o_c // groups, c // groups, kh * kw)
+    colsg = cols.reshape(n, groups, c // groups, k, oh, ow)
+    out = jnp.einsum('ngckhw,gock->ngohw', colsg, wg)
+    return {'Output': [out.reshape(n, o_c, oh, ow)]}
+
+
+@register('deformable_conv')
+def deformable_conv(ctx, ins, attrs):
+    return _deformable_conv(ctx, ins, attrs, modulated=True)
+
+
+@register('deformable_conv_v1')
+def deformable_conv_v1(ctx, ins, attrs):
+    return _deformable_conv(ctx, ins, attrs, modulated=False)
+
+
+@register('prroi_pool')
+def prroi_pool(ctx, ins, attrs):
+    """Precise RoI pooling (reference operators/prroi_pool_op.cc).
+    The exact bin integral of the bilinear surface is approximated by a
+    dense 4x4 sample average per bin — XLA-friendly static gather."""
+    x = ins['X'][0]
+    rois = ins['ROIs'][0]  # [R,4] x1,y1,x2,y2
+    scale = attrs.get('spatial_scale', 1.0)
+    p_h = attrs.get('pooled_height', 1)
+    p_w = attrs.get('pooled_width', 1)
+    ns = 4
+    batch_idx = (ins['BatchRoINums'][0].astype(jnp.int32)
+                 if ins.get('BatchRoINums') else
+                 jnp.zeros((rois.shape[0],), jnp.int32))
+
+    def one(roi, bi):
+        img = x[bi]
+        x1, y1, x2, y2 = roi * scale
+        bw = jnp.maximum((x2 - x1) / p_w, 1e-6)
+        bh = jnp.maximum((y2 - y1) / p_h, 1e-6)
+        iy = (jnp.arange(p_h)[:, None] +
+              (jnp.arange(ns) + 0.5)[None, :] / ns)  # [p_h,ns]
+        ix = (jnp.arange(p_w)[:, None] +
+              (jnp.arange(ns) + 0.5)[None, :] / ns)
+        py = y1 + iy.reshape(-1) * bh   # [p_h*ns]
+        px = x1 + ix.reshape(-1) * bw
+        grid_y = jnp.repeat(py, p_w * ns)
+        grid_x = jnp.tile(px, p_h * ns)
+        v = _bilinear_at(img, grid_y, grid_x)  # [C, p_h*ns*p_w*ns]
+        v = v.reshape(x.shape[1], p_h, ns, p_w, ns)
+        return v.mean(axis=(2, 4))
+
+    out = jax.vmap(one)(rois, batch_idx)
+    return {'Out': [out]}

@@ -121,3 +121,32 @@ def host_emb_update(executor, scope, op):
     grad = np.asarray(core.as_array(
         scope.find_var(op.input('Grad')[0])))
     table._push(ids, grad)
+
+
+@registry.register_host('distributed_lookup_table')
+def distributed_lookup_table(executor, scope, op):
+    """Reference operators/distributed_ops/distributed_lookup_table_op.cc
+    (gRPC prefetch from pservers) -> host-sharded table pull."""
+    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    for ids_name, out_name in zip(op.input('Ids'), op.output('Outputs')):
+        ids = np.asarray(core.as_array(scope.find_var(ids_name)))
+        scope.set_var(out_name, table._pull(ids))
+
+
+@registry.register_host('pull_box_sparse')
+def pull_box_sparse(executor, scope, op):
+    """Reference operators/pull_box_sparse_op.cc (BoxPS embedding pull)
+    -> same host-sharded table path."""
+    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    for ids_name, out_name in zip(op.input('Ids'), op.output('Out')):
+        ids = np.asarray(core.as_array(scope.find_var(ids_name)))
+        scope.set_var(out_name, table._pull(ids))
+
+
+@registry.register_host('push_box_sparse')
+def push_box_sparse(executor, scope, op):
+    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    for ids_name, g_name in zip(op.input('Ids'), op.input('Out@GRAD')):
+        ids = np.asarray(core.as_array(scope.find_var(ids_name)))
+        grad = np.asarray(core.as_array(scope.find_var(g_name)))
+        table._push(ids, grad)

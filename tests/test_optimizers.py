@@ -69,6 +69,7 @@ def test_adam_op():
 def _train_quadratic(optimizer, steps=100):
     """Minimize ||Wx - y||^2; returns final loss."""
     main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
     with fluid.program_guard(main, startup):
         x = fluid.layers.data('x', shape=[4], dtype='float32')
         y = fluid.layers.data('y', shape=[2], dtype='float32')
