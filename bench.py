@@ -57,9 +57,13 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
+        # warm up BOTH call signatures used below (fetch vs no-fetch
+        # compile to different XLA programs) so no compile lands in the
+        # timed region
         for _ in range(warmup):
-            l, = exe.run(main, feed={'image': x, 'label': y},
-                         fetch_list=[loss])
+            exe.run(main, feed={'image': x, 'label': y}, fetch_list=[])
+        l, = exe.run(main, feed={'image': x, 'label': y},
+                     fetch_list=[loss])
         np.asarray(l)  # force completion of warmup before timing
         t0 = time.time()
         # steady-state steps: no per-step fetch, dispatch stays async

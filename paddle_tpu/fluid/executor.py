@@ -285,6 +285,11 @@ class Executor(object):
             val = feed[name]
             if isinstance(val, core.LoDTensor):
                 val = val.data
+            if isinstance(val, jax.Array):
+                # device-resident feed: hand the buffer to jit as-is —
+                # np.asarray here would round-trip it through the host
+                # on every step
+                return val
             return np.asarray(val)
         val = scope.find_var(name)
         if val is None:
@@ -297,8 +302,15 @@ class Executor(object):
         if seg.compiled is None:
             fn = _make_segment_fn(seg)
             seg.compiled = jax.jit(fn, donate_argnums=(1,))
-        state = {n: self._lookup_input(n, feed, scope)
-                 for n in seg.state_names}
+        state = {}
+        for n in seg.state_names:
+            v = self._lookup_input(n, feed, scope)
+            if n in feed and isinstance(v, jax.Array):
+                # state buffers are donated to the jitted step; donating
+                # a caller-owned fed array would invalidate it, so hand
+                # jit a fresh copy instead
+                v = jax.numpy.array(v, copy=True)
+            state[n] = v
         data = {n: self._lookup_input(n, feed, scope)
                 for n in seg.input_names}
         with jax.default_device(device):

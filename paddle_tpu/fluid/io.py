@@ -139,6 +139,39 @@ def load_inference_model(dirname, executor, model_filename=None,
     return program, model['feed_names'], fetch_vars
 
 
+def save_train_model(dirname, main_program, startup_program, feed_names,
+                     fetch_vars):
+    """Serialize a full training job (main + startup programs) so it can
+    be driven without Python authoring — the C++ training entry point.
+    Reference: paddle/fluid/train/demo/demo_trainer.cc loads the program
+    saved by fluid.io.save_inference_model's training counterpart.
+    """
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, 'main.json'), 'w') as f:
+        json.dump(main_program.to_dict(), f)
+    with open(os.path.join(dirname, 'startup.json'), 'w') as f:
+        json.dump(startup_program.to_dict(), f)
+    spec = {
+        'feed_names': list(feed_names),
+        'fetch_names': [v.name if isinstance(v, framework.Variable) else v
+                        for v in fetch_vars],
+    }
+    with open(os.path.join(dirname, 'train_spec.json'), 'w') as f:
+        json.dump(spec, f)
+
+
+def load_train_model(dirname):
+    """Counterpart of save_train_model; returns
+    (main_program, startup_program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, 'main.json')) as f:
+        main = Program.from_dict(json.load(f))
+    with open(os.path.join(dirname, 'startup.json')) as f:
+        startup = Program.from_dict(json.load(f))
+    with open(os.path.join(dirname, 'train_spec.json')) as f:
+        spec = json.load(f)
+    return main, startup, spec['feed_names'], spec['fetch_names']
+
+
 def get_program_parameter(program):
     return program.all_parameters()
 

@@ -1,0 +1,540 @@
+/* Implementation of the paddle_tpu C API (see c_api.h).
+ *
+ * Embeds CPython and drives the paddle_tpu runtime through a private
+ * helper module; the compute itself is the same cached XLA executables
+ * the Python API runs.  Mirrors the surface of the reference C API
+ * (reference: paddle/fluid/inference/capi/c_api.cc,
+ * pd_predictor.cc, pd_tensor.cc, pd_config.cc).
+ */
+
+#include "c_api.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+/* Public entry points clear the error so PD_GetLastError() == "" means
+ * "last call succeeded", per the c_api.h contract. */
+void ClearError() { g_last_error.clear(); }
+
+/* Helper module executed inside the embedded interpreter.  All
+ * predictor/trainer state lives behind integer handles so the C side
+ * never owns PyObjects across calls. */
+const char kBootstrapSrc[] = R"PY(
+import os, sys
+
+_root = os.environ.get('PADDLE_TPU_ROOT')
+if _root and _root not in sys.path:
+    sys.path.insert(0, _root)
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+import jax
+_plat = os.environ.get('PADDLE_TPU_CAPI_PLATFORM')
+if _plat:
+    jax.config.update('jax_platforms', _plat)
+
+_objs = {}
+_next_id = [1]
+
+
+def _put(obj):
+    h = _next_id[0]
+    _next_id[0] += 1
+    _objs[h] = obj
+    return h
+
+
+def create_predictor(model_dir, params_path, use_xla):
+    cfg = AnalysisConfig(model_dir)
+    if params_path:
+        cfg.params_filename = params_path
+    if not use_xla:
+        cfg.disable_gpu()
+    return _put(create_paddle_predictor(cfg))
+
+
+def input_names(h):
+    return list(_objs[h].get_input_names())
+
+
+def output_names(h):
+    return list(_objs[h].get_output_names())
+
+
+def _feed_from(inputs):
+    feed = {}
+    for name, dtype, shape, buf in inputs:
+        feed[name] = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    return feed
+
+
+def run(h, inputs):
+    p = _objs[h]
+    names = p.get_output_names()
+    if inputs and all(t[0] for t in inputs):
+        outs = p.run_dict(_feed_from(inputs))
+    else:  # unnamed tensors: positional feed order
+        outs = [t.data for t in p.run(
+            [np.frombuffer(b, dtype=d).reshape(s)
+             for _, d, s, b in inputs])]
+    res = []
+    for name, o in zip(names, outs):
+        a = np.ascontiguousarray(np.asarray(o))
+        res.append((name, a.dtype.str, tuple(int(x) for x in a.shape),
+                    a.tobytes()))
+    return res
+
+
+class _Trainer:
+    def __init__(self, model_dir, use_accelerator):
+        self.scope = fluid.Scope()
+        place = fluid.XLAPlace(0) if use_accelerator else fluid.CPUPlace()
+        self.exe = fluid.Executor(place)
+        (self.main, self.startup, self.feed_names,
+         self.fetch_names) = fluid.io.load_train_model(model_dir)
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+
+    def step(self, inputs):
+        feed = _feed_from(inputs)
+        with fluid.scope_guard(self.scope):
+            outs = self.exe.run(self.main, feed=feed,
+                                fetch_list=list(self.fetch_names))
+        return float(np.asarray(outs[0]).reshape(-1)[0]) if outs else 0.0
+
+    def save(self, dirname):
+        with fluid.scope_guard(self.scope):
+            fluid.io.save_persistables(self.exe, dirname, self.main)
+
+
+def create_trainer(model_dir, use_accelerator):
+    return _put(_Trainer(model_dir, use_accelerator))
+
+
+def trainer_feed_names(h):
+    return list(_objs[h].feed_names)
+
+
+def trainer_step(h, inputs):
+    return _objs[h].step(inputs)
+
+
+def trainer_save(h, dirname):
+    _objs[h].save(dirname)
+    return True
+
+
+def release(h):
+    _objs.pop(h, None)
+)PY";
+
+PyObject* g_module_dict = nullptr;  // owned; helper namespace
+std::once_flag g_init_flag;
+bool g_init_ok = false;
+
+void InitializePython() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    /* Release the GIL acquired by Py_InitializeEx so PyGILState_Ensure
+     * works uniformly from any thread afterwards. */
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = PyModule_New("_paddle_tpu_capi");
+  PyObject* dict = PyModule_GetDict(mod);  // borrowed
+  PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res =
+      PyRun_String(kBootstrapSrc, Py_file_input, dict, dict);
+  if (res == nullptr) {
+    PyErr_Print();
+    Py_DECREF(mod);
+    PyGILState_Release(gil);
+    g_init_ok = false;
+    return;
+  }
+  Py_DECREF(res);
+  Py_INCREF(dict);
+  g_module_dict = dict;
+  Py_DECREF(mod);  // dict stays alive via our INCREF
+  PyGILState_Release(gil);
+  g_init_ok = true;
+}
+
+bool EnsureRuntime() {
+  std::call_once(g_init_flag, InitializePython);
+  if (!g_init_ok) SetError("paddle_tpu C API: embedded runtime failed to start");
+  return g_init_ok;
+}
+
+std::string FetchPyError() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+/* Calls helper `fn(*args)`; returns new ref or nullptr (error set). */
+PyObject* CallHelper(const char* fn, PyObject* args) {
+  PyObject* f = PyDict_GetItemString(g_module_dict, fn);  // borrowed
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    SetError(std::string("missing helper: ") + fn);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_XDECREF(args);
+  if (out == nullptr) SetError(FetchPyError());
+  return out;
+}
+
+const char* DTypeToNumpy(PD_DataType t) {
+  switch (t) {
+    case PD_FLOAT32: return "<f4";
+    case PD_INT32: return "<i4";
+    case PD_INT64: return "<i8";
+    case PD_UINT8: return "|u1";
+    default: return "<f4";
+  }
+}
+
+PD_DataType NumpyToDType(const std::string& s) {
+  if (s == "<f4" || s == "=f4" || s == "float32") return PD_FLOAT32;
+  if (s == "<i4" || s == "=i4" || s == "int32") return PD_INT32;
+  if (s == "<i8" || s == "=i8" || s == "int64") return PD_INT64;
+  if (s == "|u1" || s == "uint8") return PD_UINT8;
+  return PD_UNKDTYPE;
+}
+
+}  // namespace
+
+struct PD_Tensor {
+  std::string name;
+  PD_DataType dtype = PD_FLOAT32;
+  std::vector<int> shape;
+  std::vector<char> data;
+};
+
+struct PD_AnalysisConfig {
+  std::string model_dir;
+  std::string params_path;
+  bool use_xla = true;
+  bool ir_optim = true;
+};
+
+struct PD_Predictor {
+  long handle = 0;
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+struct PD_Trainer {
+  long handle = 0;
+  std::vector<std::string> feed_names;
+};
+
+extern "C" {
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+/* -- config --------------------------------------------------------- */
+
+PD_AnalysisConfig* PD_NewAnalysisConfig() { return new PD_AnalysisConfig(); }
+
+void PD_DeleteAnalysisConfig(PD_AnalysisConfig* c) { delete c; }
+
+void PD_SetModel(PD_AnalysisConfig* c, const char* model_dir,
+                 const char* params_path) {
+  c->model_dir = model_dir ? model_dir : "";
+  c->params_path = params_path ? params_path : "";
+}
+
+const char* PD_ModelDir(const PD_AnalysisConfig* c) {
+  return c->model_dir.c_str();
+}
+
+void PD_DisableGpu(PD_AnalysisConfig* c) { c->use_xla = false; }
+
+void PD_SwitchIrOptim(PD_AnalysisConfig* c, bool x) { c->ir_optim = x; }
+
+void PD_EnableMemoryOptim(PD_AnalysisConfig*) {}
+
+/* -- tensor --------------------------------------------------------- */
+
+PD_Tensor* PD_NewPaddleTensor() { return new PD_Tensor(); }
+
+void PD_DeletePaddleTensor(PD_Tensor* t) { delete t; }
+
+void PD_SetPaddleTensorName(PD_Tensor* t, const char* name) {
+  t->name = name ? name : "";
+}
+
+void PD_SetPaddleTensorDType(PD_Tensor* t, PD_DataType dtype) {
+  t->dtype = dtype;
+}
+
+void PD_SetPaddleTensorShape(PD_Tensor* t, const int* shape, int rank) {
+  t->shape.assign(shape, shape + rank);
+}
+
+void PD_SetPaddleTensorData(PD_Tensor* t, const void* data, size_t bytes) {
+  const char* p = static_cast<const char*>(data);
+  t->data.assign(p, p + bytes);
+}
+
+const char* PD_GetPaddleTensorName(const PD_Tensor* t) {
+  return t->name.c_str();
+}
+
+PD_DataType PD_GetPaddleTensorDType(const PD_Tensor* t) { return t->dtype; }
+
+const int* PD_GetPaddleTensorShape(const PD_Tensor* t, int* rank) {
+  if (rank != nullptr) *rank = static_cast<int>(t->shape.size());
+  return t->shape.data();
+}
+
+const void* PD_GetPaddleTensorData(const PD_Tensor* t, size_t* bytes) {
+  if (bytes != nullptr) *bytes = t->data.size();
+  return t->data.data();
+}
+
+/* -- shared marshalling --------------------------------------------- */
+
+namespace {
+
+/* new ref: [(name, dtype_str, shape_tuple, bytes), ...] */
+PyObject* TensorsToPyList(PD_Tensor* const* inputs, int n) {
+  PyObject* list = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    const PD_Tensor* t = inputs[i];
+    PyObject* shape = PyTuple_New(t->shape.size());
+    for (size_t d = 0; d < t->shape.size(); ++d) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLong(t->shape[d]));
+    }
+    PyObject* tup = Py_BuildValue(
+        "(ssNy#)", t->name.c_str(), DTypeToNumpy(t->dtype), shape,
+        t->data.data(), static_cast<Py_ssize_t>(t->data.size()));
+    if (tup == nullptr) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, i, tup);
+  }
+  return list;
+}
+
+bool NamesFromHelper(const char* fn, long handle,
+                     std::vector<std::string>* out) {
+  PyObject* res = CallHelper(fn, Py_BuildValue("(l)", handle));
+  if (res == nullptr) return false;
+  for (Py_ssize_t i = 0; i < PyList_Size(res); ++i) {
+    out->push_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  return true;
+}
+
+}  // namespace
+
+/* -- predictor ------------------------------------------------------ */
+
+namespace {
+
+/* Drop the Python-side object behind `handle` (best effort). */
+void ReleaseHandle(long handle) {
+  PyObject* res = CallHelper("release", Py_BuildValue("(l)", handle));
+  Py_XDECREF(res);
+}
+
+}  // namespace
+
+PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config) {
+  ClearError();
+  if (!EnsureRuntime()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor* p = nullptr;
+  PyObject* res = CallHelper(
+      "create_predictor",
+      Py_BuildValue("(ssi)", config->model_dir.c_str(),
+                    config->params_path.c_str(),
+                    config->use_xla ? 1 : 0));
+  if (res != nullptr) {
+    p = new PD_Predictor();
+    p->handle = PyLong_AsLong(res);
+    Py_DECREF(res);
+    if (!NamesFromHelper("input_names", p->handle, &p->input_names) ||
+        !NamesFromHelper("output_names", p->handle, &p->output_names)) {
+      ReleaseHandle(p->handle);
+      delete p;
+      p = nullptr;
+    }
+  }
+  PyGILState_Release(gil);
+  return p;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (p == nullptr) return;
+  if (g_init_ok) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    ReleaseHandle(p->handle);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}
+
+int PD_GetInputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->input_names.size());
+}
+
+int PD_GetOutputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->output_names.size());
+}
+
+const char* PD_GetInputName(const PD_Predictor* p, int n) {
+  return p->input_names.at(n).c_str();
+}
+
+const char* PD_GetOutputName(const PD_Predictor* p, int n) {
+  return p->output_names.at(n).c_str();
+}
+
+bool PD_PredictorRun(PD_Predictor* p, PD_Tensor* const* inputs, int in_size,
+                     PD_Tensor*** outputs, int* out_size) {
+  ClearError();
+  if (!EnsureRuntime()) return false;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  bool ok = false;
+  PyObject* res = CallHelper(
+      "run", Py_BuildValue("(lN)", p->handle,
+                           TensorsToPyList(inputs, in_size)));
+  if (res != nullptr) {
+    int n = static_cast<int>(PyList_Size(res));
+    PD_Tensor** arr = static_cast<PD_Tensor**>(
+        std::malloc(sizeof(PD_Tensor*) * (n > 0 ? n : 1)));
+    for (int i = 0; i < n; ++i) {
+      PyObject* tup = PyList_GetItem(res, i);  // (name, dtype, shape, bytes)
+      PD_Tensor* t = new PD_Tensor();
+      t->name = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+      t->dtype = NumpyToDType(PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1)));
+      PyObject* shape = PyTuple_GetItem(tup, 2);
+      for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
+        t->shape.push_back(
+            static_cast<int>(PyLong_AsLong(PyTuple_GetItem(shape, d))));
+      }
+      char* buf = nullptr;
+      Py_ssize_t len = 0;
+      PyBytes_AsStringAndSize(PyTuple_GetItem(tup, 3), &buf, &len);
+      t->data.assign(buf, buf + len);
+      arr[i] = t;
+    }
+    *outputs = arr;
+    *out_size = n;
+    Py_DECREF(res);
+    ok = true;
+  }
+  PyGILState_Release(gil);
+  return ok;
+}
+
+void PD_DeleteTensorArray(PD_Tensor** tensors, int n) {
+  if (tensors == nullptr) return;
+  for (int i = 0; i < n; ++i) delete tensors[i];
+  std::free(tensors);
+}
+
+/* -- trainer -------------------------------------------------------- */
+
+PD_Trainer* PD_NewTrainer(const char* model_dir, bool use_accelerator) {
+  ClearError();
+  if (!EnsureRuntime()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Trainer* t = nullptr;
+  PyObject* res = CallHelper(
+      "create_trainer",
+      Py_BuildValue("(si)", model_dir, use_accelerator ? 1 : 0));
+  if (res != nullptr) {
+    t = new PD_Trainer();
+    t->handle = PyLong_AsLong(res);
+    Py_DECREF(res);
+    if (!NamesFromHelper("trainer_feed_names", t->handle, &t->feed_names)) {
+      ReleaseHandle(t->handle);
+      delete t;
+      t = nullptr;
+    }
+  }
+  PyGILState_Release(gil);
+  return t;
+}
+
+void PD_DeleteTrainer(PD_Trainer* t) {
+  if (t == nullptr) return;
+  if (g_init_ok) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    ReleaseHandle(t->handle);
+    PyGILState_Release(gil);
+  }
+  delete t;
+}
+
+int PD_TrainerFeedNum(const PD_Trainer* t) {
+  return static_cast<int>(t->feed_names.size());
+}
+
+const char* PD_TrainerFeedName(const PD_Trainer* t, int n) {
+  return t->feed_names.at(n).c_str();
+}
+
+double PD_TrainerRunStep(PD_Trainer* t, PD_Tensor* const* feeds, int n) {
+  ClearError();
+  if (!EnsureRuntime()) return NAN;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  double loss = NAN;
+  PyObject* res = CallHelper(
+      "trainer_step",
+      Py_BuildValue("(lN)", t->handle, TensorsToPyList(feeds, n)));
+  if (res != nullptr) {
+    loss = PyFloat_AsDouble(res);
+    Py_DECREF(res);
+  }
+  PyGILState_Release(gil);
+  return loss;
+}
+
+bool PD_TrainerSavePersistables(PD_Trainer* t, const char* dirname) {
+  ClearError();
+  if (!EnsureRuntime()) return false;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = CallHelper(
+      "trainer_save", Py_BuildValue("(ls)", t->handle, dirname));
+  bool ok = res != nullptr;
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return ok;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,119 @@
+/* paddle_tpu stable C inference + training API.
+ *
+ * TPU-native analog of the reference C API
+ * (reference: paddle/fluid/inference/capi/c_api.h:1-255) plus the C++
+ * training entry the reference ships as paddle/fluid/train/demo
+ * (reference: paddle/fluid/train/demo/demo_trainer.cc).
+ *
+ * The implementation embeds a CPython runtime that drives the
+ * paddle_tpu segment executor; all tensor math runs through XLA, so the
+ * C layer is a thin stable ABI over the same compiled computations the
+ * Python API uses.  Set PADDLE_TPU_ROOT to the repo/site-packages root
+ * that contains the `paddle_tpu` package before the first call.
+ */
+
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdbool.h>
+#include <stddef.h>
+#include <stdint.h>
+
+#if defined(_WIN32)
+#define PD_EXPORT __declspec(dllexport)
+#else
+#define PD_EXPORT __attribute__((visibility("default")))
+#endif
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* reference: inference/capi/c_api.h:34 (PD_DataType) */
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_UINT8 = 3,
+  PD_UNKDTYPE = 4,
+} PD_DataType;
+
+typedef struct PD_AnalysisConfig PD_AnalysisConfig;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+typedef struct PD_Trainer PD_Trainer;
+
+/* Last error message for the calling thread ("" when none). */
+PD_EXPORT const char* PD_GetLastError();
+
+/* -- AnalysisConfig (reference: inference/capi/pd_config.cc) -------- */
+PD_EXPORT PD_AnalysisConfig* PD_NewAnalysisConfig();
+PD_EXPORT void PD_DeleteAnalysisConfig(PD_AnalysisConfig* config);
+/* model_dir: directory written by fluid.io.save_inference_model.
+ * params_path may be NULL (directory default). */
+PD_EXPORT void PD_SetModel(PD_AnalysisConfig* config, const char* model_dir,
+                           const char* params_path);
+PD_EXPORT const char* PD_ModelDir(const PD_AnalysisConfig* config);
+/* On TPU builds the accelerator is the default; DisableGpu routes the
+ * predictor to the host CPU backend instead. */
+PD_EXPORT void PD_DisableGpu(PD_AnalysisConfig* config);
+PD_EXPORT void PD_SwitchIrOptim(PD_AnalysisConfig* config, bool x);
+PD_EXPORT void PD_EnableMemoryOptim(PD_AnalysisConfig* config);
+
+/* -- Tensor (reference: inference/capi/pd_tensor.cc) ---------------- */
+PD_EXPORT PD_Tensor* PD_NewPaddleTensor();
+PD_EXPORT void PD_DeletePaddleTensor(PD_Tensor* tensor);
+PD_EXPORT void PD_SetPaddleTensorName(PD_Tensor* tensor, const char* name);
+PD_EXPORT void PD_SetPaddleTensorDType(PD_Tensor* tensor, PD_DataType dtype);
+PD_EXPORT void PD_SetPaddleTensorShape(PD_Tensor* tensor, const int* shape,
+                                       int rank);
+/* Copies `bytes` bytes out of `data` into the tensor. */
+PD_EXPORT void PD_SetPaddleTensorData(PD_Tensor* tensor, const void* data,
+                                      size_t bytes);
+PD_EXPORT const char* PD_GetPaddleTensorName(const PD_Tensor* tensor);
+PD_EXPORT PD_DataType PD_GetPaddleTensorDType(const PD_Tensor* tensor);
+PD_EXPORT const int* PD_GetPaddleTensorShape(const PD_Tensor* tensor,
+                                             int* rank);
+PD_EXPORT const void* PD_GetPaddleTensorData(const PD_Tensor* tensor,
+                                             size_t* bytes);
+
+/* -- Predictor (reference: inference/capi/pd_predictor.cc) ---------- */
+PD_EXPORT PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config);
+PD_EXPORT void PD_DeletePredictor(PD_Predictor* predictor);
+PD_EXPORT int PD_GetInputNum(const PD_Predictor* predictor);
+PD_EXPORT int PD_GetOutputNum(const PD_Predictor* predictor);
+PD_EXPORT const char* PD_GetInputName(const PD_Predictor* predictor, int n);
+PD_EXPORT const char* PD_GetOutputName(const PD_Predictor* predictor, int n);
+/* Runs the model.  `*outputs` receives a malloc'd array of `*out_size`
+ * tensors owned by the caller; free with PD_DeleteTensorArray.
+ * Returns true on success (reference: inference/capi/c_api.h:186
+ * PD_PredictorRun). */
+PD_EXPORT bool PD_PredictorRun(PD_Predictor* predictor,
+                               PD_Tensor* const* inputs, int in_size,
+                               PD_Tensor*** outputs, int* out_size);
+PD_EXPORT void PD_DeleteTensorArray(PD_Tensor** tensors, int n);
+
+/* -- Trainer (reference: paddle/fluid/train/demo/demo_trainer.cc) --- */
+/* `model_dir` holds main.json / startup.json / train_spec.json written
+ * by fluid.io.save_train_model.  Runs the startup program on creation.
+ * use_accelerator=false pins the session to host CPU. */
+PD_EXPORT PD_Trainer* PD_NewTrainer(const char* model_dir,
+                                    bool use_accelerator);
+PD_EXPORT void PD_DeleteTrainer(PD_Trainer* trainer);
+PD_EXPORT int PD_TrainerFeedNum(const PD_Trainer* trainer);
+PD_EXPORT const char* PD_TrainerFeedName(const PD_Trainer* trainer, int n);
+/* One optimizer step; returns the scalar value of the first fetch var
+ * (the loss) or NaN on failure.  A NaN from a diverged-but-successful
+ * step is distinguished from a failed call by PD_GetLastError(): it is
+ * "" when the call itself succeeded. */
+PD_EXPORT double PD_TrainerRunStep(PD_Trainer* trainer,
+                                   PD_Tensor* const* feeds, int n);
+/* Saves persistables into `dirname` (fluid.io.save_persistables). */
+PD_EXPORT bool PD_TrainerSavePersistables(PD_Trainer* trainer,
+                                          const char* dirname);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,65 @@
+/* C driver for the capi test: load an inference model, run one batch of
+ * deterministic inputs, print the outputs.  Compiled and executed by
+ * tests/test_capi.py; mirrors how a C deployment of the reference C API
+ * looks (reference: paddle/fluid/inference/capi/c_api.h usage).
+ *
+ * Usage: capi_predict_driver <model_dir> <batch> <feat>
+ * Prints: one output value per line, %.6f.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_tpu/inference/capi/c_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) return 2;
+  const char* model_dir = argv[1];
+  int batch = atoi(argv[2]);
+  int feat = atoi(argv[3]);
+
+  PD_AnalysisConfig* cfg = PD_NewAnalysisConfig();
+  PD_SetModel(cfg, model_dir, NULL);
+  PD_Predictor* pred = PD_NewPredictor(cfg);
+  if (!pred) {
+    fprintf(stderr, "NewPredictor: %s\n", PD_GetLastError());
+    return 1;
+  }
+  if (PD_GetInputNum(pred) != 1) {
+    fprintf(stderr, "expected 1 input, got %d\n", PD_GetInputNum(pred));
+    return 1;
+  }
+
+  float* x = (float*)malloc(sizeof(float) * batch * feat);
+  for (int i = 0; i < batch * feat; ++i) x[i] = (i % 17) * 0.25f - 2.0f;
+
+  PD_Tensor* in = PD_NewPaddleTensor();
+  int shape[2];
+  shape[0] = batch;
+  shape[1] = feat;
+  PD_SetPaddleTensorName(in, PD_GetInputName(pred, 0));
+  PD_SetPaddleTensorDType(in, PD_FLOAT32);
+  PD_SetPaddleTensorShape(in, shape, 2);
+  PD_SetPaddleTensorData(in, x, sizeof(float) * batch * feat);
+
+  PD_Tensor** outs = NULL;
+  int n_out = 0;
+  PD_Tensor* ins[1];
+  ins[0] = in;
+  if (!PD_PredictorRun(pred, ins, 1, &outs, &n_out)) {
+    fprintf(stderr, "Run: %s\n", PD_GetLastError());
+    return 1;
+  }
+  for (int i = 0; i < n_out; ++i) {
+    size_t bytes = 0;
+    const float* data = (const float*)PD_GetPaddleTensorData(outs[i],
+                                                             &bytes);
+    size_t cnt = bytes / sizeof(float);
+    for (size_t j = 0; j < cnt; ++j) printf("%.6f\n", data[j]);
+  }
+  PD_DeleteTensorArray(outs, n_out);
+  PD_DeletePaddleTensor(in);
+  PD_DeletePredictor(pred);
+  PD_DeleteAnalysisConfig(cfg);
+  free(x);
+  return 0;
+}

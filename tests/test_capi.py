@@ -1,0 +1,112 @@
+"""Stable C API + C++ train demo, end to end.
+
+Mirrors the reference's C API tests and C++ train demo
+(reference: paddle/fluid/inference/capi/c_api.h,
+paddle/fluid/train/demo/demo_trainer.cc,
+paddle/fluid/train/test_train_recognize_digits.cc): build the shared
+library, save a model from Python, then drive it from compiled C —
+predict parity against the Python predictor, and a C++ training loop
+whose loss must decrease.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI_DIR = os.path.join(ROOT, 'paddle_tpu', 'inference', 'capi')
+LIB = os.path.join(CAPI_DIR, 'libpaddle_tpu_capi.so')
+
+pytestmark = pytest.mark.skipif(
+    shutil.which('g++') is None or shutil.which('python3-config') is None,
+    reason='no native toolchain')
+
+
+def _build_lib():
+    subprocess.run(['make', '-C', CAPI_DIR], check=True,
+                   capture_output=True)
+    return LIB
+
+
+def _compile(src, out):
+    subprocess.run(
+        ['g++', '-O1', src, '-o', out, '-L' + CAPI_DIR,
+         '-lpaddle_tpu_capi', '-Wl,-rpath,' + CAPI_DIR],
+        check=True, capture_output=True)
+
+
+def _subprocess_env():
+    env = dict(os.environ)
+    env['PADDLE_TPU_ROOT'] = ROOT
+    # the C process spawns a fresh embedded interpreter: pin it to the
+    # host CPU backend like conftest does for in-process tests
+    env['PADDLE_TPU_CAPI_PLATFORM'] = 'cpu'
+    env['JAX_PLATFORMS'] = 'cpu'
+    return env
+
+
+def _save_fc_model(tmpdir):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        out = fluid.layers.fc(input=h, size=3, act='softmax')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(tmpdir, ['x'], [out], exe, main)
+        xv = ((np.arange(4 * 8) % 17) * 0.25 - 2.0) \
+            .reshape(4, 8).astype('float32')
+        expect, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    return expect
+
+
+def test_capi_predictor_matches_python(tmp_path):
+    _build_lib()
+    model_dir = str(tmp_path / 'model')
+    expect = _save_fc_model(model_dir)
+    driver = str(tmp_path / 'capi_predict_driver')
+    _compile(os.path.join(ROOT, 'tests', 'capi_predict_driver.c'), driver)
+    res = subprocess.run([driver, model_dir, '4', '8'],
+                         capture_output=True, text=True,
+                         env=_subprocess_env(), timeout=300)
+    assert res.returncode == 0, res.stderr
+    got = np.array([float(t) for t in res.stdout.split()],
+                   dtype='float32').reshape(expect.shape)
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-6)
+
+
+def test_cpp_train_demo_loss_decreases(tmp_path):
+    _build_lib()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[13], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    model_dir = str(tmp_path / 'train_model')
+    fluid.io.save_train_model(model_dir, main, startup, ['x', 'y'], [loss])
+
+    demo = str(tmp_path / 'demo_trainer')
+    _compile(os.path.join(ROOT, 'paddle_tpu', 'train', 'demo',
+                          'demo_trainer.cc'), demo)
+    res = subprocess.run([demo, model_dir, '40'], capture_output=True,
+                         text=True, env=_subprocess_env(), timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    # the C++ demo saved persistables back; they must load in Python
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        m2, s2, feeds, fetches = fluid.io.load_train_model(model_dir)
+        exe.run(s2)
+        fluid.io.load_persistables(exe, model_dir, m2)
+        assert feeds == ['x', 'y']
