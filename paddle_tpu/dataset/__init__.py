@@ -10,3 +10,9 @@ tests and examples run everywhere.
 from . import mnist
 from . import uci_housing
 from . import imdb
+from . import cifar
+from . import imikolov
+from . import movielens
+from . import flowers
+from . import wmt16
+from . import conll05

@@ -1,1 +1,5 @@
 from . import quantization
+from . import prune
+from . import distillation
+from . import searcher
+from . import nas

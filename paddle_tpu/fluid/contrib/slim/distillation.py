@@ -1,0 +1,98 @@
+"""Knowledge distillation (slim).
+
+TPU-native analog of the reference distillers
+(reference: python/paddle/fluid/contrib/slim/distillation/distiller.py —
+L2Distiller:25, FSPDistiller:103, SoftLabelDistiller:195).  The
+reference merges teacher and student graphs and appends a distill-loss
+subgraph; here the same composition happens on the Program IR with
+fluid.layers calls, and XLA fuses the combined graph.
+
+Usage: build the student in `program_guard`, run the teacher forward in
+the SAME program (e.g. via a frozen clone with distinct var names), then
+call one of the distillers with the mapped-out variables.
+"""
+
+from ... import layers
+
+
+class L2Distiller(object):
+    """L2 distance between teacher and student feature maps
+    (reference distiller.py:25)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, graph=None):
+        s, t = self.student_feature_map, self.teacher_feature_map
+        diff = layers.elementwise_sub(s, t)
+        loss = layers.reduce_mean(layers.square(diff))
+        return layers.scale(loss, scale=self.weight)
+
+
+def _fsp_matrix(a, b):
+    """Flow-of-solution-procedure matrix: per-sample Gram matrix
+    between two feature maps of equal spatial size
+    (reference operators/fsp_op.cc semantics: NCHW inputs ->
+    [N, C_a, C_b] = sum_hw a*b / (h*w))."""
+    n_a = a.shape
+    h_w = float(n_a[2] * n_a[3])
+    # 0 = copy dim: the batch dim is dynamic (-1) in var shapes, and
+    # reshape would mis-infer with two -1 entries
+    a2 = layers.reshape(a, [0, n_a[1], -1])
+    b2 = layers.reshape(b, [0, b.shape[1], -1])
+    prod = layers.matmul(a2, layers.transpose(b2, [0, 2, 1]))
+    return layers.scale(prod, scale=1.0 / h_w)
+
+
+class FSPDistiller(object):
+    """FSP-matrix distillation over section pairs
+    (reference distiller.py:103).  `student_pairs`/`teacher_pairs`:
+    lists of (var_a, var_b) NCHW feature-map pairs."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, graph=None):
+        losses = []
+        for (sa, sb), (ta, tb) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            fs = _fsp_matrix(sa, sb)
+            ft = _fsp_matrix(ta, tb)
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(fs, ft))))
+        total = losses[0]
+        for l in losses[1:]:
+            total = layers.elementwise_add(total, l)
+        return layers.scale(total, scale=self.weight)
+
+
+class SoftLabelDistiller(object):
+    """Cross entropy between temperature-softened teacher and student
+    logits (reference distiller.py:195)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, graph=None):
+        s = layers.scale(self.student_feature_map,
+                         scale=1.0 / self.student_temperature)
+        t = layers.scale(self.teacher_feature_map,
+                         scale=1.0 / self.teacher_temperature)
+        s_log_q = layers.log_softmax(s)
+        t_p = layers.softmax(t)
+        ce = layers.reduce_mean(
+            layers.reduce_sum(
+                layers.elementwise_mul(t_p, s_log_q), dim=-1))
+        return layers.scale(ce, scale=-self.weight)

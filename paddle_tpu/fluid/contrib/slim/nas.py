@@ -1,0 +1,56 @@
+"""Light neural-architecture search (slim).
+
+TPU-native analog of the reference light NAS
+(reference: python/paddle/fluid/contrib/slim/nas/search_space.py:19 —
+SearchSpace; light_nas_strategy.py:34 — LightNASStrategy;
+search_agent.py:25 / controller_server.py:28 — the reference splits the
+controller behind a TCP server for multi-process search; here search is
+driven in-process and distributed trials go through the fleet/launch
+path instead).
+"""
+
+from .searcher import SAController
+
+
+class SearchSpace(object):
+    """User-implemented space (reference search_space.py:19)."""
+
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Max value (exclusive) per token."""
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        """Build (startup_program, train_program, eval_program,
+        train_metrics, eval_metrics) for `tokens`."""
+        raise NotImplementedError
+
+
+class LightNASStrategy(object):
+    """SA-driven architecture search loop
+    (reference light_nas_strategy.py:34)."""
+
+    def __init__(self, search_space, controller=None, search_steps=10,
+                 init_temperature=1024, reduce_rate=0.85, seed=0):
+        self.space = search_space
+        self.controller = controller or SAController(
+            init_temperature=init_temperature, reduce_rate=reduce_rate,
+            seed=seed)
+        self.search_steps = search_steps
+
+    def search(self, eval_fn, constrain_func=None):
+        """eval_fn(tokens) -> reward.  Returns (best_tokens, best_reward).
+        """
+        tokens = self.controller.reset(self.space.range_table(),
+                                       constrain_func=constrain_func,
+                                       init_tokens=self.space.init_tokens())
+        reward = eval_fn(tokens)
+        self.controller.update(tokens, reward)
+        for _ in range(self.search_steps):
+            cand = self.controller.next_tokens()
+            reward = eval_fn(cand)
+            self.controller.update(cand, reward)
+        return self.controller.best_tokens, self.controller.max_reward

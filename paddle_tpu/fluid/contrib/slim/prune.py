@@ -1,0 +1,115 @@
+"""Model pruning (slim).
+
+TPU-native analog of the reference pruners
+(reference: python/paddle/fluid/contrib/slim/prune/pruner.py:22,34 —
+Pruner/StructurePruner; prune_strategy.py:36,563 —
+PruneStrategy/UniformPruneStrategy).  The reference prunes conv filters
+by axis criteria on the parameter ndarray; here pruning edits the scope
+arrays directly (masks for unstructured, filter slicing masks for
+structured) — XLA re-compiles with whatever the scope holds, so no
+graph surgery is needed.
+"""
+
+import numpy as np
+
+from ... import core
+
+
+class Pruner(object):
+    """Base: computes a keep-mask for one parameter array."""
+
+    def prune_tensor(self, array, ratio):
+        raise NotImplementedError
+
+    def prune(self, program, scope=None, params=None, ratios=None,
+              place=None, lazy=False, only_graph=False):
+        """Apply masks in-place to `params` in `scope`.
+
+        params: list of parameter names; ratios: same-length prune
+        ratios in [0, 1).  Returns {param_name: mask ndarray}.
+        """
+        scope = scope or core.global_scope()
+        masks = {}
+        for name, ratio in zip(params, ratios):
+            var = scope.find_var(name)
+            if var is None:
+                raise ValueError('prune: param %s not in scope' % name)
+            arr = np.asarray(core.as_array(var))
+            mask = self.prune_tensor(arr, float(ratio))
+            masks[name] = mask
+            if not only_graph:
+                scope.set_var(name, (arr * mask).astype(arr.dtype))
+        return masks
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured: zero the smallest-|w| entries."""
+
+    def prune_tensor(self, array, ratio):
+        if ratio <= 0:
+            return np.ones_like(array)
+        flat = np.abs(array).reshape(-1)
+        k = int(len(flat) * ratio)
+        if k == 0:
+            return np.ones_like(array)
+        thresh = np.partition(flat, k - 1)[k - 1]
+        return (np.abs(array) > thresh).astype(array.dtype)
+
+
+class StructurePruner(Pruner):
+    """Structured: zero whole output filters / rows by L1 norm
+    (reference pruner.py:34 prunes along `pruned_axis` with criterion
+    l1_norm)."""
+
+    def __init__(self, pruned_axis=0, criterion='l1_norm'):
+        self.pruned_axis = pruned_axis
+        self.criterion = criterion
+
+    def prune_tensor(self, array, ratio):
+        axis = self.pruned_axis
+        other = tuple(i for i in range(array.ndim) if i != axis)
+        score = np.abs(array).sum(axis=other) if other else np.abs(array)
+        n_prune = int(score.shape[0] * ratio)
+        mask_1d = np.ones(score.shape[0], array.dtype)
+        if n_prune > 0:
+            drop = np.argsort(score)[:n_prune]
+            mask_1d[drop] = 0
+        shape = [1] * array.ndim
+        shape[axis] = -1
+        return np.broadcast_to(mask_1d.reshape(shape),
+                               array.shape).astype(array.dtype)
+
+
+class UniformPruneStrategy(object):
+    """Prune every target param by the same ratio
+    (reference prune_strategy.py:563)."""
+
+    def __init__(self, pruner=None, target_ratio=0.5, params=None):
+        self.pruner = pruner or MagnitudePruner()
+        self.target_ratio = target_ratio
+        self.params = params
+
+    def on_compression_begin(self, program, scope=None):
+        params = self.params or [p.name for p in
+                                 program.all_parameters()]
+        return self.pruner.prune(
+            program, scope=scope, params=params,
+            ratios=[self.target_ratio] * len(params))
+
+
+def sensitivity(program, scope, param_name, eval_fn,
+                ratios=(0.1, 0.3, 0.5, 0.7, 0.9),
+                pruner=None):
+    """Per-param sensitivity sweep (reference
+    prune_strategy.py:672 SensitivePruneStrategy._compute_sensitivities):
+    prune one param at several ratios, re-evaluate, restore.
+    Returns {ratio: eval_metric}."""
+    scope = scope or core.global_scope()
+    pruner = pruner or MagnitudePruner()
+    baseline = np.asarray(core.as_array(scope.find_var(param_name))).copy()
+    out = {}
+    for r in ratios:
+        pruner.prune(program, scope, [param_name], [r])
+        out[float(r)] = float(eval_fn())
+        scope.set_var(param_name, baseline.copy())
+    return out

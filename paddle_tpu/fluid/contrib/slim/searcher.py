@@ -1,0 +1,93 @@
+"""Search controllers for NAS / auto-prune (slim).
+
+TPU-native analog of the reference controllers
+(reference: python/paddle/fluid/contrib/slim/searcher/controller.py —
+EvolutionaryController:28, SAController:59).
+"""
+
+import copy
+import math
+
+import numpy as np
+
+
+class EvolutionaryController(object):
+    """Base controller (reference controller.py:28)."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing over integer token vectors
+    (reference controller.py:59)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=0):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._iter = 0
+        self._reward = -math.inf
+        self._tokens = None
+        self._max_reward = -math.inf
+        self._best_tokens = None
+        self._constrain_func = None
+
+    def reset(self, range_table, constrain_func=None, init_tokens=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens) if init_tokens else [
+            int(self._rng.randint(0, r)) for r in self._range_table]
+        self._iter = 0
+        # a reused controller must not carry best/accept state between
+        # searches (spaces may even differ in token length)
+        self._reward = -math.inf
+        self._max_reward = -math.inf
+        self._best_tokens = None
+        return self._tokens
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def update(self, tokens, reward):
+        """Accept/reject by the Metropolis criterion; returns True if
+        the proposal became the new state."""
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.rand() <= math.exp(
+                (reward - self._reward) / max(temperature, 1e-9)):
+            self._reward = reward
+            self._tokens = list(tokens)
+            accepted = True
+        else:
+            accepted = False
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+        return accepted
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token if control_token is not None
+                      else self._tokens)
+        for _ in range(self._max_iter_number):
+            cand = copy.copy(tokens)
+            idx = int(self._rng.randint(0, len(cand)))
+            cand[idx] = int(self._rng.randint(0, self._range_table[idx]))
+            if self._constrain_func is None or self._constrain_func(cand):
+                return cand
+        return tokens

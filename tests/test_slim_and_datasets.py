@@ -1,0 +1,189 @@
+"""contrib.slim (prune / distillation / NAS) + dataset loaders.
+
+Mirrors the reference's slim tests
+(reference: python/paddle/fluid/contrib/slim/tests/) and dataset unit
+tests (python/paddle/dataset/tests/): pruning must zero the right
+fraction and keep the model runnable, distill losses must be positive
+scalars that shrink as student approaches teacher, the SA controller
+must find a planted optimum, and every loader must yield records with
+the documented shapes.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib.slim import prune, distillation, nas
+from paddle_tpu.fluid.contrib.slim.searcher import SAController
+import paddle_tpu.dataset as dataset
+
+
+def _sparsity(a):
+    return float((a == 0).mean())
+
+
+def test_magnitude_pruner_ratio_and_model_still_runs():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[16], dtype='float32')
+        h = fluid.layers.fc(input=x, size=32, act='relu')
+        out = fluid.layers.fc(input=h, size=4)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        params = [p.name for p in main.all_parameters()
+                  if len(p.shape) >= 2]  # weight matrices, not biases
+        masks = prune.MagnitudePruner().prune(
+            main, scope, params=params, ratios=[0.5] * len(params))
+        for name in params:
+            arr = np.asarray(fluid.core.as_array(scope.find_var(name)))
+            assert 0.4 < _sparsity(arr) <= 0.6, (name, _sparsity(arr))
+            assert masks[name].shape == arr.shape
+        o, = exe.run(main, feed={'x': np.ones((2, 16), 'float32')},
+                     fetch_list=[out])
+        assert o.shape == (2, 4)
+
+
+def test_structure_pruner_zeroes_whole_filters():
+    a = np.arange(1, 25, dtype='float32').reshape(4, 3, 2, 1)
+    mask = prune.StructurePruner(pruned_axis=0).prune_tensor(a, 0.5)
+    per_filter = mask.reshape(4, -1)
+    # 2 of 4 filters fully zero, rest fully kept
+    zero_rows = (per_filter == 0).all(axis=1)
+    one_rows = (per_filter == 1).all(axis=1)
+    assert zero_rows.sum() == 2 and one_rows.sum() == 2
+    # lowest-l1 filters (the first ones here) are dropped
+    assert zero_rows[0] and zero_rows[1]
+
+
+def test_uniform_prune_strategy_and_sensitivity():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        out = fluid.layers.fc(input=x, size=2)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pname = main.all_parameters()[0].name
+        base = np.asarray(
+            fluid.core.as_array(scope.find_var(pname))).copy()
+        sens = prune.sensitivity(main, scope, pname,
+                                 eval_fn=lambda: 1.0,
+                                 ratios=(0.3, 0.6))
+        assert set(sens) == {0.3, 0.6}
+        # param restored after the sweep
+        np.testing.assert_array_equal(
+            np.asarray(fluid.core.as_array(scope.find_var(pname))), base)
+        prune.UniformPruneStrategy(
+            target_ratio=0.25).on_compression_begin(main, scope)
+
+
+def test_distillers_build_and_shrink():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        s = fluid.layers.data('s', shape=[6], dtype='float32')
+        t = fluid.layers.data('t', shape=[6], dtype='float32')
+        l2 = distillation.L2Distiller(s, t).distiller_loss()
+        soft = distillation.SoftLabelDistiller(
+            s, t, teacher_temperature=2.0).distiller_loss()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        tv = np.arange(12, dtype='float32').reshape(2, 6)
+        far = np.zeros((2, 6), 'float32')
+        near = tv + 0.1
+        l2_far, soft_far = exe.run(
+            main, feed={'s': far, 't': tv}, fetch_list=[l2, soft])
+        l2_near, soft_near = exe.run(
+            main, feed={'s': near, 't': tv}, fetch_list=[l2, soft])
+        assert float(l2_near) < float(l2_far)
+        assert float(soft_near) < float(soft_far)
+        assert float(l2_near) >= 0 and float(soft_near) >= 0
+
+
+def test_fsp_distiller():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        sa = fluid.layers.data('sa', shape=[3, 4, 4], dtype='float32')
+        sb = fluid.layers.data('sb', shape=[5, 4, 4], dtype='float32')
+        ta = fluid.layers.data('ta', shape=[3, 4, 4], dtype='float32')
+        tb = fluid.layers.data('tb', shape=[5, 4, 4], dtype='float32')
+        loss = distillation.FSPDistiller([(sa, sb)],
+                                         [(ta, tb)]).distiller_loss()
+    rng = np.random.RandomState(0)
+    va = rng.randn(2, 3, 4, 4).astype('float32')
+    vb = rng.randn(2, 5, 4, 4).astype('float32')
+    wa = rng.randn(2, 3, 4, 4).astype('float32')
+    wb = rng.randn(2, 5, 4, 4).astype('float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        z, = exe.run(main, feed={'sa': va, 'sb': vb, 'ta': va, 'tb': vb},
+                     fetch_list=[loss])
+        assert abs(float(z)) < 1e-6  # identical pairs -> zero distance
+        v, = exe.run(main, feed={'sa': va, 'sb': vb, 'ta': wa, 'tb': wb},
+                     fetch_list=[loss])
+        # value parity vs numpy FSP (reference fsp_op semantics)
+        def fsp(a, b):
+            return np.einsum('nchw,ndhw->ncd', a, b) / (4 * 4)
+        expect = np.mean((fsp(va, vb) - fsp(wa, wb)) ** 2)
+        np.testing.assert_allclose(float(v), expect, rtol=1e-5)
+
+
+def test_sa_controller_finds_planted_optimum():
+    target = [3, 1, 4, 1, 5]
+    ctrl = SAController(seed=0)
+
+    class Space(nas.SearchSpace):
+        def init_tokens(self):
+            return [0, 0, 0, 0, 0]
+
+        def range_table(self):
+            return [8, 8, 8, 8, 8]
+
+    strategy = nas.LightNASStrategy(Space(), controller=ctrl,
+                                    search_steps=400)
+
+    def reward(tokens):
+        return -sum(abs(a - b) for a, b in zip(tokens, target))
+
+    best, best_r = strategy.search(reward)
+    assert best_r > -3, (best, best_r)
+
+
+def test_dataset_loaders_shapes():
+    img, label = next(dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= label < 10
+    img, label = next(dataset.cifar.train100()())
+    assert img.shape == (3072,) and 0 <= label < 100
+
+    word_idx = dataset.imikolov.build_dict(min_word_freq=1)
+    gram = next(dataset.imikolov.train(word_idx, 5)())
+    assert len(gram) == 5
+    assert all(0 <= g < len(word_idx) for g in gram)
+
+    rec = next(dataset.movielens.train()())
+    assert len(rec) == 8
+    assert 1 <= rec[0] <= dataset.movielens.max_user_id()
+    assert isinstance(rec[5], list) and isinstance(rec[6], list)
+    assert 1.0 <= rec[7] <= 5.0
+
+    img, label = next(dataset.flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+
+    src, trg, trg_next = next(dataset.wmt16.train(100, 100)())
+    assert src[0] == dataset.wmt16.start_mark()
+    assert src[-1] == dataset.wmt16.end_mark()
+    assert len(trg) == len(trg_next)
+    assert trg[1:] == trg_next[:-1]
+
+    rec = next(dataset.conll05.test()())
+    assert len(rec) == 9
+    n = len(rec[0])
+    assert all(len(col) == n for col in rec[1:])
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape == (dataset.conll05.WORD_VOCAB,
+                         dataset.conll05.EMB_DIM)
