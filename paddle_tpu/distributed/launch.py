@@ -72,11 +72,40 @@ def launch():
         else:
             procs.append((subprocess.Popen(cmd, env=env), None))
 
+    import time
     rc = 0
-    for p, logf in procs:
-        rc |= p.wait()
-        if logf:
-            logf.close()
+    try:
+        # poll ALL workers: a fast-failing worker must tear the job down
+        # even while its peers block in jax.distributed rendezvous
+        live = {i for i in range(len(procs))}
+        while live:
+            for i in sorted(live):
+                code = procs[i][0].poll()
+                if code is None:
+                    continue
+                live.discard(i)
+                rc |= code
+                if code != 0:
+                    raise RuntimeError(
+                        'worker %d exited with code %d' % (i, code))
+            time.sleep(0.2)
+    except RuntimeError as e:
+        sys.stderr.write(str(e) + '\n')
+        rc = rc or 1
+    finally:
+        # never orphan workers: if the launcher dies (timeout kill,
+        # Ctrl-C, a worker failing fast), tear the rest down
+        for p, logf in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p, logf in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            if logf:
+                logf.close()
     sys.exit(rc)
 
 

@@ -46,9 +46,9 @@ class CPUPlace(Place):
 
     def jax_device(self):
         try:
-            return jax.devices("cpu")[0]
+            return jax.local_devices(backend="cpu")[0]
         except RuntimeError:
-            return jax.devices()[0]
+            return jax.local_devices()[0]
 
 
 class XLAPlace(Place):
@@ -57,7 +57,11 @@ class XLAPlace(Place):
     fluid.CUDAPlace(0) -> fluid.XLAPlace(0)."""
 
     def jax_device(self):
-        devs = jax.devices()
+        # PROCESS-LOCAL device index, matching the reference semantics
+        # where CUDAPlace(i) is trainer-local GPU i (each NCCL2-mode
+        # trainer process owns its own device numbering).  On a
+        # single-process runtime local == global.
+        devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

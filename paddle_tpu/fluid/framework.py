@@ -540,9 +540,11 @@ def cpu_places(device_count=None):
 
 
 def xla_places(device_ids=None):
+    # XLAPlace indexes PROCESS-LOCAL devices (reference CUDAPlace(i) is
+    # trainer-local GPU i), so enumerate local devices only
     import jax
     if device_ids is None:
-        device_ids = range(len(jax.devices()))
+        device_ids = range(len(jax.local_devices()))
     return [core.XLAPlace(i) for i in device_ids]
 
 

@@ -25,10 +25,138 @@ def _default_mesh(places=None):
     return Mesh(np.array(devs), ('dp',))
 
 
+def _to_global(val, sharding, per_process=False):
+    """Place a host value onto the mesh with `sharding`.
+
+    Single-process: plain device_put.  Multi-process (jax.distributed —
+    the reference's NCCL2 multi-trainer mode, SURVEY.md §2.4), two host
+    value semantics exist, mirroring the reference trainer contract:
+
+    - per_process=True: the value is this trainer's LOCAL batch shard;
+      shards concatenate into the global array (each trainer feeds its
+      own data, like each reference trainer reads its own file split).
+    - per_process=False: the value is the FULL global value, identical
+      on every process (params/accumulators — parameter init determinism
+      plays the role of BCastParamsToDevices); each process contributes
+      the slices of it that its devices own, so non-replicated
+      shardings (ZeRO accumulator sharding, TP param shardings) work.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(val, sharding)
+    if isinstance(val, jax.Array) and not val.is_fully_addressable \
+            and len(val.sharding.device_set) > 1:
+        # already a global array (a prior step's output); reshard if the
+        # target differs (e.g. XLA propagated a dp-sharded layout onto a
+        # value pinned replicated) — device_put compiles a collective
+        # reshard, the multi-host analog of the single-process path
+        if val.sharding.is_equivalent_to(sharding, val.ndim):
+            return val
+        return jax.device_put(val, sharding)
+    arr = np.asarray(val)
+    if per_process:
+        return jax.make_array_from_process_local_data(sharding, arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def _batch_feed_names(program, feed):
+    """Feed vars with a batch (-1 leading) dim in the program — the only
+    feeds that are sharded over dp; fixed-shape feeds are replicated.
+    Vars the program cannot resolve are included in the set, falling
+    back to the divisibility heuristic in the shard decision."""
+    names = set()
+    blk = program.global_block()
+    for n in feed:
+        try:
+            shp = tuple(getattr(blk.var(n), 'shape', ()) or ())
+        except Exception:
+            shp = ()
+        if not shp or shp[0] == -1:
+            names.add(n)
+    return names
+
+
+def _fetch_to_host(val):
+    """Fetched value -> numpy, gathering non-addressable shards on
+    multi-process meshes."""
+    if isinstance(val, jax.Array) and not val.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            val, tiled=True))
+    return np.asarray(val)
+
+
+import weakref
+
+# per-mesh memo (weak keys: entries die with the mesh, and a recycled
+# object address can never alias a stale entry)
+_MESH_CACHE = weakref.WeakKeyDictionary()
+
+
+def _mesh_memo(mesh):
+    memo = _MESH_CACHE.get(mesh)
+    if memo is None:
+        memo = _MESH_CACHE[mesh] = {}
+    return memo
+
+
+def _local_dp_slice(mesh, dp_size):
+    """Number of dp-axis shards this process feeds: dp size scaled by
+    the fraction of mesh devices this process owns (exact for 1-axis dp
+    meshes, which is what the DP runners build).  Cached per mesh — this
+    runs per feed per step."""
+    memo = _mesh_memo(mesh)
+    key = ('ldp', dp_size)
+    if key not in memo:
+        total = mesh.devices.size
+        local = sum(d.process_index == jax.process_index()
+                    for d in mesh.devices.flat)
+        memo[key] = max(1, dp_size * local // total)
+    return memo[key]
+
+
+def _guard_local_batch(name, val, mesh, dp_size):
+    """Friendly error for a process-local feed batch that cannot be
+    evenly sharded over this process's slice of the dp axis; returns
+    True when the feed is shardable."""
+    local_dp = _local_dp_slice(mesh, dp_size) if jax.process_count() > 1 \
+        else dp_size
+    if getattr(val, 'ndim', 0) >= 1 and local_dp and \
+            val.shape[0] % local_dp == 0:
+        return True
+    if jax.process_count() > 1 and getattr(val, 'ndim', 0) >= 1:
+        # feeds differ per process: claiming replication would silently
+        # train each trainer on its own data
+        raise ValueError(
+            'feed %r local batch %d not divisible by the local dp '
+            'slice (%d shards/process); pad the batch or resize the '
+            'mesh' % (name, val.shape[0], local_dp))
+    return False
+
+
+def _check_mesh_spans_processes(mesh):
+    """On a multi-process runtime the dp mesh must cover every process;
+    a process-local mesh would drop cross-trainer gradient sync.
+    Cached per mesh — this runs every step."""
+    nproc = jax.process_count()
+    if nproc > 1:
+        memo = _mesh_memo(mesh)
+        if 'span' not in memo:
+            owners = set(d.process_index for d in mesh.devices.flat)
+            if len(owners) != nproc:
+                raise ValueError(
+                    'mesh spans %d of %d processes; multi-process data '
+                    'parallelism needs a global mesh (use the default '
+                    'mesh or pass devices from jax.devices(), not local '
+                    'places)' % (len(owners), nproc))
+            memo['span'] = True
+    return mesh
+
+
 def get_mesh(compiled):
     if getattr(compiled, '_mesh', None) is None:
         compiled._mesh = _default_mesh(compiled._places)
-    return compiled._mesh
+    return _check_mesh_spans_processes(compiled._mesh)
 
 
 def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
@@ -70,10 +198,11 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
                     shape[0] > 1:
                 return P(zero_axis)
             return None
+    batch_feeds = _batch_feed_names(program, feed)
     for item in plan:
         if isinstance(item, _Segment):
             _run_segment_parallel(executor, item, feed, scope, mesh, ndev,
-                                  fetched, param_rule)
+                                  fetched, param_rule, batch_feeds)
         else:
             from ..ops import registry
             op = item[1]
@@ -83,19 +212,20 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
         val = fetched.get(name)
         if val is None:
             val = core.as_array(scope.find_var(name))
-        results.append(np.asarray(val) if return_numpy else val)
+        results.append(_fetch_to_host(val) if return_numpy else val)
     return results
 
 
 def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
-                          param_rule=None):
+                          param_rule=None, batch_feeds=None):
     repl = NamedSharding(mesh, P())
     dp = mesh.axis_names[0]
     dp_size = mesh.shape[dp]
+    batch_feeds = feed if batch_feeds is None else batch_feeds
 
     def data_shard(name, val):
-        if name in feed and getattr(val, 'ndim', 0) >= 1 \
-                and val.shape[0] % dp_size == 0:
+        if name in feed and name in batch_feeds and \
+                _guard_local_batch(name, val, mesh, dp_size):
             return NamedSharding(mesh, P(dp))
         return repl
 
@@ -113,8 +243,13 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
     # pin state shardings by resharding the inputs (device_put is a
     # no-op when the array already matches); outputs inherit XLA's
     # propagated shardings and flow back here next step
-    state = {n: jax.device_put(v, state_shard(n, v))
+    state = {n: _to_global(v, state_shard(n, v))
              for n, v in state.items()}
+
+    def _convert_data(n, v):
+        sh = data_shard(n, v)
+        return _to_global(v, sh, per_process=sh.spec != P())
+    data = {n: _convert_data(n, v) for n, v in data.items()}
     if seg.compiled is None or not isinstance(seg.compiled, tuple):
         fn = _make_segment_fn(seg)
         in_shardings = (None,
@@ -145,7 +280,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
                    for v in (fetch_list or [])]
     if getattr(program, '_mesh', None) is None:
         program._mesh = _default_mesh()
-    mesh = program._mesh
+    mesh = _check_mesh_spans_processes(program._mesh)
     ndev = mesh.devices.size
 
     key = ('cplan', tuple(sorted(feed.keys())), tuple(fetch_names),
@@ -158,6 +293,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
 
     executor._step += 1
     fetched = {}
+    batch_feeds = _batch_feed_names(program, feed)
     for item in plan:
         if not isinstance(item, _Segment):
             from ..ops import registry
@@ -168,19 +304,49 @@ def run_collective(executor, program, feed, fetch_list, scope,
                  for n in seg.state_names}
         data = {n: executor._lookup_input(n, feed, scope)
                 for n in seg.input_names}
+        data_specs = {n: (P('dp') if (n in feed and n in batch_feeds and
+                                      getattr(data[n], 'ndim', 0) >= 1 and
+                                      (jax.process_count() == 1 or
+                                       _guard_local_batch(n, data[n], mesh,
+                                                          ndev)))
+                          else P())
+                      for n in seg.input_names}
+        if jax.process_count() > 1:
+            # multi-trainer mode: feeds are process-local shards, params
+            # replicated global arrays (reference NCCL2 multi-process DP)
+            state = {n: _to_global(v, NamedSharding(mesh, P()))
+                     for n, v in state.items()}
+            data = {n: _to_global(v, NamedSharding(mesh, data_specs[n]),
+                                  per_process=data_specs[n] != P())
+                    for n, v in data.items()}
         if seg.compiled is None:
             fn = _make_segment_fn(seg)
             in_specs = (P(),
                         {n: P() for n in seg.state_names},
-                        {n: (P('dp') if (n in feed and
-                                         getattr(data[n], 'ndim', 0) >= 1)
-                             else P())
-                         for n in seg.input_names})
+                        data_specs)
             out_specs = {n: P() for n in seg.output_names}
             sm = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False)
             seg.compiled = jax.jit(sm, donate_argnums=(1,))
-        out = seg.compiled(jnp.asarray(executor._step), state, data)
+        if jax.process_count() > 1:
+            # a process-local scalar would carry an inconsistent
+            # single-device sharding across processes; replicate it
+            step = _to_global(np.int64(executor._step),
+                              NamedSharding(mesh, P()))
+        else:
+            step = jnp.asarray(executor._step)
+        try:
+            out = seg.compiled(step, state, data)
+        except Exception as e:
+            detail = []
+            for group, d in (('state', state), ('data', data)):
+                for n, v in d.items():
+                    detail.append('%s[%s]: %s %s %s' % (
+                        group, n, getattr(v, 'shape', '?'),
+                        getattr(v, 'dtype', '?'),
+                        getattr(v, 'sharding', type(v).__name__)))
+            e.add_note('segment inputs:\n  ' + '\n  '.join(detail))
+            raise
         for n, v in out.items():
             scope.set_var(n, v)
             fetched[n] = v
@@ -189,7 +355,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
         val = fetched.get(name)
         if val is None:
             val = _core.as_array(scope.find_var(name))
-        results.append(np.asarray(val) if return_numpy else val)
+        results.append(_fetch_to_host(val) if return_numpy else val)
     return results
 
 

@@ -1,0 +1,110 @@
+"""Multi-process data-parallel trainer worker.
+
+The TPU-native analog of the reference's `dist_mnist.py`-style trainer
+scripts (`python/paddle/fluid/tests/unittests/test_dist_base.py:510`
+spawns these as subprocesses on 127.0.0.1): each process is one
+"trainer" that rendezvouses through jax.distributed (the gen_nccl_id
+replacement), feeds its OWN shard of the global batch, and trains with
+the fleet collective GradAllReduce rewrite.  The parent test asserts
+loss/parameter parity against a single-process full-batch run.
+
+Launched via `python -m paddle_tpu.distributed.launch` (which sets the
+PADDLE_TRAINER_* + JAX_* env contract).
+"""
+
+import json
+import os
+import sys
+
+
+def build_model(seed):
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, 32, act='relu')
+        h2 = fluid.layers.fc(h, 16, act='relu')
+        logits = fluid.layers.fc(h2, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+    return main, startup, loss
+
+
+def make_batches(steps=6, n=16):
+    import numpy as np
+    rng = np.random.RandomState(5)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(n, 8).astype('float32')
+        y = (np.abs(x).sum(1, keepdims=True) * 2).astype('int64') % 4
+        out.append((x, y))
+    return out
+
+
+def main():
+    # one CPU device per process: strip any forced host-device count
+    # inherited from the pytest parent before jax initializes
+    flags = os.environ.get('XLA_FLAGS', '').split()
+    flags = [f for f in flags
+             if 'xla_force_host_platform_device_count' not in f]
+    os.environ['XLA_FLAGS'] = ' '.join(flags)
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from paddle_tpu.distributed.launch import init_distributed
+    init_distributed()
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.incubate.fleet.collective import fleet, \
+        DistributedStrategy
+    from paddle_tpu.fluid.incubate.fleet.base import role_maker
+
+    rank = jax.process_index()
+    world = jax.process_count()
+    assert world > 1, 'worker expects a multi-process jax runtime'
+    mode = sys.argv[2] if len(sys.argv) > 2 else 'collective'
+
+    main_prog, startup, loss = build_model(9)
+    compiled = None
+    if mode == 'collective':
+        fleet.init(role_maker.PaddleCloudRoleMaker())
+        with fluid.program_guard(main_prog, startup):
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.SGD(0.1), DistributedStrategy())
+            opt.minimize(loss)
+    else:  # gspmd: CompiledProgram DP + ZeRO-sharded optimizer state
+        with fluid.program_guard(main_prog, startup):
+            fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+        compiled = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=loss.name).with_sharded_optimizer_states()
+
+    batches = make_batches()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for x, y in batches:
+            n_local = x.shape[0] // world
+            lo = rank * n_local
+            xl, yl = x[lo:lo + n_local], y[lo:lo + n_local]
+            l, = exe.run(compiled if compiled is not None else main_prog,
+                         feed={'x': xl, 'y': yl}, fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        pname = main_prog.all_parameters()[0].name
+        from paddle_tpu.fluid.parallel_executor import _fetch_to_host
+        final_param = _fetch_to_host(scope.find_var(pname))
+
+    outdir = sys.argv[1]
+    with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as f:
+        json.dump({'rank': rank, 'world': world, 'losses': losses,
+                   'param': final_param.tolist()}, f)
+    print('worker %d/%d done' % (rank, world))
+
+
+if __name__ == '__main__':
+    main()
