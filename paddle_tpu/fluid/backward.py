@@ -85,9 +85,17 @@ def _append_backward_impl(loss, program, block, parameter_list,
     checkpoint_names = set(v.name if isinstance(v, framework.Variable)
                            else v for v in (checkpoints or []))
 
+    recompute = None
+    if checkpoint_names:
+        recompute = _RecomputePlan(block, block.ops[:loss_idx + 1],
+                                   checkpoint_names, loss.name)
+
     for op in reversed(block.ops[:loss_idx + 1]):
+        rename = {}
+        if recompute is not None:
+            rename = recompute.activations_for(op)
         if not _op_backward(block, op, contribs, resolve_grad, no_grad_set,
-                            checkpoint_names):
+                            rename):
             continue
 
     # resolve every accumulated grad and publish the name map so callers
@@ -119,8 +127,120 @@ def _append_backward_impl(loss, program, block, parameter_list,
     return params_grads
 
 
+class _RecomputePlan(object):
+    """Activation checkpointing by program rewrite — the TPU-native
+    version of the reference's recompute backward
+    (python/paddle/fluid/backward.py:618
+    _append_backward_ops_with_checkpoints_):
+
+    Forward ops are split into spans at checkpoint-producing ops.  When
+    the backward walk enters a span, the span's forward ops are
+    re-emitted reading the span's external inputs through a
+    `recompute_barrier` (jax.lax.optimization_barrier — stops XLA from
+    CSE-ing the recomputation against the original forward, which is
+    what actually frees the activation memory), writing renamed
+    `<name>@RC` outputs; grad ops of that span then read the recomputed
+    activations instead of the originals.
+    """
+
+    def __init__(self, block, fwd_ops, checkpoint_names, loss_name):
+        from ..ops import registry
+        self.block = block
+        produced = set()
+        for op in fwd_ops:
+            produced.update(op.output_arg_names)
+        # stable names are free to read anywhere: params/persistables
+        # and anything not produced by the forward ops (feeds, startup)
+        self.stable = set()
+        for op in fwd_ops:
+            for n in op.input_arg_names:
+                if n not in produced:
+                    self.stable.add(n)
+                else:
+                    v = block._find_var_recursive(n)
+                    if v is not None and getattr(v, 'persistable', False):
+                        self.stable.add(n)
+        keep = set(checkpoint_names) | {loss_name}
+
+        # span assignment: a new span starts after an op that produces
+        # a checkpoint
+        self.span_of = {}
+        self.spans = []
+        cur = []
+        for op in fwd_ops:
+            if op.type in registry.HOST_OPS:
+                continue
+            cur.append(op)
+            self.span_of[id(op)] = len(self.spans)
+            if any(n in keep for n in op.output_arg_names):
+                self.spans.append(cur)
+                cur = []
+        if cur:
+            self.spans.append(cur)
+        self.keep = keep
+        self._emitted = {}  # span idx -> rename map
+
+    def activations_for(self, op):
+        """Rename map for the span containing `op`, emitting the span's
+        recompute ops on first use (the backward walk reaches the span's
+        last op first, so recomputation lands just before its grads)."""
+        s = self.span_of.get(id(op))
+        if s is None:
+            return {}
+        span_ops = self.spans[s]
+        if len(span_ops) <= 1:
+            return {}  # nothing to recompute: grads re-derive one op
+        if s in self._emitted:
+            return self._emitted[s]
+        rename = {}
+        span_produced = set()
+        for f in span_ops:
+            span_produced.update(f.output_arg_names)
+        # barrier the span's non-stable external activation inputs
+        for f in span_ops:
+            for n in f.input_arg_names:
+                if n in rename or n in span_produced or n in self.stable:
+                    continue
+                self._mk_var(n, n + '@RCIN')
+                self.block.append_op(
+                    'recompute_barrier', inputs={'X': [n]},
+                    outputs={'Out': [n + '@RCIN']}, infer_shape=False)
+                rename[n] = n + '@RCIN'
+        # re-emit the span's forward ops with renamed outputs (keep
+        # outputs stay materialized: their @RC twin is dead code)
+        for f in span_ops:
+            ins = {slot: [rename.get(n, n) for n in names]
+                   for slot, names in f.inputs.items()}
+            outs = {}
+            for slot, names in f.outputs.items():
+                row = []
+                for n in names:
+                    rc = n + '@RC'
+                    self._mk_var(n, rc)
+                    if n not in self.keep:
+                        rename[n] = rc
+                    row.append(rc)
+                outs[slot] = row
+            attrs = dict(f.attrs)
+            attrs['__op_role__'] = 'backward'
+            self.block.append_op(f.type, inputs=ins, outputs=outs,
+                                 attrs=attrs, infer_shape=False)
+        self._emitted[s] = rename
+        return rename
+
+    def _mk_var(self, src_name, new_name):
+        if self.block.has_var(new_name):
+            return
+        v = self.block._find_var_recursive(src_name)
+        nv = self.block.create_var(
+            name=new_name, shape=v.shape if v is not None else (),
+            dtype=v.dtype if v is not None else 'float32')
+        nv.stop_gradient = True
+
+
 def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
-                 checkpoint_names=()):
+                 rename=None):
+    rename = rename or {}
     from ..ops import registry
     if op.type in registry.HOST_OPS:
         return False
@@ -144,7 +264,8 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
                 z = block.create_var(
                     name=framework.unique_name.generate(n + '@ZERO'),
                     shape=v.shape, dtype=v.dtype)
-                block.append_op('fill_zeros_like', inputs={'X': n},
+                block.append_op('fill_zeros_like',
+                                inputs={'X': rename.get(n, n)},
                                 outputs={'Out': z})
                 g = z.name
             row.append(g)
@@ -163,7 +284,8 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
                for (_, n, v) in in_vars):
         return False
 
-    grad_inputs = dict(op.inputs)
+    grad_inputs = {slot: [rename.get(n, n) for n in names]
+                   for slot, names in op.inputs.items()}
     grad_inputs.update(grad_in)
     grad_outputs = {}
     for slot, names in op.inputs.items():
@@ -183,10 +305,6 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
     # the grad op inherits the forward op's attrs (incl. __op_seed__, so
     # e.g. dropout regenerates the same mask) but NOT its role
     attrs['__op_role__'] = 'backward'
-    if op.type in ('matmul', 'matmul_v2', 'mul', 'conv2d',
-                   'depthwise_conv2d') or any(
-            n in checkpoint_names for n in op.input_arg_names):
-        pass  # recompute policy hooks (RecomputeOptimizer) land here
     block.append_op(op.type + '_grad', inputs=grad_inputs,
                     outputs=grad_outputs, attrs=attrs,
                     infer_shape=False)

@@ -10,10 +10,38 @@ process per host (jax.distributed), jax handles the collective; this
 wrapper keeps the reference API (scale_loss / apply_collective_grads).
 """
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .layers import Layer
+
+# one-device-per-process mesh + jitted cross-process SUM, built lazily
+_PSUM_CACHE = {}
+
+
+def _process_sum(host_leaves):
+    """SUM a list of per-process host arrays across processes: each leaf
+    rides ONE fused reduction over a one-device-per-process mesh (O(M)
+    transfer — the eager analog of an NCCL allreduce), not
+    allgather+host-sum which would move and hold world_size copies."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if 'mesh' not in _PSUM_CACHE:
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        mesh = Mesh(np.array([by_proc[i] for i in sorted(by_proc)]),
+                    ('p',))
+        _PSUM_CACHE['mesh'] = mesh
+        _PSUM_CACHE['fn'] = jax.jit(
+            lambda leaves: [jnp.sum(a, axis=0) for a in leaves],
+            out_shardings=NamedSharding(mesh, P()))
+    mesh = _PSUM_CACHE['mesh']
+    sh = NamedSharding(mesh, P('p'))
+    ins = [jax.make_array_from_process_local_data(
+        sh, np.asarray(g)[None]) for g in host_leaves]
+    outs = _PSUM_CACHE['fn'](ins)
+    return [np.asarray(o.addressable_data(0)) for o in outs]
 
 
 class ParallelEnv(object):
@@ -48,15 +76,35 @@ class DataParallel(Layer):
         return loss * (1.0 / n)
 
     def apply_collective_grads(self):
+        """Sum-allreduce every parameter gradient across trainer
+        processes (reference: DataParallel.apply_collective_grads over
+        NCCLParallelContext; the loss was pre-scaled by 1/nranks in
+        scale_loss, so the allreduce is a SUM).
+
+        Every parameter participates with zeros standing in for absent
+        grads, so the collective's structure is identical on all ranks
+        even when data-dependent branches touch different parameters."""
         n = getattr(self._strategy, 'nranks', 1)
-        if n <= 1:
+        if n <= 1 or jax.process_count() <= 1:
             return
-        for p in self._layers.parameters():
+        params = list(self._layers.parameters())
+        if not params:
+            return
+        leaves = []
+        flags = np.zeros(len(params), np.float32)
+        for i, p in enumerate(params):
             if p.grad is not None:
-                # multi-process eager: psum across processes
-                p.grad = jax.experimental.multihost_utils.\
-                    process_allreduce(p.grad) if hasattr(
-                        jax.experimental, 'multihost_utils') else p.grad
+                leaves.append(np.asarray(p.grad))
+                flags[i] = 1.0
+            else:
+                leaves.append(np.zeros(np.shape(np.asarray(p.value)),
+                                       np.asarray(p.value).dtype))
+        leaves.append(flags)
+        summed = _process_sum(leaves)
+        flag_sums = summed[-1]
+        for i, p in enumerate(params):
+            if flag_sums[i] > 0:
+                p.grad = jnp.asarray(summed[i])
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

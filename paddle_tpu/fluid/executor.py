@@ -62,11 +62,19 @@ def _lower_ops(ops, env, step, prefer_test):
             try:
                 ins[slot] = [env[n] for n in names]
             except KeyError as e:
-                raise RuntimeError(
+                err = RuntimeError(
                     'op %s reads undefined var %s' % (op.type, e))
+                _add_note(err, _op_error_context(op, {}))
+                raise err from e
         ctx = registry.LowerCtx(step, op.attrs.get('__op_seed__', 0),
                                 prefer_test)
-        outs = opdef.fn(ctx, ins, op.attrs)
+        try:
+            outs = opdef.fn(ctx, ins, op.attrs)
+        except Exception as e:
+            # enforce-style error context (reference: PADDLE_ENFORCE +
+            # op_callstack, platform/enforce.h, framework/op_call_stack.h)
+            _add_note(e, _op_error_context(op, ins))
+            raise
         for slot, names in op.outputs.items():
             vals = outs.get(slot, [])
             for n, v in zip(names, vals):
@@ -133,6 +141,30 @@ def _lower_conditional_block(op, env, step, prefer_test):
     pred = jnp.asarray(env[cond_name]).reshape(())
     final = jax.lax.cond(pred, true_fn, lambda c: c, init)
     env.update(final)
+
+
+def _add_note(e, note):
+    """Attach context to an exception (PEP 678); no-op fallback on
+    interpreters without add_note so the real error is never masked."""
+    if hasattr(e, 'add_note'):
+        e.add_note(note)
+
+
+def _op_error_context(op, ins):
+    """One text block describing the failing op: type, input
+    shapes/dtypes, and the user callstack recorded at op creation."""
+    lines = ['error raised while lowering op [%s]' % op.type]
+    for slot, names in op.inputs.items():
+        vals = ins.get(slot, [])
+        for n, v in zip(names, vals):
+            lines.append('  input %s[%s]: shape=%s dtype=%s'
+                         % (slot, n, getattr(v, 'shape', '?'),
+                            getattr(v, 'dtype', '?')))
+    stack = op.attrs.get('__op_callstack__') or []
+    if stack:
+        lines.append('op created at (most recent call first):')
+        lines.extend('  ' + s for s in stack)
+    return '\n'.join(lines)
 
 
 def _make_segment_fn(segment, prefer_test=False):

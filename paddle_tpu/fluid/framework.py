@@ -13,6 +13,8 @@ the IR never drifts from the kernels.
 """
 
 import contextlib
+import os
+import sys
 
 import numpy as np
 
@@ -187,6 +189,24 @@ class Operator(object):
                            for k, v in self.attrs.items()})
 
 
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _user_callstack(limit=6):
+    """User-code frames (outside paddle_tpu) at op-creation time.
+    Reference: framework/op_call_stack.h records the Python stack into
+    the op_callstack attr for PADDLE_ENFORCE error reports."""
+    frames = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < limit:
+        fname = f.f_code.co_filename
+        if not fname.startswith(_PKG_DIR + os.sep):
+            frames.append('%s:%d (%s)' % (fname, f.f_lineno,
+                                          f.f_code.co_name))
+        f = f.f_back
+    return frames
+
+
 def _attr_to_jsonable(v):
     if isinstance(v, np.ndarray):
         return v.tolist()
@@ -257,6 +277,11 @@ class Block(object):
         attrs = dict(attrs or {})
         if '__op_seed__' not in attrs:
             attrs['__op_seed__'] = self.program._next_op_seed()
+        # creation-site stamp (reference: op_callstack attr,
+        # framework/op_call_stack.h) so runtime errors point at the
+        # user's layer call, not the lowering internals
+        if '__op_callstack__' not in attrs:
+            attrs['__op_callstack__'] = _user_callstack()
         # role stamp (reference: OpRole attr, framework/op_proto_maker.h):
         # lets clone(for_test=True) prune backward/optimize ops.
         if '__op_role__' not in attrs:

@@ -15,7 +15,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
-from .executor import _Segment, _make_segment_fn
+from .executor import _Segment, _make_segment_fn, _add_note
 
 
 def _default_mesh(places=None):
@@ -345,7 +345,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
                         group, n, getattr(v, 'shape', '?'),
                         getattr(v, 'dtype', '?'),
                         getattr(v, 'sharding', type(v).__name__)))
-            e.add_note('segment inputs:\n  ' + '\n  '.join(detail))
+            _add_note(e, 'segment inputs:\n  ' + '\n  '.join(detail))
             raise
         for n, v in out.items():
             scope.set_var(n, v)

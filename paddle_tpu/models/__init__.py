@@ -6,6 +6,7 @@ unchanged on the reference framework.
 
 from . import lenet
 from . import resnet
+from . import se_resnext
 from . import bert
 from . import transformer
 from . import wide_deep

@@ -96,6 +96,18 @@ def share_data(ctx, ins, attrs):
     return {'Out': [_x(ins)]}
 
 
+@register('recompute_barrier')
+def recompute_barrier(ctx, ins, attrs):
+    """Identity that XLA cannot CSE through: makes recomputed forward
+    spans (RecomputeOptimizer) actually rematerialize instead of being
+    deduped against the original forward, which would keep the
+    activations alive and void the memory savings.  The TPU-native
+    analog of the reference's explicit recompute sub-graphs
+    (backward.py:618 _append_backward_ops_with_checkpoints_)."""
+    import jax
+    return {'Out': [jax.lax.optimization_barrier(_x(ins))]}
+
+
 @register('cast')
 def cast(ctx, ins, attrs):
     from ..fluid import core

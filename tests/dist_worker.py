@@ -44,6 +44,58 @@ def make_batches(steps=6, n=16):
     return out
 
 
+def _dygraph_main(rank, world):
+    """Eager DataParallel: scale_loss + apply_collective_grads (sum)
+    across 2 real processes — reference parallel_dygraph_mnist.py."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.dygraph import Linear, to_variable
+    from paddle_tpu.fluid.dygraph.parallel import DataParallel, \
+        prepare_context
+    from paddle_tpu.fluid.framework import _dygraph_tracer
+
+    class Net(fluid.dygraph.Layer):
+        def __init__(self):
+            super(Net, self).__init__()
+            self.fc1 = Linear(8, 16, act='relu')
+            self.fc2 = Linear(16, 1)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    losses = []
+    with fluid.dygraph.guard():
+        np.random.seed(17)
+        strategy = prepare_context()
+        model = DataParallel(Net(), strategy)
+        opt = fluid.optimizer.SGD(0.1)
+        for x, y in make_batches():
+            n_local = x.shape[0] // world
+            lo = rank * n_local
+            xl = x[lo:lo + n_local]
+            yl = x[lo:lo + n_local].sum(1, keepdims=True).astype(
+                'float32')
+            xv, yv = to_variable(xl), to_variable(yl)
+            pred = model(xv)
+            diff = pred - yv
+            loss = _dygraph_tracer().trace_op(
+                'mean', {'X': [diff * diff]})['Out'][0]
+            loss = model.scale_loss(loss)
+            loss.backward()
+            model.apply_collective_grads()
+            opt.minimize(loss, parameter_list=model.parameters())
+            for p in model.parameters():
+                p.clear_gradient()
+            losses.append(float(np.asarray(loss.value).ravel()[0]))
+        w = np.asarray(model._layers.fc1.weight.value)
+
+    outdir = sys.argv[1]
+    with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as f:
+        json.dump({'rank': rank, 'world': world, 'losses': losses,
+                   'param': w.tolist()}, f)
+    print('dygraph worker %d/%d done' % (rank, world))
+
+
 def main():
     # one CPU device per process: strip any forced host-device count
     # inherited from the pytest parent before jax initializes
@@ -67,6 +119,8 @@ def main():
     world = jax.process_count()
     assert world > 1, 'worker expects a multi-process jax runtime'
     mode = sys.argv[2] if len(sys.argv) > 2 else 'collective'
+    if mode == 'dygraph':
+        return _dygraph_main(rank, world)
 
     main_prog, startup, loss = build_model(9)
     compiled = None

@@ -111,6 +111,59 @@ def test_two_process_collective_parity(tmp_path):
                                atol=1e-4)
 
 
+def _dygraph_reference():
+    """Single-process full-batch eager training mirroring the dygraph
+    worker."""
+    from paddle_tpu.fluid.dygraph import Linear, to_variable
+    from paddle_tpu.fluid.framework import _dygraph_tracer
+
+    class Net(fluid.dygraph.Layer):
+        def __init__(self):
+            super(Net, self).__init__()
+            self.fc1 = Linear(8, 16, act='relu')
+            self.fc2 = Linear(16, 1)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    losses = []
+    with fluid.dygraph.guard():
+        np.random.seed(17)
+        net = Net()
+        opt = fluid.optimizer.SGD(0.1)
+        for x, _ in make_batches():
+            y = x.sum(1, keepdims=True).astype('float32')
+            xv, yv = to_variable(x), to_variable(y)
+            diff = net(xv) - yv
+            loss = _dygraph_tracer().trace_op(
+                'mean', {'X': [diff * diff]})['Out'][0]
+            loss.backward()
+            opt.minimize(loss, parameter_list=net.parameters())
+            for p in net.parameters():
+                p.clear_gradient()
+            losses.append(float(np.asarray(loss.value).ravel()[0]))
+        w = np.asarray(net.fc1.weight.value)
+    return losses, w
+
+
+def test_two_process_dygraph_dataparallel_parity(tmp_path):
+    """Eager DataParallel (scale_loss + apply_collective_grads) across
+    two real processes — reference parallel_dygraph_mnist fixture."""
+    results = _launch_two_workers(tmp_path, 'dygraph')
+
+    p0 = np.asarray(results[0]['param'])
+    p1 = np.asarray(results[1]['param'])
+    np.testing.assert_allclose(p0, p1, rtol=1e-6, atol=1e-7)
+
+    ref_losses, ref_param = _dygraph_reference()
+    np.testing.assert_allclose(ref_param, p0, rtol=1e-4, atol=1e-5)
+    # scaled local losses: sum across workers ~= full-batch loss
+    sum_losses = np.sum([results[0]['losses'], results[1]['losses']],
+                        axis=0)
+    np.testing.assert_allclose(ref_losses, sum_losses, rtol=1e-3,
+                               atol=1e-4)
+
+
 def test_two_process_gspmd_zero_parity(tmp_path):
     """CompiledProgram GSPMD DP + ZeRO-sharded Momentum accumulators
     across two real processes."""

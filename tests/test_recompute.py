@@ -1,0 +1,80 @@
+"""RecomputeOptimizer: activation checkpointing by program rewrite.
+
+Reference: python/paddle/fluid/optimizer.py:3611 (RecomputeOptimizer) +
+backward.py:618 (_append_backward_ops_with_checkpoints_).  Training with
+recompute must match plain training exactly; the backward region must
+contain the re-emitted forward spans behind recompute_barrier ops.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def build(seed):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[16], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        h1 = fluid.layers.fc(x, 32, act='relu')
+        h2 = fluid.layers.fc(h1, 32, act='relu')
+        h3 = fluid.layers.fc(h2, 32, act='relu')
+        pred = fluid.layers.fc(h3, 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+    return main, startup, loss, [h2]
+
+
+def train(main, startup, loss, opt, steps=8):
+    rng = np.random.RandomState(3)
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(steps):
+            xb = rng.randn(16, 16).astype('float32')
+            yb = xb.sum(1, keepdims=True)
+            l, = exe.run(main, feed={'x': xb, 'y': yb},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        p = main.all_parameters()[0].name
+        param = np.asarray(scope.find_var(p))
+    return losses, param
+
+
+def test_recompute_matches_plain_training():
+    m1, s1, l1, _ = build(7)
+    with fluid.program_guard(m1, s1):
+        fluid.optimizer.SGD(0.05).minimize(l1)
+    ref_losses, ref_param = train(m1, s1, l1, None)
+
+    m2, s2, l2, ckpts = build(7)
+    with fluid.program_guard(m2, s2):
+        opt = fluid.optimizer.RecomputeOptimizer(fluid.optimizer.SGD(0.05))
+        opt._set_checkpoints(ckpts)
+        opt.minimize(l2)
+    rc_losses, rc_param = train(m2, s2, l2, None)
+
+    np.testing.assert_allclose(ref_losses, rc_losses, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(ref_param, rc_param, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_rewrites_program():
+    m, s, loss, ckpts = build(11)
+    with fluid.program_guard(m, s):
+        opt = fluid.optimizer.RecomputeOptimizer(fluid.optimizer.SGD(0.05))
+        opt._set_checkpoints(ckpts)
+        opt.minimize(loss)
+    ops = m.global_block().ops
+    types = [op.type for op in ops]
+    assert 'recompute_barrier' in types
+    # re-emitted forward ops write @RC twins in the backward region
+    rc_outputs = [n for op in ops for n in op.output_arg_names
+                  if n.endswith('@RC')]
+    assert rc_outputs, 'expected recomputed forward activations'
+    # recompute ops carry the backward role so eval clones prune them
+    for op in ops:
+        if op.type == 'recompute_barrier':
+            assert op.attrs['__op_role__'] == 'backward'
