@@ -1,0 +1,44 @@
+"""Host-initiated cross-process collectives for eager / step-boundary
+protocols (dygraph DataParallel grad sync, LocalSGD param averaging).
+
+Reference analog: imperative/nccl_context.h + collective.py LocalSGD —
+host code triggering an allreduce outside the compiled graph.  Here each
+leaf rides ONE fused jitted reduction over a one-device-per-process mesh
+(O(M) transfer), the eager analog of an NCCL allreduce.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_PSUM_CACHE = {}
+
+
+def process_sum(host_leaves):
+    """SUM a list of per-process host arrays across processes; returns
+    host arrays.  Single-process: identity."""
+    if jax.process_count() <= 1:
+        return [np.asarray(g) for g in host_leaves]
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if 'mesh' not in _PSUM_CACHE:
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        mesh = Mesh(np.array([by_proc[i] for i in sorted(by_proc)]),
+                    ('p',))
+        _PSUM_CACHE['mesh'] = mesh
+        _PSUM_CACHE['fn'] = jax.jit(
+            lambda leaves: [jnp.sum(a, axis=0) for a in leaves],
+            out_shardings=NamedSharding(mesh, P()))
+    mesh = _PSUM_CACHE['mesh']
+    sh = NamedSharding(mesh, P('p'))
+    ins = [jax.make_array_from_process_local_data(
+        sh, np.asarray(g)[None]) for g in host_leaves]
+    outs = _PSUM_CACHE['fn'](ins)
+    return [np.asarray(o.addressable_data(0)) for o in outs]
+
+
+def process_mean(host_leaves):
+    """Average a list of per-process host arrays across processes."""
+    n = jax.process_count()
+    return [s / n for s in process_sum(host_leaves)]

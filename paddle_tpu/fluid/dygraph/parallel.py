@@ -15,33 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from .layers import Layer
-
-# one-device-per-process mesh + jitted cross-process SUM, built lazily
-_PSUM_CACHE = {}
-
-
-def _process_sum(host_leaves):
-    """SUM a list of per-process host arrays across processes: each leaf
-    rides ONE fused reduction over a one-device-per-process mesh (O(M)
-    transfer — the eager analog of an NCCL allreduce), not
-    allgather+host-sum which would move and hold world_size copies."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    if 'mesh' not in _PSUM_CACHE:
-        by_proc = {}
-        for d in jax.devices():
-            by_proc.setdefault(d.process_index, d)
-        mesh = Mesh(np.array([by_proc[i] for i in sorted(by_proc)]),
-                    ('p',))
-        _PSUM_CACHE['mesh'] = mesh
-        _PSUM_CACHE['fn'] = jax.jit(
-            lambda leaves: [jnp.sum(a, axis=0) for a in leaves],
-            out_shardings=NamedSharding(mesh, P()))
-    mesh = _PSUM_CACHE['mesh']
-    sh = NamedSharding(mesh, P('p'))
-    ins = [jax.make_array_from_process_local_data(
-        sh, np.asarray(g)[None]) for g in host_leaves]
-    outs = _PSUM_CACHE['fn'](ins)
-    return [np.asarray(o.addressable_data(0)) for o in outs]
+from ...distributed.collective_utils import process_sum as _process_sum
 
 
 class ParallelEnv(object):
@@ -97,8 +71,9 @@ class DataParallel(Layer):
                 leaves.append(np.asarray(p.grad))
                 flags[i] = 1.0
             else:
-                leaves.append(np.zeros(np.shape(np.asarray(p.value)),
-                                       np.asarray(p.value).dtype))
+                v = p.value
+                leaves.append(np.zeros(getattr(v, 'shape', ()),
+                                       getattr(v, 'dtype', 'float32')))
         leaves.append(flags)
         summed = _process_sum(leaves)
         flag_sums = summed[-1]

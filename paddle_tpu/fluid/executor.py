@@ -213,8 +213,25 @@ class Executor(object):
         plan = self._get_plan(program, tuple(sorted(feed.keys())),
                               tuple(fetch_names))
         self._step += 1
-        return self._run_plan(program, plan, feed, fetch_names, scope,
-                              return_numpy)
+        out = self._run_plan(program, plan, feed, fetch_names, scope,
+                             return_numpy)
+        lsgd = getattr(program, '_local_sgd', None)
+        if lsgd:
+            lsgd['count'] = lsgd.get('count', 0) + 1
+            if lsgd['count'] % lsgd['period'] == 0:
+                self._local_sgd_sync(scope, lsgd['params'])
+        if getattr(program, '_ps_async', None):
+            from .incubate.fleet.parameter_server import ps_async_step
+            ps_async_step(self, scope, program)
+        return out
+
+    def _local_sgd_sync(self, scope, param_names):
+        """LocalSGD sync point: average trainable params across trainer
+        processes (reference: transpiler/collective.py LocalSGD)."""
+        from ..distributed.collective_utils import process_mean
+        vals = [core.as_array(scope.find_var(n)) for n in param_names]
+        for n, avg in zip(param_names, process_mean(vals)):
+            scope.set_var(n, avg)
 
     # ------------------------------------------------------------------
     def _get_plan(self, program, feed_names, fetch_names):
@@ -274,7 +291,11 @@ class Executor(object):
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable:
                     persistable.add(n)
-            outputs = written & (persistable | later_reads[i] | fetch_set)
+            # extra outputs: vars consumed outside the program by host
+            # protocols (e.g. async-PS grad push), exempt from DCE
+            extra = set(getattr(program, '_extra_output_names', ()))
+            outputs = written & (persistable | later_reads[i] |
+                                 fetch_set | extra)
             # state = inputs that are also written (in-place params etc.)
             state = sorted(reads_before_write & written)
             inputs = sorted(reads_before_write - set(state))

@@ -23,6 +23,7 @@ class DistributedStrategy(object):
         self.mode = 'grad_allreduce'  # or 'local_sgd'
         self.nrings = 1
         self.use_local_sgd = False
+        self.local_sgd_period = 4
         self.use_amp = False
         self.amp_loss_scaling = 2 ** 15
         self.use_recompute = False
@@ -69,6 +70,14 @@ class CollectiveOptimizer(DistributedOptimizer):
                                     parameter_list, no_grad_set)
         program = loss.block.program
         import jax
+        optimize_ops = None
+        if self._strategy.use_local_sgd or \
+                self._strategy.mode == 'local_sgd':
+            from ....transpiler.collective import LocalSGD
+            optimize_ops = opt.apply_gradients(params_grads)
+            LocalSGD(steps=self._strategy.local_sgd_period).transpile(
+                startup_program, program, 0, ['127.0.0.1'], '127.0.0.1')
+            return optimize_ops, params_grads
         nranks = max(len(jax.devices()), 1)
         self._insert_allreduce(program.global_block(), params_grads,
                                nranks)

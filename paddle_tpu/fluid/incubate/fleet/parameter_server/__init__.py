@@ -1,0 +1,135 @@
+"""Parameter-server fleet (Downpour/PSLib analog).
+
+Reference: python/paddle/fluid/incubate/fleet/parameter_server/
+(distribute_transpiler + pslib frontends over
+operators/distributed/communicator.h:175 AsyncCommunicator and
+framework/fleet/fleet_wrapper.h:55 FleetWrapper pull/push).
+
+TPU-native re-design: there are no pserver PROCESSES — dense sync rides
+XLA collectives, so the classic CPU parameter server survives as the
+pattern the CTR workloads actually need: an in-process (host-thread)
+parameter store with ASYNC bounded-staleness updates
+(`distributed.ParameterServerStore` + `AsyncCommunicator`, preserving
+merge-before-send semantics) for dense params, and host-sharded
+embedding tables (`parallel/sparse_embedding.py`) for the sparse path.
+The fleet API surface (init/init_worker/run_server/stop_worker/
+distributed_optimizer) is kept so reference PS scripts port unchanged;
+sync_mode=True degenerates to collective grad-allreduce, matching the
+reference guidance that sync PS ~ collective training.
+"""
+
+import numpy as np
+
+from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
+from .....distributed import ParameterServerStore, AsyncCommunicator
+from .... import core
+
+
+class ParameterServerFleet(Fleet):
+    def __init__(self):
+        super(ParameterServerFleet, self).__init__(Mode.TRANSPILER)
+        self._server = None
+        self._communicator = None
+        self._main_program = None
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ....transpiler import DistributeTranspilerConfig
+        self._optimizer = ParameterServerOptimizer(
+            optimizer, strategy or DistributeTranspilerConfig(), self)
+        return self._optimizer
+
+    # -- server lifecycle (embedded: the "pserver" is a host-side store)
+    def init_server(self, model_dir=None):
+        if self._server is None:
+            self._server = ParameterServerStore(
+                lr=self._optimizer._server_lr
+                if self._optimizer else 1.0)
+
+    def run_server(self):
+        self.init_server()
+
+    def init_worker(self):
+        """Start the async communicator (reference:
+        Communicator::Start, operators/distributed/communicator.h)."""
+        self.init_server()
+        if self._communicator is None:
+            self._communicator = AsyncCommunicator(self._server)
+            self._communicator.start()
+
+    def stop_worker(self):
+        if self._communicator is not None:
+            self._communicator.flush()
+            self._communicator.stop()
+            self._communicator = None
+        # end of training session: drop the embedded server so a later
+        # session (possibly reusing param names) starts clean
+        self._server = None
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        from .... import io
+        return io.save_persistables(executor, dirname, main_program,
+                                    filename)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor,
+                                       main_program)
+
+
+class ParameterServerOptimizer(DistributedOptimizer):
+    """Async mode (sync_mode=False): backward only — gradients go to the
+    embedded server through the communicator (merge-before-send, bounded
+    staleness), updated params are pulled back each step; the trainer
+    program carries NO optimizer ops, exactly like a transpiled async
+    trainer (reference distribute_transpiler.py async mode).
+    Sync mode: collective grad-allreduce rewrite."""
+
+    def __init__(self, optimizer, strategy, fleet_ref):
+        super(ParameterServerOptimizer, self).__init__(optimizer,
+                                                       strategy)
+        self._fleet = fleet_ref
+        lr = getattr(optimizer, '_learning_rate', 1.0)
+        self._server_lr = float(lr if not callable(lr) else 1.0)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        if getattr(self._strategy, 'sync_mode', True):
+            from ...collective import CollectiveOptimizer
+            return CollectiveOptimizer(self._optimizer).minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program,
+                                     parameter_list, no_grad_set)
+        pairs = [(p.name, g.name) for p, g in params_grads
+                 if g is not None]
+        program._ps_async = {'pairs': pairs, 'fleet': self._fleet}
+        # grads have no in-program consumers (no optimizer ops); exempt
+        # them from the executor's dead-code elimination
+        program._extra_output_names = set(
+            getattr(program, '_extra_output_names', ())) | set(
+            g for _, g in pairs)
+        return [], params_grads
+
+
+def ps_async_step(executor, scope, program):
+    """Executor hook, one trainer step of the async PS protocol:
+    push grads (merged in background threads), pull current params."""
+    fleet_ref = program._ps_async['fleet']
+    if fleet_ref._communicator is None:
+        fleet_ref.init_worker()
+    comm = fleet_ref._communicator
+    server = fleet_ref._server
+    for pname, gname in program._ps_async['pairs']:
+        if pname not in server.names():
+            server.init_var(pname, core.as_array(scope.find_var(pname)))
+        g = scope.find_var(gname)
+        if g is not None:
+            comm.send(pname, np.asarray(core.as_array(g)))
+        scope.set_var(pname, comm.recv(pname))
+
+
+fleet = ParameterServerFleet()

@@ -1,0 +1,1 @@
+from .fs import LocalFS, HDFSClient, ExecuteError

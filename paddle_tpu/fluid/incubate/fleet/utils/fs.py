@@ -1,0 +1,153 @@
+"""Filesystem shell utilities for dataset/checkpoint IO.
+
+Reference: paddle/fluid/framework/io/fs.h + shell.h (C++ fs access by
+shelling out) and python/paddle/fluid/incubate/fleet/utils/hdfs.py
+(HDFSClient wrapping `hadoop fs`).  Same surface here: LocalFS for the
+common case, HDFSClient shelling out to a hadoop binary when one is
+configured (this image has no cluster egress, so HDFS paths raise a
+clear error unless hadoop_home points at a real client).
+"""
+
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class LocalFS(object):
+    """Reference fs.h localfs_* ops."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst, overwrite=False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise ExecuteError('%s exists' % dst)
+            self.delete(dst)
+        os.replace(src, dst)
+
+    mv = rename
+
+    def touch(self, path):
+        open(path, 'a').close()
+
+    def cat(self, path):
+        with open(path) as f:
+            return f.read()
+
+    # (dest, src) argument order matches HDFSClient so the two
+    # filesystems are interchangeable in checkpoint code
+    def upload(self, dest_path, local_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, dest_path, dirs_exist_ok=True)
+        else:
+            shutil.copy(local_path, dest_path)
+
+    def download(self, src_path, local_path):
+        if os.path.isdir(src_path):
+            shutil.copytree(src_path, local_path, dirs_exist_ok=True)
+        else:
+            shutil.copy(src_path, local_path)
+
+    @staticmethod
+    def split_files(files, trainer_id, trainers):
+        """Round-robin file split across trainers (reference
+        hdfs.py:394 split_files) — how dataset shards are assigned."""
+        return [f for i, f in enumerate(sorted(files))
+                if i % trainers == trainer_id]
+
+
+class HDFSClient(object):
+    """Reference hdfs.py:45 — every op shells out to `hadoop fs`."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self._hadoop_home = hadoop_home or os.environ.get('HADOOP_HOME')
+        self._configs = configs or {}
+
+    def _cmd_prefix(self):
+        if not self._hadoop_home:
+            raise ExecuteError(
+                'no hadoop client: set hadoop_home or HADOOP_HOME '
+                '(this environment has no cluster egress)')
+        cmd = [os.path.join(self._hadoop_home, 'bin', 'hadoop'), 'fs']
+        for k, v in self._configs.items():
+            cmd += ['-D', '%s=%s' % (k, v)]
+        return cmd
+
+    def _run(self, args, retry_times=5):
+        last = None
+        for _ in range(max(1, retry_times)):
+            p = subprocess.run(self._cmd_prefix() + args,
+                               capture_output=True, text=True)
+            if p.returncode == 0:
+                return p.stdout
+            last = p.stderr
+        raise ExecuteError('hadoop fs %s failed: %s' % (args, last))
+
+    def is_exist(self, path):
+        try:
+            self._run(['-test', '-e', path], retry_times=1)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            self._run(['-test', '-d', path], retry_times=1)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls(self, path):
+        out = self._run(['-ls', path])
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith('Found')]
+
+    def cat(self, path):
+        return self._run(['-cat', path])
+
+    def delete(self, path):
+        return self._run(['-rm', '-r', path])
+
+    def makedirs(self, path):
+        return self._run(['-mkdir', '-p', path])
+
+    def rename(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        return self._run(['-mv', src, dst])
+
+    def upload(self, hdfs_path, local_path):
+        return self._run(['-put', local_path, hdfs_path])
+
+    def download(self, hdfs_path, local_path):
+        return self._run(['-get', hdfs_path, local_path])
+
+    split_files = staticmethod(LocalFS.split_files)

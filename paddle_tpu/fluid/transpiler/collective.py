@@ -59,19 +59,46 @@ class GradAllReduce(Collective):
 
 class LocalSGD(Collective):
     """Reference collective.py:270: train locally, periodically average
-    params across workers."""
+    params across workers.
+
+    Two renderings, matching worker granularity:
+
+    - multi-process (jax.distributed, workers == trainer processes, the
+      reference's actual topology): each process trains its plain local
+      program; every `steps` runs the executor averages the trainable
+      params across processes on the host (collective_utils.process_mean)
+      — true k-step LocalSGD with divergent local replicas between syncs.
+    - single-process multi-device: workers are mesh devices running
+      inside one shard_map, where divergent per-device params cannot
+      outlive a step (replicated out-specs), so params are averaged
+      in-graph every step.  For SGD this is mathematically identical to
+      gradient allreduce (update is linear in the grad).
+    """
 
     def __init__(self, nrings=1, steps=4):
         super(LocalSGD, self).__init__(nrings)
         self.steps = steps
 
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        import jax
+        if jax.process_count() > 1:
+            self.main_program = main_program
+            self.nranks = jax.process_count()
+            params = [p.name for p in
+                      main_program.global_block().all_parameters()
+                      if getattr(p, 'trainable', True)]
+            main_program._local_sgd = {'period': self.steps,
+                                       'params': params}
+            return
+        super(LocalSGD, self).transpile(
+            startup_program, main_program, rank, endpoints,
+            current_endpoint, wait_port)
+
     def _transpile_main_program(self):
         block = self.main_program.global_block()
         params = [p.name for p in block.all_parameters()
                   if getattr(p, 'trainable', True)]
-        # every step: p = allreduce(p)/nranks — a conservative rendering
-        # of periodic averaging (step-gating via counter lands with the
-        # conditional runtime)
         for name in params:
             block.append_op('c_allreduce_sum', inputs={'X': name},
                             outputs={'Out': name},

@@ -124,11 +124,15 @@ def main():
 
     main_prog, startup, loss = build_model(9)
     compiled = None
-    if mode == 'collective':
+    if mode in ('collective', 'local_sgd'):
         fleet.init(role_maker.PaddleCloudRoleMaker())
+        strategy = DistributedStrategy()
+        if mode == 'local_sgd':
+            strategy.use_local_sgd = True
+            strategy.local_sgd_period = 2
         with fluid.program_guard(main_prog, startup):
             opt = fleet.distributed_optimizer(
-                fluid.optimizer.SGD(0.1), DistributedStrategy())
+                fluid.optimizer.SGD(0.1), strategy)
             opt.minimize(loss)
     else:  # gspmd: CompiledProgram DP + ZeRO-sharded optimizer state
         with fluid.program_guard(main_prog, startup):

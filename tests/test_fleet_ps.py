@@ -1,0 +1,87 @@
+"""Parameter-server fleet frontend (Downpour/PSLib analog): async
+bounded-staleness training through the embedded server converges.
+
+Reference fixture: test_dist_fleet_base.py (PS fleet init_worker/
+run_server/stop_worker lifecycle + async trainer convergence).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.incubate.fleet.parameter_server import fleet
+from paddle_tpu.fluid.incubate.fleet.base import role_maker
+from paddle_tpu.fluid.transpiler import DistributeTranspilerConfig
+
+
+def test_async_ps_fleet_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(fluid.layers.fc(x, 16, act='relu'), 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    config = DistributeTranspilerConfig()
+    config.sync_mode = False
+    with fluid.program_guard(main, startup):
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.05),
+                                          config)
+        opt.minimize(loss)
+
+    # async trainer program must carry no optimizer ops
+    assert not any(op.type == 'sgd' for op in main.global_block().ops)
+
+    fleet.run_server()
+    fleet.init_worker()
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 1).astype('float32')
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for i in range(60):
+            xb = rng.randn(32, 8).astype('float32')
+            l, = exe.run(main, feed={'x': xb, 'y': xb @ w},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    fleet.stop_worker()
+    assert np.isfinite(losses).all()
+    # bounded-staleness training converges
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, (
+        losses[:5], losses[-5:])
+
+
+def test_local_fs_ops(tmp_path):
+    """LocalFS surface (reference framework/io/fs.h localfs ops +
+    hdfs.py split_files trainer sharding)."""
+    from paddle_tpu.fluid.incubate.fleet.utils import LocalFS
+    fs = LocalFS()
+    d = str(tmp_path / 'data')
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = d + '/part-0'
+    fs.touch(f)
+    assert fs.is_file(f) and fs.ls_dir(d) == ['part-0']
+    with open(f, 'w') as fh:
+        fh.write('hello')
+    assert fs.cat(f) == 'hello'
+    fs.rename(f, d + '/part-1')
+    assert fs.ls_dir(d) == ['part-1']
+    files = ['a', 'b', 'c', 'd', 'e']
+    assert fs.split_files(files, 0, 2) == ['a', 'c', 'e']
+    assert fs.split_files(files, 1, 2) == ['b', 'd']
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_without_hadoop_errors_clearly(monkeypatch):
+    from paddle_tpu.fluid.incubate.fleet.utils import HDFSClient, \
+        ExecuteError
+    monkeypatch.delenv('HADOOP_HOME', raising=False)
+    c = HDFSClient()
+    import pytest as _pytest
+    with _pytest.raises(ExecuteError, match='no hadoop client'):
+        c.ls('hdfs://x/y')
