@@ -267,6 +267,9 @@ class Executor(object):
         # dataflow analysis: inputs / outputs per segment
         feed_set = set(feed_names)
         fetch_set = set(fetch_names)
+        # extra outputs: vars consumed outside the program by host
+        # protocols (e.g. async-PS grad push), exempt from DCE
+        extra_outputs = set(getattr(program, '_extra_output_names', ()))
         # reads of later items, computed backwards
         later_reads = [set()] * len(items)
         acc = set()
@@ -291,11 +294,8 @@ class Executor(object):
                 v = block._find_var_recursive(n)
                 if v is not None and v.persistable:
                     persistable.add(n)
-            # extra outputs: vars consumed outside the program by host
-            # protocols (e.g. async-PS grad push), exempt from DCE
-            extra = set(getattr(program, '_extra_output_names', ()))
             outputs = written & (persistable | later_reads[i] |
-                                 fetch_set | extra)
+                                 fetch_set | extra_outputs)
             # state = inputs that are also written (in-place params etc.)
             state = sorted(reads_before_write & written)
             inputs = sorted(reads_before_write - set(state))

@@ -41,9 +41,9 @@ class ParameterServerFleet(Fleet):
     # -- server lifecycle (embedded: the "pserver" is a host-side store)
     def init_server(self, model_dir=None):
         if self._server is None:
+            lr = getattr(self._optimizer, '_server_lr', None)
             self._server = ParameterServerStore(
-                lr=self._optimizer._server_lr
-                if self._optimizer else 1.0)
+                lr=1.0 if lr is None else lr)
 
     def run_server(self):
         self.init_server()
@@ -61,6 +61,15 @@ class ParameterServerFleet(Fleet):
             self._communicator.flush()
             self._communicator.stop()
             self._communicator = None
+        # the flush just applied the final merged updates on the server;
+        # pull them into the trainer scope so save_persistables sees the
+        # freshest parameters
+        scope = getattr(self, '_last_scope', None)
+        if scope is not None and self._server is not None:
+            for pname in self._server.names():
+                if scope.find_var(pname) is not None:
+                    scope.set_var(pname, self._server.get(pname))
+        self._last_scope = None
         # end of training session: drop the embedded server so a later
         # session (possibly reusing param names) starts clean
         self._server = None
@@ -92,8 +101,23 @@ class ParameterServerOptimizer(DistributedOptimizer):
         super(ParameterServerOptimizer, self).__init__(optimizer,
                                                        strategy)
         self._fleet = fleet_ref
-        lr = getattr(optimizer, '_learning_rate', 1.0)
-        self._server_lr = float(lr if not callable(lr) else 1.0)
+        self._server_lr = None
+        if not getattr(strategy, 'sync_mode', True):
+            from ....optimizer import SGDOptimizer
+            if not isinstance(optimizer, SGDOptimizer):
+                raise ValueError(
+                    'async PS mode applies updates on the embedded '
+                    'server with the SGD rule (the DownpourSGD analog); '
+                    'got %s — use SGD, or sync_mode=True for arbitrary '
+                    'optimizers' % type(optimizer).__name__)
+            lr = getattr(optimizer, '_learning_rate', 1.0)
+            try:
+                self._server_lr = float(lr)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    'async PS mode needs a constant float learning '
+                    'rate (the embedded server applies it per merged '
+                    'update); got %r' % (lr,))
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -121,6 +145,7 @@ def ps_async_step(executor, scope, program):
     fleet_ref = program._ps_async['fleet']
     if fleet_ref._communicator is None:
         fleet_ref.init_worker()
+    fleet_ref._last_scope = scope  # final pull target for stop_worker
     comm = fleet_ref._communicator
     server = fleet_ref._server
     for pname, gname in program._ps_async['pairs']:

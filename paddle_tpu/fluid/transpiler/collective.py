@@ -96,10 +96,26 @@ class LocalSGD(Collective):
             current_endpoint, wait_port)
 
     def _transpile_main_program(self):
+        # average params AND optimizer accumulators: inside one
+        # shard_map step divergent per-device state cannot outlive the
+        # segment (replicated out-specs), so both must be re-synced.
+        # For linear-in-grad updates (SGD, Momentum) averaging state is
+        # exactly synchronous training; for others it is the
+        # synchronized-state LocalSGD variant.
         block = self.main_program.global_block()
-        params = [p.name for p in block.all_parameters()
-                  if getattr(p, 'trainable', True)]
-        for name in params:
+        names = [p.name for p in block.all_parameters()
+                 if getattr(p, 'trainable', True)]
+        seen = set(names)
+        for op in block.ops:
+            if op.attrs.get('__op_role__') != 'optimize':
+                continue
+            for n in op.output_arg_names:
+                v = block._find_var_recursive(n)
+                if v is not None and getattr(v, 'persistable', False) \
+                        and n not in seen and 'learning_rate' not in n:
+                    seen.add(n)
+                    names.append(n)
+        for name in names:
             block.append_op('c_allreduce_sum', inputs={'X': name},
                             outputs={'Out': name},
                             attrs={'ring_id': 0}, infer_shape=False)

@@ -25,3 +25,8 @@ from . import quant_ops  # noqa: F401
 from . import lang_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import vision_ops  # noqa: F401
+
+# host-sharded embedding (PS analog) host ops: registration lives with
+# the table implementation; import so distributed_lookup_table /
+# pull_box_sparse etc. resolve without requiring a manual import
+from ..parallel import sparse_embedding as _sparse_embedding  # noqa: F401,E402

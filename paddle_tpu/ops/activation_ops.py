@@ -130,6 +130,10 @@ def soft_shrink(ctx, ins, attrs):
                                         jnp.zeros_like(x)))]}
 
 
+# reference REGISTER_OPERATOR name (operators/activation_op.cc)
+register('softshrink')(soft_shrink)
+
+
 @register('softmax')
 def softmax(ctx, ins, attrs):
     return {'Out': [jax.nn.softmax(ins['X'][0],

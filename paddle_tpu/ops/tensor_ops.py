@@ -241,6 +241,11 @@ def unsqueeze2(ctx, ins, attrs):
     return {'Out': [x]}
 
 
+# v1 op name, same semantics minus the XShape output
+# (operators/unsqueeze_op.cc)
+register('unsqueeze')(unsqueeze2)
+
+
 @register('expand')
 def expand(ctx, ins, attrs):
     x = _x(ins)
@@ -631,3 +636,20 @@ def split_byref(ctx, ins, attrs):
     by-ref aliasing is meaningless under XLA's value semantics."""
     from .tensor_ops import split as _split
     return _split(ctx, ins, attrs)
+
+
+@register('while')
+def while_op(ctx, ins, attrs):
+    """Control-flow marker: lowered by the executor itself
+    (fluid/executor.py _lower_while -> lax.while_loop); the registry
+    entry exists for dispatch/coverage, never invoked directly."""
+    raise RuntimeError('while op is lowered by the executor, not the '
+                       'registry; a bare registry call is a bug')
+
+
+@register('conditional_block')
+def conditional_block_op(ctx, ins, attrs):
+    """Control-flow marker (executor _lower_conditional_block ->
+    lax.cond); see while_op."""
+    raise RuntimeError('conditional_block is lowered by the executor, '
+                       'not the registry; a bare registry call is a bug')

@@ -147,6 +147,38 @@ def test_fleet_local_sgd_single_process_parity():
     np.testing.assert_allclose(ref_p, lsgd_p, rtol=1e-4, atol=1e-5)
 
 
+def test_fleet_local_sgd_momentum_parity():
+    """Stateful optimizer under in-graph LocalSGD: velocity accumulators
+    are averaged alongside params (both are linear in the grad, so this
+    equals synchronous momentum = single-device full batch)."""
+    from paddle_tpu.fluid.incubate.fleet.collective import fleet, \
+        DistributedStrategy
+    from paddle_tpu.fluid.incubate.fleet.base import role_maker
+
+    batches = make_batches()
+    m1, s1, l1 = build_model(23)
+    ref, ref_p = train(_single, m1, s1, l1, batches,
+                       fluid.optimizer.Momentum(0.1, momentum=0.9))
+
+    m2, s2, l2 = build_model(23)
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    strategy = DistributedStrategy()
+    strategy.use_local_sgd = True
+    with fluid.program_guard(m2, s2):
+        opt = fleet.distributed_optimizer(
+            fluid.optimizer.Momentum(0.1, momentum=0.9), strategy)
+        opt.minimize(l2)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(s2)
+        for x, y in batches:
+            exe.run(m2, feed={'x': x, 'y': y}, fetch_list=[l2])
+        pname = m2.all_parameters()[0].name
+        lsgd_p = np.asarray(scope.find_var(pname))
+    np.testing.assert_allclose(ref_p, lsgd_p, rtol=1e-4, atol=1e-5)
+
+
 def test_collective_ops_semantics():
     """c_allreduce/c_allgather/c_broadcast inside shard_map match numpy."""
     import jax

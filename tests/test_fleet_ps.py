@@ -54,6 +54,17 @@ def test_async_ps_fleet_trains():
         losses[:5], losses[-5:])
 
 
+def test_async_ps_rejects_stateful_optimizer():
+    """The embedded server applies the SGD rule (DownpourSGD analog);
+    silently degrading Adam to SGD must be rejected."""
+    import pytest
+    config = DistributeTranspilerConfig()
+    config.sync_mode = False
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    with pytest.raises(ValueError, match='SGD rule'):
+        fleet.distributed_optimizer(fluid.optimizer.Adam(1e-3), config)
+
+
 def test_local_fs_ops(tmp_path):
     """LocalFS surface (reference framework/io/fs.h localfs ops +
     hdfs.py split_files trainer sharding)."""
