@@ -42,6 +42,11 @@ from .dygraph.base import enable_dygraph, disable_dygraph
 from . import data_feeder
 from .data_feeder import DataFeeder
 from . import reader
+from .reader import PyReader  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .framework import (cuda_pinned_places, load_op_library,  # noqa
+                        require_version)
+from .initializer import init_on_cpu  # noqa: F401
 from .reader import DataLoader
 from . import contrib
 from . import incubate

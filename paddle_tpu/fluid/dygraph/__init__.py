@@ -8,6 +8,13 @@ from . import nn
 from .nn import (Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
                  Dropout)
 from .checkpoint import save_dygraph, load_dygraph
+from .nn import (Conv2DTranspose, Conv3D, Conv3DTranspose,  # noqa: F401
+                 GRUUnit, NCE, PRelu, BilinearTensorProduct, GroupNorm,
+                 SpectralNorm, TreeConv)
+from . import learning_rate_scheduler  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    LearningRateDecay, NoamDecay, PiecewiseDecay, NaturalExpDecay,
+    ExponentialDecay, InverseTimeDecay, PolynomialDecay, CosineDecay)
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from . import jit
 from .jit import TracedLayer

@@ -1,0 +1,126 @@
+"""Dygraph LR schedulers. Reference:
+python/paddle/fluid/dygraph/learning_rate_scheduler.py — eager
+LearningRateDecay objects the optimizer queries per step (the static
+path computes the same schedules as graph arithmetic,
+layers/learning_rate_scheduler.py).
+"""
+
+import math
+
+__all__ = ['LearningRateDecay', 'NoamDecay', 'PiecewiseDecay',
+           'NaturalExpDecay', 'ExponentialDecay', 'InverseTimeDecay',
+           'PolynomialDecay', 'CosineDecay']
+
+
+class LearningRateDecay(object):
+    def __init__(self, begin=0, step=1, dtype='float32'):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def __call__(self):
+        lr = self.step()
+        self.step_num += self.step_size
+        return float(lr)
+
+    def step(self):
+        raise NotImplementedError
+
+
+class NoamDecay(LearningRateDecay):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)."""
+
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype='float32'):
+        super(NoamDecay, self).__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def step(self):
+        a = max(self.step_num, 1) ** -0.5
+        b = max(self.step_num, 1) * self.warmup_steps ** -1.5
+        return (self.d_model ** -0.5) * min(a, b)
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1,
+                 dtype='float32'):
+        super(PiecewiseDecay, self).__init__(begin, step, dtype)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def step(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.step_num < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype='float32'):
+        super(NaturalExpDecay, self).__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        p = self.step_num / float(self.decay_steps)
+        if self.staircase:
+            p = math.floor(p)
+        return self.learning_rate * math.exp(-self.decay_rate * p)
+
+
+class ExponentialDecay(NaturalExpDecay):
+    def step(self):
+        p = self.step_num / float(self.decay_steps)
+        if self.staircase:
+            p = math.floor(p)
+        return self.learning_rate * (self.decay_rate ** p)
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    def step(self):
+        p = self.step_num / float(self.decay_steps)
+        if self.staircase:
+            p = math.floor(p)
+        return self.learning_rate / (1.0 + self.decay_rate * p)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1,
+                 dtype='float32'):
+        super(PolynomialDecay, self).__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def step(self):
+        g = self.step_num
+        steps = self.decay_steps
+        if self.cycle:
+            mult = max(1.0, math.ceil(g / float(steps)))
+            steps = steps * mult
+        else:
+            g = min(g, steps)
+        frac = (1.0 - g / float(steps)) ** self.power
+        return ((self.learning_rate - self.end_learning_rate) * frac +
+                self.end_learning_rate)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype='float32'):
+        super(CosineDecay, self).__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def step(self):
+        epoch = self.step_num // self.step_each_epoch
+        return self.learning_rate * 0.5 * (
+            math.cos(epoch * math.pi / self.epochs) + 1)

@@ -190,3 +190,282 @@ class Dropout(Layer):
                       {'dropout_prob': self._p,
                        'is_test': not self.training,
                        'dropout_implementation': self._impl})['Out'][0]
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=1, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype='float32'):
+        super(Conv2DTranspose, self).__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size, filter_size]
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // (groups or 1)] + list(fs),
+            dtype, attr=param_attr)
+        self.bias = self.create_parameter([num_filters], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._attrs = {
+            'strides': stride if isinstance(stride, (list, tuple))
+            else [stride, stride],
+            'paddings': padding if isinstance(padding, (list, tuple))
+            else [padding, padding],
+            'dilations': dilation if isinstance(dilation, (list, tuple))
+            else [dilation, dilation],
+            'groups': groups or 1}
+        self._act = act
+
+    def forward(self, input):
+        out = _trace('conv2d_transpose',
+                     {'Input': [input], 'Filter': [self.weight]},
+                     self._attrs)['Output'][0]
+        if self.bias is not None:
+            out = _trace('elementwise_add',
+                         {'X': [out], 'Y': [self.bias]},
+                         {'axis': 1})['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class Conv3D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, use_cudnn=True,
+                 act=None, dtype='float32'):
+        super(Conv3D, self).__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // (groups or 1)] + list(fs),
+            dtype, attr=param_attr)
+        self.bias = self.create_parameter([num_filters], dtype,
+                                          is_bias=True, attr=bias_attr)
+
+        def _trip(v):
+            return v if isinstance(v, (list, tuple)) else [v] * 3
+        self._attrs = {'strides': _trip(stride),
+                       'paddings': _trip(padding),
+                       'dilations': _trip(dilation),
+                       'groups': groups or 1}
+        self._act = act
+
+    def forward(self, input):
+        out = _trace('conv3d',
+                     {'Input': [input], 'Filter': [self.weight]},
+                     self._attrs)['Output'][0]
+        if self.bias is not None:
+            out = _trace('elementwise_add',
+                         {'X': [out], 'Y': [self.bias]},
+                         {'axis': 1})['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=1, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype='float32'):
+        super(Conv3DTranspose, self).__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // (groups or 1)] + list(fs),
+            dtype, attr=param_attr)
+        self.bias = self.create_parameter([num_filters], dtype,
+                                          is_bias=True, attr=bias_attr)
+
+        def _trip(v):
+            return v if isinstance(v, (list, tuple)) else [v] * 3
+        self._attrs = {'strides': _trip(stride),
+                       'paddings': _trip(padding),
+                       'dilations': _trip(dilation),
+                       'groups': groups or 1}
+        self._act = act
+
+    def forward(self, input):
+        out = _trace('conv3d_transpose',
+                     {'Input': [input], 'Filter': [self.weight]},
+                     self._attrs)['Output'][0]
+        if self.bias is not None:
+            out = _trace('elementwise_add',
+                         {'X': [out], 'Y': [self.bias]},
+                         {'axis': 1})['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class GRUUnit(Layer):
+    """One GRU step (reference dygraph/nn.py GRUUnit over gru_unit)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation='tanh', gate_activation='sigmoid',
+                 origin_mode=False, dtype='float32'):
+        super(GRUUnit, self).__init__(dtype=dtype)
+        D = size // 3
+        self.weight = self.create_parameter([D, 3 * D], dtype,
+                                            attr=param_attr)
+        self.bias = self.create_parameter([1, 3 * D], dtype,
+                                          is_bias=True, attr=bias_attr)
+
+    def forward(self, input, hidden):
+        ins = {'Input': [input], 'HiddenPrev': [hidden],
+               'Weight': [self.weight]}
+        if self.bias is not None:
+            ins['Bias'] = [self.bias]
+        outs = _trace('gru_unit', ins)
+        return (outs['Hidden'][0], outs['ResetHiddenPrev'][0],
+                outs['Gate'][0])
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation loss layer (reference dygraph
+    NCE over operators/nce_op)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler='uniform', custom_dist=None, seed=0,
+                 is_sparse=False, dtype='float32'):
+        super(NCE, self).__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], dtype, attr=param_attr)
+        self.bias = self.create_parameter([num_total_classes, 1], dtype,
+                                          is_bias=True, attr=bias_attr)
+        if custom_dist is not None or sample_weight is not None:
+            raise ValueError('NCE: custom_dist/sample_weight are not '
+                             'supported (uniform sampler only)')
+        self._attrs = {'num_total_classes': num_total_classes,
+                       'num_neg_samples': num_neg_samples,
+                       'seed': seed, 'sampler': sampler}
+
+    def forward(self, input, label, sample_weight=None):
+        if sample_weight is not None:
+            raise ValueError('NCE: sample_weight is not supported')
+        ins = {'Input': [input], 'Label': [label],
+               'Weight': [self.weight]}
+        if self.bias is not None:
+            ins['Bias'] = [self.bias]
+        outs = _trace('nce', ins, self._attrs)
+        return outs['Cost'][0]
+
+
+class PRelu(Layer):
+    def __init__(self, mode='all', channel=None, input_shape=None,
+                 param_attr=None, dtype='float32'):
+        super(PRelu, self).__init__(dtype=dtype)
+        if mode == 'all':
+            shape = [1]
+        elif mode == 'channel':
+            shape = [channel or 1]
+        else:
+            shape = list(input_shape or [1])
+        from ..initializer import Constant
+        self.weight = self.create_parameter(
+            shape, dtype, attr=param_attr,
+            default_initializer=Constant(0.25))
+        self._mode = mode
+
+    def forward(self, input):
+        return _trace('prelu',
+                      {'X': [input], 'Alpha': [self.weight]},
+                      {'mode': self._mode})['Out'][0]
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim,
+                 name=None, act=None, param_attr=None, bias_attr=None,
+                 dtype='float32'):
+        super(BilinearTensorProduct, self).__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], dtype,
+            attr=param_attr)
+        self.bias = self.create_parameter([1, output_dim], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {'X': [x], 'Y': [y], 'Weight': [self.weight]}
+        if self.bias is not None:
+            ins['Bias'] = [self.bias]
+        out = _trace('bilinear_tensor_product', ins)['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, data_layout='NCHW',
+                 dtype='float32'):
+        super(GroupNorm, self).__init__(dtype=dtype)
+        from ..initializer import Constant
+        self.weight = self.create_parameter(
+            [channels], dtype, attr=param_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([channels], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._attrs = {'groups': groups, 'epsilon': epsilon,
+                       'data_layout': data_layout}
+        self._act = act
+
+    def forward(self, input):
+        outs = _trace('group_norm',
+                      {'X': [input], 'Scale': [self.weight],
+                       'Bias': [self.bias]}, self._attrs)
+        out = outs['Y'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype='float32'):
+        super(SpectralNorm, self).__init__(dtype=dtype)
+        import numpy as _np
+        h = weight_shape[dim]
+        w = int(_np.prod(weight_shape)) // h
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter(
+            [h], dtype, default_initializer=Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], dtype, default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+        self._attrs = {'dim': dim, 'power_iters': power_iters,
+                       'eps': eps}
+
+    def forward(self, weight):
+        return _trace('spectral_norm',
+                      {'Weight': [weight], 'U': [self.weight_u],
+                       'V': [self.weight_v]}, self._attrs)['Out'][0]
+
+
+class TreeConv(Layer):
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=8, act='tanh', param_attr=None,
+                 bias_attr=None, name=None, dtype='float32'):
+        super(TreeConv, self).__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], dtype,
+            attr=param_attr)
+        self.bias = self.create_parameter([num_filters], dtype,
+                                          is_bias=True, attr=bias_attr)
+        self._attrs = {'max_depth': max_depth}
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        out = _trace('tree_conv',
+                     {'NodesVector': [nodes_vector],
+                      'EdgeSet': [edge_set],
+                      'Filter': [self.weight]}, self._attrs)['Out'][0]
+        if self.bias is not None:
+            out = _trace('elementwise_add',
+                         {'X': [out], 'Y': [self.bias]},
+                         {'axis': -1})['Out'][0]
+        if self._act:
+            out = _trace(self._act, {'X': [out]})['Out'][0]
+        return out

@@ -564,6 +564,42 @@ def cpu_places(device_count=None):
     return [core.CPUPlace()]
 
 
+def cuda_pinned_places(device_count=None):
+    """Pinned host memory places (reference framework.py
+    cuda_pinned_places): host staging is jax's job; returns CPU places."""
+    return cpu_places(device_count)
+
+
+def load_op_library(lib_filename):
+    """Reference framework.py load_op_library loads custom C++ op .so
+    files; custom ops here are registered through ops.registry.register
+    (python) — nothing to dlopen."""
+    import warnings
+    warnings.warn('load_op_library is a no-op: register custom ops via '
+                  'paddle_tpu.ops.registry.register')
+
+
+def require_version(min_version, max_version=None):
+    """Reference framework.py require_version."""
+    from .. import __version__ as ver
+
+    def _tup(v):
+        import re as _re
+        parts = []
+        for x in str(v).split('.')[:3]:
+            m = _re.match(r'\d+', x)
+            parts.append(int(m.group()) if m else 0)
+        while len(parts) < 3:
+            parts.append(0)
+        return tuple(parts)
+    if _tup(ver) < _tup(min_version):
+        raise Exception('installed version %s < required %s'
+                        % (ver, min_version))
+    if max_version is not None and _tup(ver) > _tup(max_version):
+        raise Exception('installed version %s > allowed %s'
+                        % (ver, max_version))
+
+
 def xla_places(device_ids=None):
     # XLAPlace indexes PROCESS-LOCAL devices (reference CUDAPlace(i) is
     # trainer-local GPU i), so enumerate local devices only

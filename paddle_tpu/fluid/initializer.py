@@ -147,3 +147,14 @@ Bilinear = BilinearInitializer
 
 def force_init_on_cpu():
     return False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """Reference initializer.py init_on_cpu: force init ops onto CPU.
+    Host-side init is already where initializers run before device
+    upload, so this is a transparent guard."""
+    yield

@@ -13,13 +13,20 @@ from . import rnn
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
-from .io import data  # noqa: F401
-from .control_flow import While, increment, Switch  # noqa: F401
+from .io import (data, py_reader, create_py_reader_by_data,  # noqa
+                 double_buffer, read_file, load)
+from .control_flow import (  # noqa: F401
+    While, increment, Switch, StaticRNN, ConditionalBlock,
+    create_array, array_write, array_read, array_length,
+    while_loop, cond, case, switch_case, is_empty, Print,
+    reorder_lod_tensor_by_rank)
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
 from .rnn import (  # noqa: F401
-    dynamic_lstm, dynamic_gru, lstm_unit, beam_search, gather_tree)
+    dynamic_lstm, dynamic_gru, lstm_unit, beam_search, gather_tree,
+    gru_unit, lstm, dynamic_lstmp, RNNCell, GRUCell, LSTMCell,
+    Decoder, BeamSearchDecoder, dynamic_decode, beam_search_decode)
 from .sequence_lod import (  # noqa: F401
     sequence_pool, sequence_softmax, sequence_expand, sequence_reshape,
     sequence_first_step, sequence_last_step, sequence_conv,
@@ -28,3 +35,13 @@ from .sequence_lod import (  # noqa: F401
     sequence_expand_as, sequence_scatter, lod_reset)
 from . import extras
 from .extras import *  # noqa: F401,F403
+from . import more_layers
+from .more_layers import *  # noqa: F401,F403
+from .more_layers import sum, shape, size, rank, hash  # noqa: F401,A001
+from . import detection
+from .detection import *  # noqa: F401,F403
+from .sequence_lod import sequence_mask  # noqa: F401
+from . import distributions  # noqa: F401
+from .distributions import (Uniform, Normal, Categorical,  # noqa: F401
+                            MultivariateNormalDiag)
+from .control_flow import IfElse, DynamicRNN  # noqa: F401

@@ -100,27 +100,301 @@ def increment(x, value=1.0, in_place=True):
     return out
 
 
+#: default capacity for LoDTensorArray's fixed-length dense rendering
+ARRAY_CAPACITY = 64
+
+
+def create_array(dtype, initialized_list=None):
+    """LoDTensorArray analog.  Reference: layers/control_flow.py
+    create_array over the growable C++ LoDTensorArray; on XLA arrays are
+    FIXED-CAPACITY stacked tensors ([capacity, ...element]) materialized
+    lazily at the first array_write (which knows the element shape)."""
+    helper = LayerHelper('create_array')
+    arr = helper.create_variable_for_type_inference(dtype)
+    arr._tensor_array = {'materialized': False, 'dtype': dtype}
+    if initialized_list:
+        for i, v in enumerate(initialized_list):
+            from . import tensor as _t
+            array_write(v, _t.fill_constant([1], 'int64', i), arr)
+    return arr
+
+
+def _array_len_var(array, helper):
+    name = array.name + '@ARRLEN'
+    block = helper.main_program.current_block()
+    v = block._find_var_recursive(name)
+    if v is None:
+        v = block.create_var(name=name, shape=(1,), dtype='int64')
+        helper.append_op('fill_constant', outputs={'Out': v},
+                         attrs={'shape': [1], 'dtype': 'int64',
+                                'value': 0.0})
+    return v
+
+
 def array_write(x, i, array=None):
-    raise NotImplementedError(
-        'LoDTensorArray: dynamic-length arrays are replaced by '
-        'fixed-length stacked tensors on XLA; use lax.scan-style '
-        'layers.scan instead')
+    """Write x at index i (dense rendering: dynamic_update_slice into a
+    [capacity, ...] stacked tensor; reference
+    operators/controlflow/tensor_array ops)."""
+    helper = LayerHelper('array_write')
+    if array is None:
+        array = create_array(x.dtype)
+    meta = getattr(array, '_tensor_array', None)
+    if meta is not None and not meta['materialized']:
+        from . import tensor as _t
+        shape = [ARRAY_CAPACITY] + list(x.shape)
+        helper.append_op('fill_constant', outputs={'Out': array},
+                         attrs={'shape': shape, 'dtype': x.dtype,
+                                'value': 0.0})
+        array.shape = tuple(shape)
+        array.dtype = x.dtype
+        meta['materialized'] = True
+    helper.append_op('write_to_array',
+                     inputs={'X': x, 'I': i, 'Array': array},
+                     outputs={'Out': array}, infer_shape=False)
+    if meta is not None:
+        # static length only when the index is a constant written in
+        # the array's own block; loop-body / dynamic-index writes fall
+        # back to full capacity at conversion time
+        idx_op = getattr(i, 'op', None)
+        cur_block = helper.main_program.current_block()
+        if idx_op is not None and idx_op.type == 'fill_constant' \
+                and cur_block is array.block:
+            meta['static_len'] = max(
+                meta.get('static_len', 0),
+                int(idx_op.attrs.get('value', 0)) + 1)
+        else:
+            meta['dynamic'] = True
+    # track length = max(len, i+1)
+    lv = _array_len_var(array, helper)
+    from . import tensor as _t
+    one = _t.fill_constant([1], 'int64', 1)
+    from . import nn as _nn
+    ip1 = _nn.elementwise_add(i, one)
+    helper.append_op('elementwise_max', inputs={'X': lv, 'Y': ip1},
+                     outputs={'Out': lv}, attrs={'axis': -1},
+                     infer_shape=False)
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        'LoDTensorArray: use fixed-length stacked tensors on XLA')
+    helper = LayerHelper('array_read')
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op('read_from_array', inputs={'X': array, 'I': i},
+                     outputs={'Out': out}, infer_shape=False)
+    if len(getattr(array, 'shape', ())) > 1:
+        out.shape = tuple(array.shape[1:])
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper('array_length')
+    return _array_len_var(array, helper)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while (reference layers/control_flow.py while_loop):
+    builds a While block; body outputs are assigned back onto the loop
+    vars so the executor's lax.while_loop carry picks them up."""
+    from . import tensor as _t
+    if not isinstance(loop_vars, (list, tuple)):
+        loop_vars = [loop_vars]
+    loop_vars = list(loop_vars)
+    pre = cond(*loop_vars)
+    w = While(pre, is_test=is_test, name=name)
+    with w.block():
+        new_vars = body(*loop_vars)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        for old, new in zip(loop_vars, new_vars):
+            if new is not old:
+                _t.assign(new, old)
+        _t.assign(cond(*loop_vars), pre)
+    return loop_vars
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Two-branch conditional (reference layers/control_flow.py cond).
+
+    Dense rendering: the false branch computes unconditionally to give
+    the outputs their shapes/defaults, then a conditional_block
+    overwrites them when pred holds (the executor lowers it to
+    lax.cond).  Both branches must be effect-free, as with lax.cond.
+    """
+    from . import tensor as _t
+    false_out = false_fn() if false_fn is not None else None
+    if false_out is None:
+        # side-effect-only conditional: run true_fn in the gated block
+        cb = ConditionalBlock(pred)
+        with cb.block():
+            res = true_fn() if true_fn is not None else None
+            if res is not None:
+                raise ValueError(
+                    'cond: true_fn returned outputs but false_fn '
+                    'returned none — both branches must match')
+        return None
+    helper = LayerHelper('cond', name=name)
+    single = not isinstance(false_out, (list, tuple))
+    outs = [false_out] if single else list(false_out)
+    # copy so the conditional assign does not clobber the false values
+    outs = [_t.assign(o) for o in outs]
+    cb = ConditionalBlock(pred)
+    with cb.block():
+        true_out = true_fn() if true_fn is not None else None
+        true_list = [true_out] if not isinstance(
+            true_out, (list, tuple)) else list(true_out)
+        for o, t in zip(outs, true_list):
+            _t.assign(t, o)
+    return outs[0] if single else outs
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference layers/control_flow.py case: first matching branch
+    wins; rendered as a chain of cond()s evaluated innermost-last."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    out = default()
+    for pred, fn in reversed(pairs):
+        out = cond(pred, fn, lambda o=out: o)
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference layers/control_flow.py switch_case."""
+    from . import tensor as _t
+    from . import nn as _nn
+    pairs = []
+    if isinstance(branch_fns, dict):
+        items = branch_fns.items()
+    else:
+        items = enumerate(branch_fns)
+    from . import ops as _ops
+    for idx, fn in items:
+        i = _t.fill_constant([1], branch_index.dtype, int(idx))
+        pairs.append((_ops.equal(branch_index, i), fn))
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    out = default()
+    for pred, fn in reversed(pairs):
+        out = cond(pred, fn, lambda o=out: o)
+    return out
+
+
+def is_empty(x, name=None):
+    helper = LayerHelper('is_empty', name=name)
+    out = helper.create_variable_for_type_inference('bool')
+    helper.append_op('is_empty', inputs={'X': x}, outputs={'Out': out},
+                     infer_shape=False)
+    out.shape = ()
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both'):
+    """Reference operators/print_op.cc — host-side debug print."""
+    helper = LayerHelper('print')
+    helper.append_op('print', inputs={'In': input},
+                     outputs={'Out': input},
+                     attrs={'first_n': first_n,
+                            'message': message or '',
+                            'summarize': summarize},
+                     infer_shape=False)
+    return input
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    helper = LayerHelper('reorder_lod_tensor_by_rank')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op('reorder_lod_tensor_by_rank',
+                     inputs={'X': x, 'RankTable': rank_table},
+                     outputs={'Out': out}, infer_shape=False)
+    out.shape = x.shape
+    return out
+
+
+class ConditionalBlock(object):
+    """Builder for a conditional_block op (reference
+    operators/controlflow/conditional_block_op.cc)."""
+
+    def __init__(self, pred, is_scalar_condition=True, name=None):
+        self.helper = LayerHelper('conditional_block', name=name)
+        self.pred = pred
+
+    def block(self):
+        return _CondBlockGuard(self)
+
+
+class _CondBlockGuard(object):
+    def __init__(self, cb):
+        self.cb = cb
+        self.program = cb.helper.main_program
+
+    def __enter__(self):
+        self.sub_block = self.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.program._rollback()
+        self.cb.helper.append_op(
+            'conditional_block',
+            inputs={'Cond': self.cb.pred},
+            outputs={},
+            attrs={'sub_block': self.sub_block.idx,
+                   'is_scalar_condition': True},
+            infer_shape=False)
+        return True
 
 
 class Switch(object):
-    """Reference: layers/control_flow.py Switch — used mainly by LR
-    schedules; here schedules are arithmetic (learning_rate_scheduler.py)
-    so Switch is provided for API parity on simple cases."""
+    """Reference: layers/control_flow.py Switch — piecewise branch
+    builder (used by LR schedules).  Each case body runs in a
+    conditional_block gated on its predicate AND no earlier case
+    having matched."""
 
     def __init__(self, name=None):
-        raise NotImplementedError(
-            'Switch: express piecewise logic with layers.where / masks '
-            '(see layers/learning_rate_scheduler.py piecewise_decay)')
+        self.helper = LayerHelper('switch', name=name)
+        self._matched = None  # bool var: some earlier case fired
+        self._in_default = False
+
+    class _CaseGuard(object):
+        def __init__(self, sw, condition):
+            from . import ops as _nn
+            from . import tensor as _t
+            self.sw = sw
+            if condition is None:  # default: no earlier match
+                if sw._matched is None:  # no cases at all: always run
+                    pred = _t.assign(__import__('numpy').array(
+                        [True]))
+                else:
+                    pred = _nn.logical_not(sw._matched)
+            elif sw._matched is None:
+                pred = condition
+                sw._matched = _t.assign(condition)
+            else:
+                pred = _nn.logical_and(
+                    condition, _nn.logical_not(sw._matched))
+                _t.assign(_nn.logical_or(sw._matched, condition),
+                          sw._matched)
+            self.cb = ConditionalBlock(pred)
+            self.guard = self.cb.block()
+
+        def __enter__(self):
+            return self.guard.__enter__()
+
+        def __exit__(self, *a):
+            return self.guard.__exit__(*a)
+
+    def case(self, condition):
+        return Switch._CaseGuard(self, condition)
+
+    def default(self):
+        return Switch._CaseGuard(self, None)
 
 
 class StaticRNN(object):
@@ -287,3 +561,90 @@ class StaticRNN(object):
         if len(results) == 1:
             return results[0]
         return results
+
+
+class IfElse(object):
+    """Per-example two-branch select (reference layers/control_flow.py
+    IfElse splits rows by a [B,1] bool cond, runs each branch on its
+    rows, and merges).  Dense rendering: both branches compute on the
+    FULL batch and rows merge by where(cond) — identical results for
+    pure branches, and XLA-friendly (no dynamic row counts)."""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper('ifelse', name=name)
+        self.cond = cond
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self._true_outs = []
+        self._false_outs = []
+
+    class _Guard(object):
+        def __init__(self, ie, is_true):
+            self.ie = ie
+            self.is_true = is_true
+
+        def __enter__(self):
+            self.ie.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                              if self.is_true else
+                              IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+            return self
+
+        def __exit__(self, exc_type, *a):
+            self.ie.status = IfElse.OUT_IF_ELSE_BLOCKS
+            return exc_type is None
+
+    def true_block(self):
+        return IfElse._Guard(self, True)
+
+    def false_block(self):
+        return IfElse._Guard(self, False)
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError('IfElse.input() must be inside a block')
+        return x  # dense rendering: both branches see the full batch
+
+    def output(self, *outs):
+        if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS:
+            self._true_outs.extend(outs)
+        elif self.status == IfElse.IN_IF_ELSE_FALSE_BLOCKS:
+            self._false_outs.extend(outs)
+        else:
+            raise ValueError('IfElse.output() must be inside a block')
+
+    def __call__(self):
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError(
+                'IfElse: true/false blocks produced %d vs %d outputs'
+                % (len(self._true_outs), len(self._false_outs)))
+        from . import tensor as _t
+        from . import nn as _nn
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            c = _t.cast(self.cond, t.dtype)
+            one = _t.fill_constant([1], t.dtype, 1.0)
+            inv = _nn.elementwise_sub(one, c)
+            merged.append(_nn.elementwise_add(
+                _nn.elementwise_mul(t, c),
+                _nn.elementwise_mul(f, inv)))
+        return merged
+
+
+class DynamicRNN(StaticRNN):
+    """Reference layers/control_flow.py DynamicRNN over LoD sequences
+    (operators/recurrent_op sorted-by-length batches).
+
+    Dense rendering: sequences arrive padded [B, T, ...] and the step
+    block unrolls exactly like StaticRNN; positions past each row's
+    length carry padding that downstream sequence ops mask out (the
+    framework-wide padded+mask convention, ops/sequence_ops.py)."""
+
+    def block(self):
+        return self.step()
+
+    def static_input(self, x):
+        # non-sequence input visible at every step
+        return x

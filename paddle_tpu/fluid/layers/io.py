@@ -13,3 +13,52 @@ def data(name, shape, dtype='float32', lod_level=0, type=None,
     return helper.create_global_variable(
         name=name, shape=tuple(shape), dtype=dtype, lod_level=lod_level,
         stop_gradient=stop_gradient, is_data=True, persistable=False)
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Reference layers/io.py py_reader -> the GeneratorLoader path
+    (reader.py): returns a reader object with decorate_* methods; the
+    native feeder replaces the C++ LoDTensorBlockingQueue."""
+    from ..reader import PyReader as _PyReader
+    from . import data as _data
+    feed_list = []
+    for i, (sh, dt) in enumerate(zip(shapes, dtypes)):
+        # reference shapes always include the (possibly concrete)
+        # batch dim; data() re-prepends -1
+        shape = list(sh[1:])
+        feed_list.append(_data('_py_reader_%d_%s' % (i, name or ''),
+                               shape=shape, dtype=dt))
+    return _PyReader(feed_list=feed_list, capacity=capacity,
+                     use_double_buffer=use_double_buffer,
+                     iterable=False)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..reader import PyReader as _PyReader
+    return _PyReader(feed_list=feed_list, capacity=capacity,
+                     use_double_buffer=use_double_buffer,
+                     iterable=False)
+
+
+def double_buffer(reader, place=None, name=None):
+    """XLA dispatch is already async (compute overlaps host feeding);
+    the explicit double_buffer decorator is an identity here."""
+    return reader
+
+
+def read_file(reader):
+    """Reference layers/io.py read_file: pop one batch's vars from the
+    reader — here the feed vars themselves (the executor feeds them)."""
+    return reader.feed_vars if hasattr(reader, 'feed_vars') else reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Reference layers/io.py load -> load op."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('load')
+    helper.append_op('load', inputs={},
+                     outputs={'Out': out},
+                     attrs={'file_path': file_path}, infer_shape=False)
+    return out

@@ -212,3 +212,51 @@ def isfinite(x):
                                                     stop_gradient=True)
     helper.append_op('isfinite', inputs={'X': [x]}, outputs={'Out': out})
     return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference layers/tensor.py create_parameter."""
+    from ..layer_helper import LayerHelper
+    from ..param_attr import ParamAttr
+    helper = LayerHelper('create_parameter')
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, list(shape), dtype, is_bias,
+                                   default_initializer)
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype='float32'):
+    """Reference layers/tensor.py eye."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('eye')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op('eye', outputs={'Out': out},
+                     attrs={'num_rows': num_rows,
+                            'num_columns': num_columns or -1,
+                            'dtype': dtype}, infer_shape=False)
+    n = num_columns or num_rows
+    out.shape = (num_rows, n)
+    if batch_shape:
+        from . import nn as _nn
+        for _ in batch_shape:
+            out = _nn.unsqueeze(out, axes=[0])
+        out = _nn.expand(out, expand_times=list(batch_shape) + [1, 1])
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Reference layers/tensor.py tensor_array_to_tensor over
+    operators/tensor_array_to_tensor_op.cc (dense array rendering)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('tensor_array_to_tensor', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference('int32')
+    meta = getattr(input, '_tensor_array', None)
+    length = 0 if (meta is None or meta.get('dynamic')) else \
+        meta.get('static_len', 0)
+    helper.append_op('tensor_array_to_tensor', inputs={'X': input},
+                     outputs={'Out': out, 'OutIndex': idx},
+                     attrs={'axis': axis, 'use_stack': use_stack,
+                            'length': length},
+                     infer_shape=False)
+    return out, idx

@@ -698,6 +698,113 @@ class ExponentialMovingAverage(object):
 
 
 # Short aliases matching fluid.optimizer namespace
+
+class DecayedAdagradOptimizer(Optimizer):
+    """Reference optimizer.py DecayedAdagradOptimizer over
+    operators/optimizers/decayed_adagrad_op.cc."""
+    type = 'decayed_adagrad'
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 **kwargs):
+        super(DecayedAdagradOptimizer, self).__init__(learning_rate,
+                                                      **kwargs)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('moment', p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator('moment', p)
+        return block.append_op(
+            'decayed_adagrad',
+            inputs={'Param': p, 'Grad': g, 'Moment': moment,
+                    'LearningRate': self._create_param_lr(param_and_grad)},
+            outputs={'ParamOut': p, 'MomentOut': moment},
+            attrs={'decay': self._decay, 'epsilon': self._epsilon},
+            infer_shape=False)
+
+
+class LookaheadOptimizer(object):
+    """Reference optimizer.py LookaheadOptimizer: fast weights step
+    every iteration; every k steps slow <- slow + alpha*(fast-slow),
+    fast <- slow.  In-graph rendering: a step counter + where() select
+    (the reference uses a Switch block)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert isinstance(k, int) and k > 0
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        from . import layers
+        from .framework import default_main_program, \
+            default_startup_program
+        mini_out = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program)
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        block = main.global_block()
+        params = [p.name for p in block.all_parameters()]
+
+        with main._role_guard('optimize'):
+            k = layers.fill_constant([1], 'int32', self.k)
+            one = layers.fill_constant([1], 'int32', 1)
+            zero = layers.fill_constant([1], 'int32', 0)
+            step = layers.autoincreased_step_counter(begin=1)
+            step_i = layers.cast(step, 'int32')
+            mod = layers.elementwise_mod(step_i, k)
+            do_sync = layers.cast(layers.equal(mod, zero), 'float32')
+            for name in params:
+                fast = block.var(name)
+                slow_name = name + '@SLOW'
+                slow = block.create_var(name=slow_name,
+                                        shape=fast.shape,
+                                        dtype=fast.dtype,
+                                        persistable=True)
+                sb = startup.global_block()
+                sb.create_var(name=slow_name, shape=fast.shape,
+                              dtype=fast.dtype, persistable=True)
+                sb.append_op('assign', inputs={'X': name},
+                             outputs={'Out': slow_name},
+                             infer_shape=False)
+                # slow_new = slow + alpha*(fast-slow) when sync else slow
+                diff = layers.elementwise_sub(fast, slow)
+                cand = layers.elementwise_add(
+                    slow, layers.scale(diff, scale=self.alpha))
+                gate = do_sync  # [1] broadcasting over param dims
+                inv = layers.elementwise_sub(
+                    layers.fill_constant([1], 'float32', 1.0), gate)
+                new_slow = layers.elementwise_add(
+                    layers.elementwise_mul(cand, gate, axis=0
+                                           if len(fast.shape) == 1
+                                           else -1),
+                    layers.elementwise_mul(slow, inv, axis=0
+                                           if len(fast.shape) == 1
+                                           else -1))
+                block.append_op('assign', inputs={'X': new_slow},
+                                outputs={'Out': slow_name},
+                                infer_shape=False)
+                new_fast = layers.elementwise_add(
+                    layers.elementwise_mul(new_slow, gate,
+                                           axis=0 if len(fast.shape) == 1
+                                           else -1),
+                    layers.elementwise_mul(fast, inv,
+                                           axis=0 if len(fast.shape) == 1
+                                           else -1))
+                block.append_op('assign', inputs={'X': new_fast},
+                                outputs={'Out': name},
+                                infer_shape=False)
+        return mini_out
+
+
+DecayedAdagrad = DecayedAdagradOptimizer
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adam = AdamOptimizer

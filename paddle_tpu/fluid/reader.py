@@ -83,4 +83,48 @@ class GeneratorLoader(object):
         self._iter = iter(self._generator())
 
 
-PyReader = GeneratorLoader
+
+
+class PyReader(GeneratorLoader):
+    """Reference: python/paddle/fluid/reader.py:588 PyReader — the
+    legacy decorate_* reader surface over the GeneratorLoader path
+    (the C++ LoDTensorBlockingQueue is replaced by the native feeder)."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super(PyReader, self).__init__(feed_list, capacity, iterable)
+        self._return_list = return_list
+        self._started = False
+
+    # decorate_* aliases (reference PyReader API)
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)
+
+    @property
+    def feed_vars(self):
+        return self._feed_list
+
+    def start(self):
+        self._started = True
+        self._iter = iter(self._generator())
+
+    def reset(self):
+        self._started = False
+        self._iter = None
+
+    def next(self):
+        if not self._started:
+            raise RuntimeError('call PyReader.start() first')
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self.reset()
+            raise

