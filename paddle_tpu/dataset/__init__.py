@@ -16,3 +16,9 @@ from . import movielens
 from . import flowers
 from . import wmt16
 from . import conll05
+from . import sentiment
+from . import voc2012
+from . import wmt14
+from . import mq2007
+from . import common
+from . import image

@@ -15,7 +15,28 @@ collective schedule.
 
 
 class BuildStrategy(object):
-    """Reference: framework/details/build_strategy.h:37."""
+    """Reference: framework/details/build_strategy.h:37.
+
+    Knob -> TPU/XLA disposition:
+
+    - reduce_strategy AllReduce: the default GSPMD rendering (params
+      replicated, gradient all-reduce over ICI).
+    - reduce_strategy Reduce (each device owns a param shard +
+      broadcast): the ZeRO-style sharded-optimizer-state rendering —
+      with_data_parallel enables with_sharded_optimizer_states().
+    - gradient_scale CoeffNumDevice: built in (the loss is a global
+      mean, so grads already carry the 1/global-batch coefficient).
+      One/Customized would rescale a quantity XLA derives from the
+      loss itself and are rejected explicitly.
+    - fuse_all_reduce_ops / fuse_all_optimizer_ops /
+      fuse_elewise_add_act_ops: XLA fusion + collective combining do
+      this unconditionally; the flags are accepted and ignored.
+    - memory_optimize / enable_inplace: XLA buffer liveness + donated
+      optimizer buffers (executor donate_argnums) do this
+      unconditionally.
+    - num_trainers / trainer_id: superseded by jax.distributed process
+      topology (launch CLI sets it up).
+    """
 
     class ReduceStrategy(object):
         AllReduce = 0
@@ -65,6 +86,18 @@ class CompiledProgram(object):
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
+        bs = self._build_strategy
+        if bs.reduce_strategy == BuildStrategy.ReduceStrategy.Reduce:
+            # kReduce (param shards owned per device) -> ZeRO-style
+            # optimizer-state sharding over dp
+            self.with_sharded_optimizer_states()
+        if bs.gradient_scale_strategy != \
+                BuildStrategy.GradientScaleStrategy.CoeffNumDevice:
+            raise ValueError(
+                'gradient_scale_strategy: only CoeffNumDevice is '
+                'meaningful here — the loss is a global mean, so '
+                'gradients already carry the 1/global-batch '
+                'coefficient (see BuildStrategy docstring)')
         self._share_vars_from = share_vars_from
         self._places = places
         return self

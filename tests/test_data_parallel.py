@@ -236,3 +236,30 @@ def test_zero_sharded_optimizer_states_parity():
                        fluid.optimizer.Adam(0.01))
     np.testing.assert_allclose(ref, par, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(ref_p, par_p, rtol=1e-4, atol=1e-5)
+
+
+def test_reduce_strategy_maps_to_zero_sharding():
+    """BuildStrategy ReduceStrategy.Reduce -> ZeRO-style sharded
+    optimizer states (the kReduce param-ownership analog), with full
+    loss parity."""
+    batches = make_batches()
+    m1, s1, l1 = build_model(31)
+    ref, ref_p = train(_single, m1, s1, l1, batches,
+                       fluid.optimizer.Momentum(0.1, momentum=0.9))
+
+    m2, s2, l2 = build_model(31)
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    box = {}
+
+    def _parallel(exe, main, feed, fetch):
+        if 'cp' not in box:
+            box['cp'] = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=l2.name, build_strategy=bs)
+            assert box['cp']._shard_opt_states_axis is not None
+        return exe.run(box['cp'], feed=feed, fetch_list=fetch)
+
+    par, par_p = train(_parallel, m2, s2, l2, batches,
+                       fluid.optimizer.Momentum(0.1, momentum=0.9))
+    np.testing.assert_allclose(ref, par, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ref_p, par_p, rtol=1e-4, atol=1e-5)
