@@ -76,8 +76,126 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
     return batch * steps / dt
 
 
+def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    l, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    np.asarray(l)
+    t0 = time.time()
+    for _ in range(steps - 1):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    last, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    np.asarray(last)
+    return (time.time() - t0) / steps
+
+
+def bench_bert(batch=32, seq_len=128, steps=20):
+    """BASELINE.json config 2: BERT-base pretrain step time."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, enc, loss = models.bert.build_pretrain(
+            models.bert.BASE, seq_len)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(1e-4),
+            use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    batch_data = models.bert.synthetic_batch(models.bert.BASE, batch,
+                                             seq_len, rng)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        dt = _timed_steps(exe, main, batch_data, loss, steps)
+    return {'metric': 'bert_base_pretrain_step_ms_b%d_s%d'
+            % (batch, seq_len),
+            'value': round(dt * 1000, 2), 'unit': 'ms/step',
+            'seq_per_sec': round(batch / dt, 1)}
+
+
+def bench_wide_deep(batch=2048, steps=30):
+    """BASELINE.json config 3: Wide&Deep CTR throughput."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, preds, loss = models.wide_deep.build(
+            models.wide_deep.BASE, is_sparse=False)
+        fluid.optimizer.Adagrad(0.01).minimize(loss)
+    cfg = models.wide_deep.BASE
+    rng = np.random.RandomState(0)
+    feed = models.wide_deep.synthetic_batch(cfg, batch, rng)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        dt = _timed_steps(exe, main, feed, loss, steps)
+    return {'metric': 'wide_deep_ctr_examples_per_sec_b%d' % batch,
+            'value': round(batch / dt, 1), 'unit': 'examples/sec'}
+
+
+def bench_transformer(batch=32, src_len=64, tgt_len=64, steps=20):
+    """BASELINE.json config 4: Transformer NMT step time."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, logits, loss = models.transformer.build(
+            models.transformer.BASE, src_len, tgt_len)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(1e-4),
+            use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    cfg = models.transformer.BASE
+    rng = np.random.RandomState(0)
+    feed = models.transformer.synthetic_batch(cfg, batch, src_len,
+                                              tgt_len, rng)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        dt = _timed_steps(exe, main, feed, loss, steps)
+    return {'metric': 'transformer_nmt_tokens_per_sec_b%d' % batch,
+            'value': round(batch * tgt_len / dt, 1),
+            'unit': 'tokens/sec',
+            'step_ms': round(dt * 1000, 2)}
+
+
+def bench_lenet(batch=512, steps=30):
+    """BASELINE.json config 0: MNIST LeNet throughput."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(batch, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (batch, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        dt = _timed_steps(exe, main, feed, loss, steps)
+    return {'metric': 'lenet_mnist_images_per_sec_b%d' % batch,
+            'value': round(batch / dt, 1), 'unit': 'images/sec'}
+
+
 def main():
     _enable_compile_cache()
+    if len(sys.argv) > 1 and sys.argv[1] == '--all':
+        # secondary configs (BASELINE.json 0,2,3,4); the driver contract
+        # stays the default single-line ResNet metric
+        for fn in (bench_lenet, bench_bert, bench_wide_deep,
+                   bench_transformer):
+            try:
+                print(json.dumps(fn()))
+            except Exception as e:
+                sys.stderr.write('%s failed: %s\n'
+                                 % (fn.__name__, str(e)[:300]))
+        return
     layout = os.environ.get('PADDLE_TPU_BENCH_LAYOUT', 'NCHW')
     for batch in (128, 64, 32):
         try:

@@ -132,3 +132,51 @@ def firstn(reader, n):
     def impl():
         return itertools.islice(reader(), n)
     return impl
+
+
+def _mp_worker(reader, q):
+    """Module-level worker (picklable under spawn/forkserver)."""
+    try:
+        for sample in reader():
+            if sample is None:
+                raise ValueError(
+                    'multiprocess_reader: sample cannot be None')
+            q.put(('sample', sample))
+        q.put(('done', None))
+    except Exception as e:  # error sentinel, never hang the consumer
+        q.put(('error', '%s: %s' % (type(e).__name__, e)))
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Fan-in multiple readers through OS processes (reference
+    python/paddle/reader/decorator.py multiprocess_reader).  Both
+    use_pipe settings use a multiprocessing.Queue transport here
+    (identical semantics; the reference's pipe variant is a transport
+    detail)."""
+    import multiprocessing
+
+    def impl():
+        q = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(target=_mp_worker, args=(r, q))
+                 for r in readers]
+        for p in procs:
+            p.daemon = True
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                kind, payload = q.get()
+                if kind == 'sample':
+                    yield payload
+                elif kind == 'done':
+                    finished += 1
+                else:  # error
+                    raise RuntimeError(
+                        'multiprocess_reader worker failed: %s'
+                        % payload)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                p.join()
+    return impl

@@ -1,0 +1,30 @@
+"""Coverage audits stay green (the CI-gate analog of reference
+tools/check_op_desc.py + diff_api.py + check_api_approvals.sh)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(tool):
+    env = dict(os.environ)
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    p = subprocess.run([sys.executable, os.path.join(REPO, 'tools',
+                                                     tool)],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=300)
+    return p
+
+
+def test_op_coverage_complete():
+    p = _run('check_op_coverage.py')
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert 'coverage: complete' in p.stdout
+
+
+def test_api_coverage_complete():
+    p = _run('check_api_coverage.py')
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert '(100.0%)' in p.stdout

@@ -1,0 +1,52 @@
+"""Export a chrome://tracing file from a captured profile.
+
+Reference: tools/timeline.py converts the profiler's protobuf dump into
+chrome-trace JSON.  The jax profiler (fluid.profiler wraps it) already
+emits a gzipped chrome trace inside its plugin directory; this tool
+locates it and writes a plain .json chrome://tracing / Perfetto can
+open directly.
+
+Usage: python tools/timeline.py --profile_path /tmp/profile \
+           --timeline_path /tmp/timeline.json
+"""
+
+import argparse
+import glob
+import gzip
+import os
+import shutil
+import sys
+
+
+def find_trace(profile_path):
+    pats = [os.path.join(profile_path, '**', '*.trace.json.gz'),
+            os.path.join(profile_path, '**', '*.trace.json')]
+    hits = []
+    for p in pats:
+        hits.extend(glob.glob(p, recursive=True))
+    if not hits:
+        raise SystemExit(
+            'no trace found under %s — capture one with '
+            'fluid.profiler.profiler(profile_path=...)' % profile_path)
+    return max(hits, key=os.path.getmtime)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--profile_path', default='/tmp/profile')
+    ap.add_argument('--timeline_path', default='/tmp/timeline.json')
+    args = ap.parse_args()
+    src = find_trace(args.profile_path)
+    if src.endswith('.gz'):
+        with gzip.open(src, 'rb') as f_in, \
+                open(args.timeline_path, 'wb') as f_out:
+            shutil.copyfileobj(f_in, f_out)
+    else:
+        shutil.copy(src, args.timeline_path)
+    print('chrome trace written to %s (open in chrome://tracing or '
+          'https://ui.perfetto.dev)' % args.timeline_path)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
