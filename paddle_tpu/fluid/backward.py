@@ -241,6 +241,23 @@ class _RecomputePlan(object):
 def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
                  rename=None):
     rename = rename or {}
+    if op.type in ('while', 'conditional_block'):
+        # would the loop/branch need a gradient?  The op's declared
+        # outputs can be empty (conditional_block discovers its writes
+        # at lowering time), so inspect the sub-block's writes too.
+        out_names = set(op.output_arg_names)
+        sub_idx = op.attrs.get('sub_block')
+        if sub_idx is not None:
+            for sop in block.program.blocks[sub_idx].ops:
+                out_names.update(sop.output_arg_names)
+        needs = any(contribs.get(n) for n in out_names)
+        if needs:
+            raise NotImplementedError(
+                'gradients through %s sub-blocks are not implemented: '
+                'build differentiable recurrences with StaticRNN / '
+                'DynamicRNN (unrolled, fully differentiable) or keep '
+                'the loop outside the loss path' % op.type)
+        return False
     from ..ops import registry
     if op.type in registry.HOST_OPS:
         return False

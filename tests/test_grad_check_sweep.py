@@ -153,3 +153,112 @@ def test_layer_norm_grad():
                   'Bias': rng.randn(6).astype('float32')},
                  {'epsilon': 1e-5, 'begin_norm_axis': 1},
                  out_slot='Y')
+
+
+def test_conv2d_grad():
+    t = OpTest()
+    t.check_grad('conv2d',
+                 {'Input': rng.randn(1, 2, 5, 5).astype('float32'),
+                  'Filter': rng.randn(3, 2, 3, 3).astype('float32')},
+                 {'strides': [1, 1], 'paddings': [1, 1],
+                  'dilations': [1, 1], 'groups': 1},
+                 out_slot='Output')
+
+
+def test_depthwise_conv2d_grad():
+    t = OpTest()
+    t.check_grad('depthwise_conv2d',
+                 {'Input': rng.randn(1, 3, 5, 5).astype('float32'),
+                  'Filter': rng.randn(3, 1, 3, 3).astype('float32')},
+                 {'strides': [1, 1], 'paddings': [1, 1],
+                  'dilations': [1, 1], 'groups': 3},
+                 out_slot='Output')
+
+
+def test_pool2d_avg_grad():
+    t = OpTest()
+    t.check_grad('pool2d', {'X': rng.randn(1, 2, 6, 6).astype('float32')},
+                 {'pooling_type': 'avg', 'ksize': [2, 2],
+                  'strides': [2, 2], 'paddings': [0, 0]})
+
+
+def test_batch_norm_grad():
+    t = OpTest()
+    t.check_grad('batch_norm',
+                 {'X': rng.randn(4, 3, 2, 2).astype('float32') + 1.0,
+                  'Scale': (rng.rand(3) + 0.5).astype('float32'),
+                  'Bias': rng.randn(3).astype('float32'),
+                  'Mean': np.zeros(3, 'float32'),
+                  'Variance': np.ones(3, 'float32')},
+                 {'epsilon': 1e-5, 'is_test': False,
+                  'momentum': 0.9},
+                 out_slot='Y',
+                 grad_slots=['X', 'Scale', 'Bias'],
+                 stop_gradients=('Mean', 'Variance'))
+
+
+def test_softmax_with_cross_entropy_grad():
+    t = OpTest()
+    t.check_grad('softmax_with_cross_entropy',
+                 {'Logits': rng.randn(4, 5).astype('float32'),
+                  'Label': rng.randint(0, 5, (4, 1)).astype('int64')},
+                 {'soft_label': False},
+                 out_slot='Loss', grad_slots=['Logits'])
+
+
+def test_lookup_table_grad():
+    t = OpTest()
+    t.check_grad('lookup_table_v2',
+                 {'W': rng.randn(7, 4).astype('float32'),
+                  'Ids': rng.randint(0, 7, (3, 2)).astype('int64')},
+                 {}, grad_slots=['W'])
+
+
+def test_gather_grad():
+    t = OpTest()
+    t.check_grad('gather',
+                 {'X': rng.randn(6, 3).astype('float32'),
+                  'Index': np.array([0, 2, 5], 'int32')},
+                 {}, grad_slots=['X'])
+
+
+def test_while_grad_raises_clear_error():
+    """Gradients through while sub-blocks are a documented
+    non-capability (differentiable recurrence = StaticRNN/DynamicRNN
+    unrolling); the error must say so instead of failing obscurely."""
+    import pytest
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[1], dtype='float32')
+        x.stop_gradient = False
+        ten = fluid.layers.fill_constant([1], 'float32', 10.0)
+        out, = fluid.layers.while_loop(
+            lambda v: fluid.layers.less_than(v, ten),
+            lambda v: fluid.layers.elementwise_mul(
+                v, fluid.layers.fill_constant([1], 'float32', 2.0)),
+            [fluid.layers.elementwise_add(
+                x, fluid.layers.fill_constant([1], 'float32', 0.0))])
+        loss = fluid.layers.mean(out)
+        with pytest.raises(NotImplementedError, match='StaticRNN'):
+            fluid.backward.append_backward(loss)
+
+
+def test_cond_grad_raises_clear_error():
+    """cond() gradients must raise, not silently differentiate the
+    always-computed false branch (reviewer-found hazard)."""
+    import pytest
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[1], dtype='float32')
+        x.stop_gradient = False
+        zero = fluid.layers.fill_constant([1], 'float32', 0.0)
+        from paddle_tpu.fluid.layers import ops as _ops
+        pred = _ops.greater_than(fluid.layers.reduce_sum(x), zero)
+        y = fluid.layers.cond(pred,
+                              lambda: fluid.layers.scale(x, scale=2.0),
+                              lambda: fluid.layers.scale(x, scale=3.0))
+        loss = fluid.layers.mean(y)
+        with pytest.raises(NotImplementedError, match='StaticRNN'):
+            fluid.backward.append_backward(loss)
