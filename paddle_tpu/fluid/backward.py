@@ -246,10 +246,16 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
         # outputs can be empty (conditional_block discovers its writes
         # at lowering time), so inspect the sub-block's writes too.
         out_names = set(op.output_arg_names)
-        sub_idx = op.attrs.get('sub_block')
-        if sub_idx is not None:
+
+        def _collect(sub_idx, seen):
+            if sub_idx is None or sub_idx in seen:
+                return
+            seen.add(sub_idx)
             for sop in block.program.blocks[sub_idx].ops:
                 out_names.update(sop.output_arg_names)
+                _collect(sop.attrs.get('sub_block'), seen)
+
+        _collect(op.attrs.get('sub_block'), set())
         needs = any(contribs.get(n) for n in out_names)
         if needs:
             raise NotImplementedError(

@@ -109,7 +109,11 @@ NAME_TO_VARTYPE = {v: k for k, v in VARTYPE_TO_NAME.items()}
 
 
 def convert_dtype(dtype):
-    """Accept str ('float32'), numpy dtype, jnp dtype, or VarType int."""
+    """Accept str ('float32'), numpy dtype, jnp dtype, or VarType int.
+
+    int64/uint64/float64 map to their 32-bit widths when jax runs with
+    x64 disabled (the default): jax would truncate them anyway, this
+    just does it without emitting a warning per op."""
     if dtype is None:
         return np.dtype(np.float32)
     if isinstance(dtype, int):
@@ -117,11 +121,17 @@ def convert_dtype(dtype):
     if isinstance(dtype, str):
         if dtype == "bfloat16":
             return jnp.dtype(jnp.bfloat16)
-        return np.dtype(_DTYPE_MAP[dtype])
-    try:
-        return np.dtype(dtype)
-    except TypeError:
-        return jnp.dtype(dtype)
+        dt = np.dtype(_DTYPE_MAP[dtype])
+    else:
+        try:
+            dt = np.dtype(dtype)
+        except TypeError:
+            return jnp.dtype(dtype)
+    if dt.itemsize == 8 and dt.kind in 'iuf' and \
+            not jax.config.jax_enable_x64:
+        dt = np.dtype({'i': np.int32, 'u': np.uint32,
+                       'f': np.float32}[dt.kind])
+    return dt
 
 
 def dtype_name(dtype):

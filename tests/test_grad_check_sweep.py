@@ -262,3 +262,37 @@ def test_cond_grad_raises_clear_error():
         loss = fluid.layers.mean(y)
         with pytest.raises(NotImplementedError, match='StaticRNN'):
             fluid.backward.append_backward(loss)
+
+
+def test_nested_cond_in_while_grad_raises():
+    """Writes hidden one block deeper (conditional_block inside a while)
+    must still trip the no-control-flow-gradients guard."""
+    import pytest
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.layers.control_flow import ConditionalBlock
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[1], dtype='float32')
+        x.stop_gradient = False
+        acc = fluid.layers.elementwise_add(
+            x, fluid.layers.fill_constant([1], 'float32', 0.0))
+        i = fluid.layers.fill_constant([1], 'float32', 0.0)
+        three = fluid.layers.fill_constant([1], 'float32', 3.0)
+        cond_v = fluid.layers.less_than(i, three)
+        w = fluid.layers.While(cond_v)
+        with w.block():
+            from paddle_tpu.fluid.layers import ops as _ops
+            pred = _ops.greater_than(
+                acc, fluid.layers.fill_constant([1], 'float32', 0.0))
+            cb = ConditionalBlock(pred)
+            with cb.block():
+                fluid.layers.assign(
+                    fluid.layers.scale(acc, scale=2.0), acc)
+            fluid.layers.assign(
+                fluid.layers.elementwise_add(
+                    i, fluid.layers.fill_constant([1], 'float32', 1.0)),
+                i)
+            fluid.layers.assign(fluid.layers.less_than(i, three), cond_v)
+        loss = fluid.layers.mean(acc)
+        with pytest.raises(NotImplementedError, match='StaticRNN'):
+            fluid.backward.append_backward(loss)
