@@ -478,7 +478,7 @@ def deformable_conv(input, offset, mask, num_filters, filter_size,
                      infer_shape=False)
     if bias_attr is not False:
         out = helper.append_bias_op(out, dim_start=1, dim_end=2,
-                                    attr=bias_attr)
+                                    bias_attr=bias_attr)
     return out
 
 
@@ -539,5 +539,8 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
                             'remove_accidental_hits':
                                 remove_accidental_hits,
                             'seed': seed}, infer_shape=False)
+    b = logits.shape[0]
+    sampled_logits.shape = (b, num_true + num_samples)
+    sampled_label.shape = (b, num_true)
     from . import nn as _nn
     return _nn.softmax_with_cross_entropy(sampled_logits, sampled_label)

@@ -148,18 +148,21 @@ def yolo_box(ctx, ins, attrs):
     return {'Boxes': [boxes], 'Scores': [scores]}
 
 
-def _nms_single(boxes, scores, iou_thr, keep_k):
-    """Greedy NMS with fixed output size keep_k; returns (idx, valid)."""
+def _nms_single(boxes, scores, iou_thr, keep_k, offset=0.0):
+    """Greedy NMS with fixed output size keep_k; returns (idx, valid).
+    offset=1.0 selects the legacy pixel convention (w = x2-x1+1), which
+    must match the decode convention of the caller."""
     n = boxes.shape[0]
-    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    area = ((boxes[:, 2] - boxes[:, 0] + offset) *
+            (boxes[:, 3] - boxes[:, 1] + offset))
 
     def iou_with(i):
         b = boxes[i]
         lt = jnp.maximum(boxes[:, :2], b[:2])
         rb = jnp.minimum(boxes[:, 2:], b[2:])
-        wh = jnp.maximum(rb - lt, 0.0)
+        wh = jnp.maximum(rb - lt + offset, 0.0)
         inter = wh[:, 0] * wh[:, 1]
-        ab = (b[2] - b[0]) * (b[3] - b[1])
+        ab = (b[2] - b[0] + offset) * (b[3] - b[1] + offset)
         return inter / (area + ab - inter + 1e-10)
 
     def body(k, carry):
@@ -270,11 +273,67 @@ def roi_align(ctx, ins, attrs):
     return {'Out': [out]}
 
 
-@register('generate_proposals')
+@register('generate_proposals', no_grad_out_slots=('RpnRois',
+                                                   'RpnRoiProbs'))
 def generate_proposals(ctx, ins, attrs):
-    raise NotImplementedError(
-        'generate_proposals: compose yolo_box/box_coder + '
-        'multiclass_nms fixed-size variants')
+    """RPN proposal generation (detection/generate_proposals_op.cc),
+    dense rendering: decode anchor deltas -> clip to image -> top-N by
+    score -> NMS -> padded [post_nms_topN, 4] per image."""
+    scores = ins['Scores'][0]       # [N, A, H, W]
+    deltas = ins['BboxDeltas'][0]   # [N, 4A, H, W]
+    im_info = ins['ImInfo'][0]      # [N, 3] (h, w, scale)
+    anchors = ins['Anchors'][0].reshape(-1, 4)    # [A*H*W, 4]
+    variances = ins['Variances'][0].reshape(-1, 4) \
+        if ins.get('Variances') else jnp.ones_like(
+            anchors.reshape(-1, 4))
+    pre_n = int(attrs.get('pre_nms_topN', 6000))
+    post_n = int(attrs.get('post_nms_topN', 1000))
+    nms_thresh = attrs.get('nms_thresh', 0.5)
+    min_size = attrs.get('min_size', 0.1)
+
+    n = scores.shape[0]
+    a = scores.shape[1]
+    sc = scores.transpose(0, 2, 3, 1).reshape(n, -1)          # [N, K]
+    dl = deltas.transpose(0, 2, 3, 1).reshape(n, -1, 4)       # [N, K, 4]
+    k = sc.shape[1]
+    pre_n = min(pre_n, k)
+    post_n = min(post_n, pre_n)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+
+    def per_image(sc_i, dl_i, info):
+        cx = ax + dl_i[:, 0] * variances[:, 0] * aw
+        cy = ay + dl_i[:, 1] * variances[:, 1] * ah
+        w = aw * jnp.exp(jnp.clip(dl_i[:, 2] * variances[:, 2],
+                                  -10.0, 10.0))
+        h = ah * jnp.exp(jnp.clip(dl_i[:, 3] * variances[:, 3],
+                                  -10.0, 10.0))
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        boxes = jnp.clip(boxes,
+                         jnp.zeros(4, boxes.dtype),
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        # FilterBoxes: drop slivers below min_size (in image scale)
+        ms = min_size * info[2]
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        sc_f = jnp.where((bw >= ms) & (bh >= ms), sc_i, -jnp.inf)
+        top_sc, idx = jax.lax.top_k(sc_f, pre_n)
+        top_boxes = jnp.take(boxes, idx, axis=0)
+        keep, valid = _nms_single(top_boxes, top_sc, nms_thresh,
+                                  post_n, offset=1.0)
+        rois = jnp.take(top_boxes, jnp.maximum(keep, 0), axis=0)
+        rois = rois * valid[:, None].astype(rois.dtype)
+        probs = jnp.take(top_sc, jnp.maximum(keep, 0)) * \
+            valid.astype(top_sc.dtype)
+        return rois, probs
+
+    rois, probs = jax.vmap(per_image)(sc, dl, im_info)
+    return {'RpnRois': [rois], 'RpnRoiProbs': [probs[..., None]]}
 
 
 @register('sigmoid_focal_loss')

@@ -478,7 +478,39 @@ def bilinear_interp(ctx, ins, attrs):
 
 @register('grid_sampler')
 def grid_sampler(ctx, ins, attrs):
-    raise NotImplementedError('grid_sampler: planned Pallas kernel')
+    """Bilinear sampling at normalized grid coords
+    (operators/grid_sampler_op.cc; align_corners semantics):
+    X [N,C,H,W], Grid [N,Hg,Wg,2] in [-1,1] -> Out [N,C,Hg,Wg]."""
+    x = ins['X'][0]
+    grid = ins['Grid'][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * 0.5 * (w - 1)   # [N,Hg,Wg]
+    gy = (grid[..., 1] + 1.0) * 0.5 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(img, yy, xx):
+        # img [C,H,W]; out-of-bound neighbors contribute ZERO
+        # (reference GetGridPointValue), not the border pixel
+        inb = ((yy >= 0) & (yy <= h - 1) &
+               (xx >= 0) & (xx <= w - 1))
+        yyc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xxc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return img[:, yyc, xxc] * inb[None].astype(img.dtype)
+
+    def one(img, x0i, y0i, wxi, wyi):
+        v00 = gather(img, y0i, x0i)
+        v01 = gather(img, y0i, x0i + 1)
+        v10 = gather(img, y0i + 1, x0i)
+        v11 = gather(img, y0i + 1, x0i + 1)
+        return (v00 * (1 - wyi) * (1 - wxi) + v01 * (1 - wyi) * wxi +
+                v10 * wyi * (1 - wxi) + v11 * wyi * wxi)
+
+    out = jax.vmap(one)(x, x0.astype(jnp.int32), y0.astype(jnp.int32),
+                        wx[:, None], wy[:, None])
+    return {'Output': [out], 'Out': [out]}
 
 
 @register('temporal_shift')

@@ -124,7 +124,20 @@ def sequence_conv(ctx, ins, attrs):
 
 @register('im2sequence')
 def im2sequence(ctx, ins, attrs):
-    raise NotImplementedError('im2sequence: OCR path planned')
+    """Sliding-window patches to sequence (operators/im2sequence_op.h):
+    X [N,C,H,W] -> [N, OH*OW, C*kh*kw] dense rendering of the
+    reference's LoD output (one sequence per image)."""
+    x = ins['X'][0]
+    kh, kw = attrs.get('kernels', [1, 1])
+    sh, sw = attrs.get('strides', [1, 1])
+    pads = attrs.get('paddings', [0, 0, 0, 0])
+    pu, pl, pd, pr = (pads + pads)[:4] if len(pads) == 2 else pads
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        padding=((pu, pd), (pl, pr)))          # [N, C*kh*kw, OH, OW]
+    n, ckk, oh, ow = patches.shape
+    out = patches.reshape(n, ckk, oh * ow).transpose(0, 2, 1)
+    return {'Out': [out]}
 
 
 # --- additional sequence ops on the padded+mask representation ---------

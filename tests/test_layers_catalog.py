@@ -1,0 +1,258 @@
+"""Layer catalog: build + execute every long-tail wrapper through the
+real executor (reference test_layers.py pattern — every layer in
+fluid.layers must construct a runnable program)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+rng = np.random.RandomState(1)
+
+
+def run(build, feed=None):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        outs = [o for o in outs if o is not None]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        return exe.run(main, feed=feed or {}, fetch_list=list(outs))
+
+
+def test_shape_size_rank_sum():
+    def b():
+        x = fluid.layers.data('x', shape=[3, 4], dtype='float32')
+        return (fluid.layers.shape(x), fluid.layers.size(x),
+                fluid.layers.rank(x),
+                fluid.layers.sum([x, x]))
+    sh, sz, rk, sm = run(b, {'x': np.ones((2, 3, 4), 'float32')})
+    assert list(np.asarray(sh)) == [2, 3, 4]
+    assert int(np.asarray(sz)) == 24 and int(np.asarray(rk)) == 3
+    np.testing.assert_allclose(np.asarray(sm), 2.0)
+
+
+def test_crop_family_and_slices():
+    def b():
+        x = fluid.layers.data('x', shape=[6, 6], dtype='float32')
+        c = fluid.layers.crop(x, shape=[-1, 3, 3], offsets=[0, 1, 1])
+        ct = fluid.layers.crop_tensor(x, shape=[-1, 2, 2],
+                                      offsets=[0, 0, 0])
+        ss = fluid.layers.strided_slice(x, axes=[1], starts=[0],
+                                        ends=[6], strides=[2])
+        return c, ct, ss
+    c, ct, ss = run(b, {'x': rng.rand(2, 6, 6).astype('float32')})
+    assert np.asarray(c).shape == (2, 3, 3)
+    assert np.asarray(ct).shape == (2, 2, 2)
+    assert np.asarray(ss).shape == (2, 3, 6)
+
+
+def test_expand_as_and_elementwise_int():
+    def b():
+        x = fluid.layers.data('x', shape=[1, 4], dtype='float32')
+        t = fluid.layers.data('t', shape=[3, 4], dtype='float32')
+        e = fluid.layers.expand_as(x, t)
+        a = fluid.layers.data('a', shape=[4], dtype='int64')
+        m = fluid.layers.elementwise_mod(
+            a, fluid.layers.fill_constant([4], 'int64', 3))
+        f = fluid.layers.elementwise_floordiv(
+            a, fluid.layers.fill_constant([4], 'int64', 3))
+        return e, m, f
+    e, m, f = run(b, {'x': np.ones((2, 1, 4), 'float32'),
+                      't': np.ones((2, 3, 4), 'float32'),
+                      'a': np.arange(8).reshape(2, 4).astype('int64')})
+    assert np.asarray(e).shape == (2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(m)[0], [0, 1, 2, 0])
+    np.testing.assert_array_equal(np.asarray(f)[0], [0, 0, 0, 1])
+
+
+def test_random_layers_shapes():
+    def b():
+        u = fluid.layers.uniform_random([4, 5], min=0.0, max=1.0)
+        g = fluid.layers.gaussian_random([3, 2])
+        x = fluid.layers.data('x', shape=[7], dtype='float32')
+        ub = fluid.layers.uniform_random_batch_size_like(x, [-1, 6])
+        gb = fluid.layers.gaussian_random_batch_size_like(x, [-1, 2])
+        return u, g, ub, gb
+    u, g, ub, gb = run(b, {'x': np.ones((5, 7), 'float32')})
+    assert np.asarray(u).shape == (4, 5)
+    assert (np.asarray(u) >= 0).all() and (np.asarray(u) < 1).all()
+    assert np.asarray(g).shape == (3, 2)
+    assert np.asarray(ub).shape == (5, 6)
+    assert np.asarray(gb).shape == (5, 2)
+
+
+def test_hash_unique_scatter_nd():
+    def b():
+        ids = fluid.layers.data('ids', shape=[4], dtype='int64')
+        h = fluid.layers.hash(ids, hash_size=100, num_hash=2)
+        u, idx = fluid.layers.unique(
+            fluid.layers.reshape(ids, shape=[-1]))
+        uo, ui, uc = fluid.layers.unique_with_counts(
+            fluid.layers.reshape(ids, shape=[-1]))
+        index = fluid.layers.data('index', shape=[2, 1], dtype='int32')
+        upd = fluid.layers.data('upd', shape=[2], dtype='float32')
+        sc = fluid.layers.scatter_nd(index, upd, [6])
+        return h, u, uo, uc, sc
+    h, u, uo, uc, sc = run(
+        b, {'ids': np.array([[1, 2, 2, 9], [3, 1, 9, 9]], 'int64'),
+            'index': np.array([[1], [4]], 'int32').reshape(1, 2, 1)[0],
+            'upd': np.array([5.0, 7.0], 'float32')})
+    assert np.asarray(h).shape[-1] == 8  # 2 hashes x 4 ids
+    assert (np.asarray(h) < 100).all()
+    assert sorted(np.asarray(u).tolist()) == [1, 2, 3, 9]
+    assert np.asarray(uc).sum() == 8
+    got = np.zeros(6); got[1] = 5; got[4] = 7
+    np.testing.assert_allclose(np.asarray(sc), got)
+
+
+def test_vision_wrappers():
+    def b():
+        x = fluid.layers.data('x', shape=[2, 8, 8], dtype='float32')
+        rois = fluid.layers.data('rois', shape=[4], dtype='float32')
+        ra = fluid.layers.roi_align(x, rois, pooled_height=2,
+                                    pooled_width=2)
+        pp = fluid.layers.prroi_pool(x, rois, pooled_height=2,
+                                     pooled_width=2)
+        g = fluid.layers.data('grid', shape=[4, 4, 2], dtype='float32')
+        gs = fluid.layers.grid_sampler(x, g)
+        ap = fluid.layers.adaptive_pool3d(
+            fluid.layers.unsqueeze(x, axes=[1]), pool_size=[1, 2, 2],
+            pool_type='avg')
+        return ra, pp, gs, ap
+    ra, pp, gs, ap = run(
+        b, {'x': rng.rand(1, 2, 8, 8).astype('float32'),
+            'rois': np.array([[0, 0, 4, 4]], 'float32'),
+            'grid': np.zeros((1, 4, 4, 2), 'float32')})
+    assert np.asarray(ra).shape[-2:] == (2, 2)
+    assert np.asarray(pp).shape[-2:] == (2, 2)
+    assert np.asarray(gs).shape == (1, 2, 4, 4)
+    assert np.isfinite(np.asarray(ap)).all()
+
+
+def test_deformable_wrappers():
+    def b():
+        x = fluid.layers.data('x', shape=[2, 6, 6], dtype='float32')
+        # 2*dg*K offsets for a 3x3 kernel, dg=1 -> 18 channels
+        off = fluid.layers.data('off', shape=[18, 6, 6],
+                                dtype='float32')
+        mask = fluid.layers.data('mask', shape=[9, 6, 6],
+                                 dtype='float32')
+        dc = fluid.layers.deformable_conv(x, off, mask, num_filters=4,
+                                          filter_size=3, padding=1)
+        rois = fluid.layers.data('rois', shape=[4], dtype='float32')
+        trans = fluid.layers.data('trans', shape=[2, 2, 2],
+                                  dtype='float32')
+        dr = fluid.layers.deformable_roi_pooling(
+            x, rois, trans, pooled_height=2, pooled_width=2)
+        return dc, dr
+    dc, dr = run(b, {'x': rng.rand(1, 2, 6, 6).astype('float32'),
+                     'off': np.zeros((1, 18, 6, 6), 'float32'),
+                     'mask': np.ones((1, 9, 6, 6), 'float32'),
+                     'rois': np.array([[0, 0, 4, 4]], 'float32'),
+                     'trans': np.zeros((1, 2, 2, 2), 'float32')})
+    assert np.asarray(dc).shape == (1, 4, 6, 6)
+    assert np.asarray(dr).shape[-2:] == (2, 2)
+
+
+def test_detection_host_wrappers():
+    def b():
+        bbox_pred = fluid.layers.data('bp', shape=[4], dtype='float32')
+        cls = fluid.layers.data('cl', shape=[1], dtype='float32')
+        anchors = fluid.layers.data('an', shape=[4], dtype='float32',
+                                    append_batch_size=False)
+        gts = fluid.layers.data('gt', shape=[4], dtype='float32',
+                                append_batch_size=False)
+        out = fluid.layers.rpn_target_assign(
+            bbox_pred, cls, anchors, None, gts)
+        rois, restore = fluid.layers.distribute_fpn_proposals(
+            gts, 2, 5, 4, 224)
+        col = fluid.layers.collect_fpn_proposals(
+            rois, [fluid.layers.fill_constant(
+                [1], 'float32', 0.9)] * len(rois), 2, 5, 3)
+        return (out[0], restore, col)
+    loc_idx, restore, col = run(
+        b, {'bp': np.zeros((1, 8, 4), 'float32'),
+            'cl': np.zeros((1, 8, 1), 'float32'),
+            'an': np.array([[0, 0, 10, 10], [20, 20, 40, 40],
+                            [0, 0, 300, 300]], 'float32'),
+            'gt': np.array([[0, 0, 9, 9], [100, 100, 280, 280]],
+                           'float32')})
+    assert np.asarray(loc_idx).ndim >= 1
+    assert np.asarray(col).shape[-1] == 4
+
+
+def test_sequence_misc_wrappers():
+    def b():
+        x = fluid.layers.data('x', shape=[6, 8], dtype='float32')
+        ape = fluid.layers.add_position_encoding(x)
+        rc = fluid.layers.row_conv(x, future_context_size=2)
+        im = fluid.layers.data('im', shape=[1, 8, 8], dtype='float32')
+        seq = fluid.layers.im2sequence(im, filter_size=4, stride=4)
+        return ape, rc, seq
+    ape, rc, seq = run(b, {'x': rng.rand(2, 6, 8).astype('float32'),
+                           'im': rng.rand(2, 1, 8, 8).astype('float32')})
+    assert np.asarray(ape).shape == (2, 6, 8)
+    assert np.asarray(rc).shape == (2, 6, 8)
+    assert np.isfinite(np.asarray(seq)).all()
+
+
+def test_loss_wrappers():
+    def b():
+        p = fluid.layers.data('p', shape=[1], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        ll = fluid.layers.log_loss(p, y)
+        hl = fluid.layers.huber_loss(p, y, delta=1.0)
+        kl = fluid.layers.kldiv_loss(p, y, reduction='none')
+        ms = fluid.layers.mse_loss(p, y)
+        logits = fluid.layers.data('lg', shape=[50], dtype='float32')
+        lab = fluid.layers.data('lb', shape=[1], dtype='int64')
+        ss = fluid.layers.sampled_softmax_with_cross_entropy(
+            logits, lab, num_samples=10)
+        return ll, hl, kl, ms, ss
+    outs = run(b, {'p': np.full((3, 1), 0.4, 'float32'),
+                   'y': np.full((3, 1), 0.5, 'float32'),
+                   'lg': rng.rand(3, 50).astype('float32'),
+                   'lb': rng.randint(0, 50, (3, 1)).astype('int64')})
+    assert all(np.isfinite(np.asarray(o)).all() for o in outs)
+
+
+def test_step_counter_and_print():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        step = fluid.layers.autoincreased_step_counter()
+        fluid.layers.Print(x, message='catalog')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        vals = []
+        for _ in range(3):
+            s, = exe.run(main, feed={'x': np.ones((1, 2), 'float32')},
+                         fetch_list=[step])
+            vals.append(int(np.asarray(s).ravel()[0]))
+    assert vals == [1, 2, 3], vals
+
+
+def test_misc_remaining():
+    def b():
+        x = fluid.layers.data('x', shape=[4, 6, 6], dtype='float32')
+        sf = fluid.layers.similarity_focus(x, axis=1, indexes=[0])
+        pb = fluid.layers.polygon_box_transform(
+            fluid.layers.data('q', shape=[8, 4, 4], dtype='float32'))
+        rk = fluid.layers.data('rk', shape=[1], dtype='int32',
+                               append_batch_size=False)
+        ro = fluid.layers.reorder_lod_tensor_by_rank(x, rk)
+        return sf, pb, ro
+    sf, pb, ro = run(b, {'x': rng.rand(2, 4, 6, 6).astype('float32'),
+                         'q': rng.rand(2, 8, 4, 4).astype('float32'),
+                         'rk': np.array([1, 0], 'int32')})
+    assert set(np.unique(np.asarray(sf))) <= {0.0, 1.0}
+    assert np.asarray(pb).shape == (2, 8, 4, 4)
+    assert np.asarray(ro).shape == (2, 4, 6, 6)
