@@ -648,11 +648,46 @@ class PipelineOptimizer(object):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        """With cut_list: validates the cut and records the pipeline
+        plan on the program (program._pipeline_plan), then appends the
+        standard backward+update ops so exe.run keeps exact
+        single-submission semantics.  The staged GPipe execution path
+        over the plan is
+        paddle_tpu.parallel.program_pipeline.build_train_step
+        (parity-tested in tests/test_program_pipeline.py)."""
         if self._cut_list:
-            raise NotImplementedError(
-                'program cutting onto the pp mesh axis lands next '
-                'round; build staged models with '
-                'paddle_tpu.parallel.pipeline.pipeline_apply')
+            from ..parallel.program_pipeline import split_program_stages
+            program = loss.block.program
+            cut_names = [v.name if hasattr(v, 'name') else v
+                         for cuts in self._cut_list for v in
+                         (cuts if isinstance(cuts, (list, tuple))
+                          else [cuts])]
+            feeds = [v.name for v in program.global_block().vars.values()
+                     if getattr(v, 'is_data', False)]
+            # the pipeline input is the data var the FIRST stage reads
+            # (ops up to the first cut producer), not merely the first
+            # declared feed (labels may be declared first)
+            first_cut = cut_names[0]
+            stage0_reads = set()
+            for op in program.global_block().ops:
+                stage0_reads.update(op.input_arg_names)
+                if first_cut in op.output_arg_names:
+                    break
+            candidates = [n for n in feeds if n in stage0_reads]
+            if len(candidates) != 1:
+                raise ValueError(
+                    'PipelineOptimizer(cut_list=...) needs exactly one '
+                    'layers.data input feeding the first stage; found '
+                    '%r — restructure the feeds or use '
+                    'parallel.program_pipeline.build_train_step with '
+                    'an explicit input_name' % (candidates,))
+            input_name = candidates[0]
+            # validate the cut now so bad cut_lists fail at build
+            split_program_stages(program, input_name, cut_names,
+                                 loss.name, allow_data_reads=True)
+            program._pipeline_plan = {
+                'input': input_name, 'cuts': cut_names,
+                'output': loss.name}
         return self._optimizer.minimize(loss, startup_program,
                                         parameter_list, no_grad_set)
 

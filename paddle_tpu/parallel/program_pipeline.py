@@ -1,0 +1,197 @@
+"""Program-cutting pipeline parallelism: slice a fluid Program at cut
+variables into per-device stages and train with the GPipe schedule.
+
+Reference: PipelineOptimizer cut_list (python/paddle/fluid/
+optimizer.py:3311) slices the ProgramDesc into sections executed by
+SectionWorker threads over scope queues (framework/pipeline_trainer.cc).
+
+TPU-native re-design: the cut produces per-stage jax closures over the
+program's op lowerings; the GPipe schedule runs inside one shard_map
+over the 'pp' mesh axis where every device lax.switch-es to ITS stage
+and activations hop via ppermute (parallel/pipeline.py).  The loss is
+applied OUTSIDE the pipelined region (labels never enter the ring), so
+jax.grad reverses the whole pipeline automatically.
+
+Restrictions (validated with clear errors):
+- every cut activation must share one shape/dtype (the classic GPipe
+  rotating-buffer restriction);
+- each stage may read exactly one upstream activation: the previous cut
+  (no skip connections across stage boundaries).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import registry
+
+
+def split_program_stages(program, input_name, cut_var_names,
+                         output_name, allow_data_reads=False):
+    """Slice the program's device ops into stages at the producers of
+    `cut_var_names`.  Returns (stage_fns, stage_param_names):
+    stage_fns[s](params_dict, x) -> y closures over the op lowerings.
+    """
+    block = program.global_block()
+    fwd_ops = []
+    for op in block.ops:
+        if op.type in registry.HOST_OPS:
+            continue
+        if op.attrs.get('__op_role__', 'forward') != 'forward':
+            continue
+        fwd_ops.append(op)
+        if output_name in op.output_arg_names:
+            break
+    else:
+        raise ValueError('output %r is not produced by the program'
+                         % output_name)
+
+    stages = []
+    cur = []
+    cuts = list(cut_var_names)
+    for op in fwd_ops:
+        cur.append(op)
+        if cuts and cuts[0] in op.output_arg_names:
+            stages.append(cur)
+            cur = []
+            cuts.pop(0)
+    if cuts:
+        raise ValueError('cut vars %r are not produced before %r'
+                         % (cuts, output_name))
+    stages.append(cur)
+
+    boundaries = [input_name] + list(cut_var_names)
+    persistable = set()
+    for v in (block._find_var_recursive(n) for op in fwd_ops
+              for n in op.input_arg_names):
+        if v is not None and getattr(v, 'persistable', False):
+            persistable.add(v.name)
+
+    stage_fns, stage_params = [], []
+    for s, ops in enumerate(stages):
+        produced = set()
+        reads = []
+        for op in ops:
+            for n in op.input_arg_names:
+                if n not in produced and n not in reads:
+                    reads.append(n)
+            produced.update(op.output_arg_names)
+        def _is_data(n):
+            v = block._find_var_recursive(n)
+            return v is not None and getattr(v, 'is_data', False)
+        data_reads = [n for n in reads if _is_data(n)
+                      and n != boundaries[s]]
+        acts = [n for n in reads
+                if n not in persistable and n != boundaries[s]
+                and n not in data_reads]
+        if acts:
+            raise ValueError(
+                'stage %d reads %r from outside its boundary — '
+                'cross-stage skip connections are not supported; move '
+                'the cut or restructure the model' % (s, acts))
+        if data_reads and not allow_data_reads:
+            raise ValueError(
+                'stage %d reads feed vars %r: cut at the model output '
+                'and apply the loss outside the pipeline '
+                '(build_train_step loss_fn)' % (s, data_reads))
+        params = sorted(n for n in reads if n in persistable)
+        out_name = (cut_var_names[s] if s < len(cut_var_names)
+                    else output_name)
+
+        def make(ops, in_name, out_name, param_names):
+            def stage_fn(params_dict, x, step=0):
+                env = dict(params_dict)
+                env[in_name] = x
+                from ..fluid.executor import _lower_ops
+                _lower_ops(ops, env, step, False)
+                return env[out_name]
+            return stage_fn
+
+        stage_fns.append(make(list(ops), boundaries[s], out_name,
+                              params))
+        stage_params.append(params)
+    seen = {}
+    for s, names in enumerate(stage_params):
+        for n in names:
+            if n in seen:
+                raise ValueError(
+                    'parameter %r is read by stages %d and %d: '
+                    'cross-stage weight sharing would update two '
+                    'independent copies; untie the weight or move the '
+                    'cut' % (n, seen[n], s))
+            seen[n] = s
+    return stage_fns, stage_params
+
+
+def pipeline_forward_hetero(stage_fns, stage_params, x, mesh,
+                            axis='pp', n_microbatches=4, step_idx=0):
+    """GPipe forward over HETEROGENEOUS stages: every device applies its
+    own stage via lax.switch (params replicated; per-stage placement is
+    a memory follow-up), activations hop via ppermute."""
+    from .pipeline import pipeline_apply_inner
+    n_stages = mesh.shape[axis]
+    if len(stage_fns) != n_stages:
+        raise ValueError('%d stages but %s axis has %d devices'
+                         % (len(stage_fns), axis, n_stages))
+    b = x.shape[0]
+    assert b % n_microbatches == 0, 'batch must divide microbatches'
+    x_micro = x.reshape((n_microbatches, b // n_microbatches)
+                        + x.shape[1:])
+
+    def switched(all_params, buf):
+        branches = [
+            (lambda bb, f=f, p=p: f(p, bb, step_idx))
+            for f, p in zip(stage_fns, all_params)]
+        idx = jax.lax.axis_index(axis)
+        return jax.lax.switch(idx, branches, buf)
+
+    def inner(all_params, xm):
+        return pipeline_apply_inner(switched, all_params, xm, axis)
+
+    f = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(tuple(P() for _ in stage_fns), P()),
+        out_specs=P(), check_vma=False)
+    return f(tuple(stage_params), x_micro).reshape((b,) + x.shape[1:])
+
+
+def build_train_step(program, scope, input_name, cut_var_names,
+                     output_name, loss_fn, mesh, axis='pp',
+                     n_microbatches=4, learning_rate=0.01):
+    """Compile a full GPipe SGD train step from a cut program.
+
+    loss_fn(output, *labels) -> scalar is applied OUTSIDE the pipeline.
+    Returns (step, params): step(params, x, *labels) -> (loss,
+    new_params), jitted over `mesh`.
+    """
+    from ..fluid import core
+    stage_fns, stage_param_names = split_program_stages(
+        program, input_name, cut_var_names, output_name)
+    params = tuple(
+        {n: np.asarray(core.as_array(scope.find_var(n)))
+         for n in names}
+        for names in stage_param_names)
+
+    def step_impl(params, step_idx, x, *labels):
+        def loss_of(params):
+            out = pipeline_forward_hetero(
+                stage_fns, params, x, mesh, axis, n_microbatches,
+                step_idx=step_idx)
+            return loss_fn(out, *labels)
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        new_params = jax.tree.map(
+            lambda p, g: p - learning_rate * g, params, grads)
+        return loss, new_params
+
+    jitted = jax.jit(step_impl)
+    counter = {'n': 0}
+
+    def step(params, x, *labels):
+        # per-call step index varies stochastic-op RNG (dropout masks)
+        # like the executor's per-run step counter; traced arg, so no
+        # retrace per step
+        counter['n'] += 1
+        return jitted(params, jnp.asarray(counter['n']), x, *labels)
+
+    return step, params
