@@ -14,6 +14,8 @@ matching the reference's semantics; XLA fuses it away.
 
 from collections import defaultdict
 
+import numpy as np
+
 from . import framework
 from .framework import Parameter, grad_var_name
 
@@ -258,11 +260,8 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
         _collect(op.attrs.get('sub_block'), set())
         needs = any(contribs.get(n) for n in out_names)
         if needs:
-            raise NotImplementedError(
-                'gradients through %s sub-blocks are not implemented: '
-                'build differentiable recurrences with StaticRNN / '
-                'DynamicRNN (unrolled, fully differentiable) or keep '
-                'the loop outside the loss path' % op.type)
+            return _control_flow_backward(block, op, contribs,
+                                          resolve_grad, no_grad_set)
         return False
     from ..ops import registry
     if op.type in registry.HOST_OPS:
@@ -334,19 +333,168 @@ def _op_backward(block, op, contribs, resolve_grad, no_grad_set,
     return True
 
 
+def _control_flow_backward(block, op, contribs, resolve_grad, no_grad_set):
+    """Differentiate a while / conditional_block op.
+
+    TPU-native analog of the reference's WhileGradOp
+    (/root/reference/paddle/fluid/operators/controlflow/while_op.cc) and
+    ConditionalBlockGradOp (conditional_block_op.cc).  Instead of
+    replaying saved step scopes, the forward op saves the ENTRY values of
+    its loop state (carry), and the grad op re-runs the sub-block
+    functionally from those entries under jax.vjp — loops re-run as a
+    bounded, masked lax.scan (reverse-differentiable, hence the
+    max_trip_count requirement), branches as lax.cond.  See
+    executor._lower_while_grad / _lower_conditional_block_grad.
+    """
+    is_while = op.type == 'while'
+    if is_while and int(op.attrs.get('max_trip_count') or 0) <= 0:
+        raise NotImplementedError(
+            'gradients through a while op need a bounded trip count so '
+            'the backward pass can re-run it as a reverse-differentiable '
+            'lax.scan: build the loop with While(cond, max_trip_count=N) '
+            'or layers.while_loop(..., max_trip_count=N)')
+    carry_names = list(op.output('Out'))
+    cond_slot = 'Condition' if is_while else 'Cond'
+    cond_name = op.input(cond_slot)[0]
+    if is_while and cond_name not in carry_names:
+        carry_names.append(cond_name)
+
+    float_carries = []
+    for n in carry_names:
+        v = block._find_var_recursive(n)
+        if v is not None and _is_float_dtype(v.dtype):
+            float_carries.append(n)
+
+    # cotangents for the post-op values of the float carries; consuming
+    # them resets the var's contribution list — producers BEFORE the op
+    # get the entry-grad appended below instead
+    cot_row = []
+    for n in float_carries:
+        g = resolve_grad(n)
+        if g is None:
+            v = block._find_var_recursive(n)
+            z = block.create_var(
+                name=framework.unique_name.generate(n + '@ZERO'),
+                shape=v.shape, dtype=v.dtype)
+            z.stop_gradient = True
+            block.append_op('fill_zeros_like', inputs={'X': n},
+                            outputs={'Out': z}, infer_shape=False)
+            g = z.name
+        cot_row.append(g)
+        contribs[n] = []
+
+    # entry vars: the forward op re-declares them as outputs and its
+    # lowering stashes the pre-loop carry values there (__needs_grad__)
+    entry_row = []
+    for n in carry_names:
+        v = block._find_var_recursive(n)
+        en = framework.unique_name.generate(n + '@CF_ENTRY')
+        ev = block.create_var(name=en, shape=v.shape if v else (),
+                              dtype=v.dtype if v else 'float32')
+        ev.stop_gradient = True
+        entry_row.append(en)
+    op.attrs['__needs_grad__'] = True
+    op.attrs['__carry_names__'] = list(carry_names)
+    op.attrs['__entry_names__'] = list(entry_row)
+    op.outputs['Entry'] = list(entry_row)
+
+    # closure reads: declared X values the sub-block only reads
+    # (parameters etc.) — unchanged after the op, so read by name
+    closure = []
+    for n in op.input('X'):
+        if n in carry_names or n in closure:
+            continue
+        v = block._find_var_recursive(n)
+        if v is not None and _creates_grad(v) and n not in no_grad_set:
+            closure.append(n)
+
+    entry_grad_row = []
+    for n in float_carries:
+        gname = framework.unique_name.generate(grad_var_name(n))
+        v = block._find_var_recursive(n)
+        gv = block.create_var(name=gname, shape=v.shape, dtype=v.dtype)
+        gv.stop_gradient = True
+        entry_grad_row.append(gname)
+        if _creates_grad(v) and n not in no_grad_set:
+            contribs[n].append(gname)
+    closure_grad_row = []
+    for n in closure:
+        gname = framework.unique_name.generate(grad_var_name(n))
+        v = block._find_var_recursive(n)
+        gv = block.create_var(name=gname, shape=v.shape, dtype=v.dtype)
+        gv.stop_gradient = True
+        closure_grad_row.append(gname)
+        contribs[n].append(gname)
+
+    grad_inputs = {'X': list(op.input('X')), cond_slot: [cond_name],
+                   'Entry': list(entry_row), 'GRAD::Out': cot_row}
+    attrs = {'sub_block': op.attrs['sub_block'],
+             '__carry_names__': list(carry_names),
+             '__float_carries__': list(float_carries),
+             '__closure_names__': list(closure),
+             '__op_role__': 'backward'}
+    if is_while:
+        attrs['max_trip_count'] = int(op.attrs['max_trip_count'])
+    block.append_op(op.type + '_grad', inputs=grad_inputs,
+                    outputs={'GRAD::Entry': entry_grad_row,
+                             'GRAD::X': closure_grad_row},
+                    attrs=attrs, infer_shape=False)
+    return True
+
+
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
-    """Reference: backward.py:1407."""
-    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    """Reference: backward.py:1407.  Multiple targets differentiate the
+    weighted sum sum_i <target_gradients_i, targets_i> (implicit ones
+    when target_gradients is None) — the reverse-mode contract the
+    reference implements by seeding each target's grad var."""
+    targets = targets if isinstance(targets, (list, tuple)) \
+        else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    if len(targets) != 1:
-        raise NotImplementedError('calc_gradient: single target for now')
-    loss = targets[0]
-    block = loss.block
+    if target_gradients is not None and not isinstance(
+            target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    block = targets[0].block
+    program = block.program
+    if len(targets) == 1 and target_gradients is None and \
+            int(np.prod(targets[0].shape or (1,))) in (1,):
+        loss = targets[0]
+    else:
+        parts = []
+        for i, t in enumerate(targets):
+            tg = target_gradients[i] if target_gradients else None
+            weighted = t
+            if tg is not None:
+                weighted = block.create_var(
+                    name=framework.unique_name.generate(
+                        t.name + '@WEIGHTED'),
+                    shape=t.shape, dtype=t.dtype)
+                block.append_op('elementwise_mul',
+                                inputs={'X': t, 'Y': tg},
+                                outputs={'Out': weighted},
+                                attrs={'axis': -1})
+            s = block.create_var(
+                name=framework.unique_name.generate(t.name + '@TSUM'),
+                shape=(), dtype=t.dtype)
+            block.append_op('reduce_sum', inputs={'X': weighted},
+                            outputs={'Out': s},
+                            attrs={'dim': None, 'reduce_all': True,
+                                   'keep_dim': False},
+                            infer_shape=False)
+            parts.append(s.name)
+        if len(parts) == 1:
+            loss = block.vars[parts[0]]
+        else:
+            total = block.create_var(
+                name=framework.unique_name.generate('calc_grad_total'),
+                shape=(), dtype=targets[0].dtype)
+            block.append_op('sum', inputs={'X': parts},
+                            outputs={'Out': total}, infer_shape=False)
+            loss = total
     pg = append_backward(loss, no_grad_set=no_grad_set)
     del pg
     outs = []
     for v in inputs:
-        gname = loss.block.program._grad_name_map.get(v.name)
+        gname = program._grad_name_map.get(v.name)
         outs.append(block._find_var_recursive(gname) if gname else None)
     return outs
 

@@ -47,12 +47,15 @@ def _op_writes(op):
 
 def _lower_ops(ops, env, step, prefer_test):
     """Run a list of ops' lowering rules over a functional env."""
+    CF_LOWERINGS = {'while': _lower_while,
+                    'conditional_block': _lower_conditional_block,
+                    'while_grad': _lower_while_grad,
+                    'conditional_block_grad': _lower_conditional_block_grad}
     for op in ops:
-        if op.type == 'while':
-            _lower_while(op, env, step, prefer_test)
-            continue
-        if op.type == 'conditional_block':
-            _lower_conditional_block(op, env, step, prefer_test)
+        cf = CF_LOWERINGS.get(op.type)
+        if cf is not None:
+            with jax.named_scope(op.type):
+                cf(op, env, step, prefer_test)
             continue
         opdef = registry.get(op.type)
         ins = {}
@@ -69,7 +72,12 @@ def _lower_ops(ops, env, step, prefer_test):
         ctx = registry.LowerCtx(step, op.attrs.get('__op_seed__', 0),
                                 prefer_test)
         try:
-            outs = opdef.fn(ctx, ins, op.attrs)
+            # per-op trace attribution: the reference wraps every op run
+            # in a profiler RecordEvent (framework/operator.cc:170); here
+            # the scope name flows into XLA op metadata so Perfetto
+            # traces and HLO dumps read as fluid op names
+            with jax.named_scope(op.type):
+                outs = opdef.fn(ctx, ins, op.attrs)
         except Exception as e:
             # enforce-style error context (reference: PADDLE_ENFORCE +
             # op_callstack, platform/enforce.h, framework/op_call_stack.h)
@@ -97,12 +105,34 @@ def _subblock_carry(sub_ops, env):
 
 def _lower_while(op, env, step, prefer_test):
     """while op -> lax.while_loop.  Static shapes; parent vars the
-    sub-block only reads are captured as closure constants."""
+    sub-block only reads are captured as closure constants.
+
+    When the loop carries gradients (__needs_grad__, set by
+    backward._control_flow_backward) it lowers instead to a bounded,
+    masked lax.scan — semantically `for i in range(max_trip_count):
+    carry = cond ? body(carry) : carry` — which is what the grad op
+    re-runs under jax.vjp, and it stashes the carry ENTRY values for
+    the grad op (the reference keeps them in step scopes:
+    operators/controlflow/while_op.cc)."""
     import jax
     import jax.numpy as jnp
     program = op.block.program
     sub = program.blocks[op.attrs['sub_block']]
     cond_name = op.input('Condition')[0]
+    if op.attrs.get('__needs_grad__'):
+        carry_names = list(op.attrs['__carry_names__'])
+        for n, en in zip(carry_names, op.attrs['__entry_names__']):
+            if n not in env:
+                raise RuntimeError(
+                    'while loop state %s is not initialized before the '
+                    'loop' % n)
+            env[en] = env[n]
+        init = {n: env[n] for n in carry_names}
+        final = _while_scan(sub.ops, carry_names, cond_name, init, env,
+                            int(op.attrs['max_trip_count']), step,
+                            prefer_test)
+        env.update(final)
+        return
     carry_names = _subblock_carry(sub.ops, env)
     if cond_name not in carry_names:
         carry_names.append(cond_name)
@@ -121,25 +151,165 @@ def _lower_while(op, env, step, prefer_test):
     env.update(final)
 
 
+def _while_scan(sub_ops, carry_names, cond_name, init, outer_env, max_t,
+                step, prefer_test):
+    """Bounded masked-scan rendering of a while loop: every iteration
+    computes the body, but the carry only advances while the condition
+    holds.  Unlike lax.while_loop this is reverse-mode differentiable
+    (lax.scan saves per-iteration residuals for the vjp).
+
+    Truncation guard: if the condition is STILL true after max_t
+    iterations (max_trip_count underestimated the real trip count), the
+    float carries are poisoned with NaN instead of silently returning
+    the truncated recurrence — the failure is loud (NaN loss;
+    FLAGS_check_nan_inf names the var) rather than numerically wrong.
+    When the loop exits within the bound the guard adds exact 0.0."""
+    import jax
+    import jax.numpy as jnp
+
+    init = {n: jnp.asarray(init[n]) for n in carry_names}
+
+    def body(carry, _):
+        pred = jnp.asarray(carry[cond_name]).reshape(()).astype(bool)
+        local = dict(outer_env)
+        local.update(carry)
+        _lower_ops(sub_ops, local, step, prefer_test)
+        merged = {}
+        for n in carry_names:
+            new = jnp.asarray(local[n]).astype(carry[n].dtype)
+            merged[n] = jnp.where(pred, new, carry[n])
+        return merged, None
+
+    final, _ = jax.lax.scan(body, init, None, length=max_t)
+    truncated = jnp.asarray(final[cond_name]).reshape(()).astype(bool)
+    poison = jnp.where(truncated, jnp.float32(jnp.nan), jnp.float32(0))
+    out = {}
+    for n in carry_names:
+        v = final[n]
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = v + poison.astype(v.dtype)
+        out[n] = v
+    return out
+
+
+def _control_flow_grad(op, env, make_fwd):
+    """Shared plumbing for while_grad / conditional_block_grad: collect
+    entries + closure values from env, jax.vjp over the re-run forward
+    (make_fwd builds it from the collected pieces), write grads back.
+    The op wiring comes from backward._control_flow_backward."""
+    import jax
+    import jax.numpy as jnp
+    carry_names = list(op.attrs['__carry_names__'])
+    float_carries = list(op.attrs['__float_carries__'])
+    closure_names = list(op.attrs['__closure_names__'])
+
+    entries = {n: jnp.asarray(env[en])
+               for n, en in zip(carry_names, op.input('Entry'))}
+    base_env = {n: env[n] for n in op.input('X')
+                if n in env and n not in carry_names
+                and n not in closure_names}
+    closure_vals = {n: jnp.asarray(env[n]) for n in closure_names}
+
+    fwd = make_fwd(carry_names, float_carries, base_env)
+    out, vjp_fn = jax.vjp(fwd, entries, closure_vals)
+    cots = {}
+    for n, g in zip(float_carries, op.input('GRAD::Out')):
+        cots[n] = jnp.asarray(env[g]).astype(out[n].dtype).reshape(
+            out[n].shape)
+    d_entry, d_closure = vjp_fn(cots)
+    for n, gname in zip(float_carries, op.output('GRAD::Entry')):
+        env[gname] = d_entry[n]
+    for n, gname in zip(closure_names, op.output('GRAD::X')):
+        env[gname] = d_closure[n]
+
+
+def _lower_while_grad(op, env, step, prefer_test):
+    """Gradient of a while op: re-run the bounded masked scan from the
+    saved carry entries under jax.vjp.  Gradients flow to the entry
+    values of the loop state and to closure reads (e.g. weights used
+    inside the body).  Reference analog: WhileGradOp replaying step
+    scopes (operators/controlflow/while_op.cc)."""
+    program = op.block.program
+    sub = program.blocks[op.attrs['sub_block']]
+    cond_name = op.input('Condition')[0]
+    max_t = int(op.attrs['max_trip_count'])
+
+    def make_fwd(carry_names, float_carries, base_env):
+        def fwd(entry_carry, closure):
+            outer = dict(base_env)
+            outer.update(closure)
+            final = _while_scan(sub.ops, carry_names, cond_name,
+                                entry_carry, outer, max_t, step,
+                                prefer_test)
+            return {n: final[n] for n in float_carries}
+        return fwd
+
+    _control_flow_grad(op, env, make_fwd)
+
+
+def _lower_conditional_block_grad(op, env, step, prefer_test):
+    """Gradient of a conditional_block: jax.vjp over `lax.cond(pred,
+    sub_block, identity, entries)` from the saved carry entries.
+    Reference analog: ConditionalBlockGradOp
+    (operators/controlflow/conditional_block_op.cc)."""
+    import jax
+    import jax.numpy as jnp
+    program = op.block.program
+    sub = program.blocks[op.attrs['sub_block']]
+    pred = jnp.asarray(env[op.input('Cond')[0]]).reshape(())
+
+    def make_fwd(carry_names, float_carries, base_env):
+        def fwd(entry_carry, closure):
+            outer = dict(base_env)
+            outer.update(closure)
+
+            def true_fn(carry):
+                local = dict(outer)
+                local.update(carry)
+                _lower_ops(sub.ops, local, step, prefer_test)
+                return {n: jnp.asarray(local[n]).astype(carry[n].dtype)
+                        for n in carry_names}
+
+            final = jax.lax.cond(pred, true_fn, lambda c: dict(c),
+                                 {n: jnp.asarray(entry_carry[n])
+                                  for n in carry_names})
+            return {n: final[n] for n in float_carries}
+        return fwd
+
+    _control_flow_grad(op, env, make_fwd)
+
+
 def _lower_conditional_block(op, env, step, prefer_test):
     """conditional_block -> lax.cond with an identity false branch
-    (reference: operators/controlflow/conditional_block_op.cc)."""
+    (reference: operators/controlflow/conditional_block_op.cc).  With
+    __needs_grad__ the carry ENTRY values are stashed for the grad op
+    (_lower_conditional_block_grad)."""
     import jax
     import jax.numpy as jnp
     program = op.block.program
     sub = program.blocks[op.attrs['sub_block']]
     cond_name = op.input('Cond')[0]
-    carry_names = _subblock_carry(sub.ops, env)
+    if op.attrs.get('__needs_grad__'):
+        carry_names = list(op.attrs['__carry_names__'])
+        for n, en in zip(carry_names, op.attrs['__entry_names__']):
+            if n not in env:
+                raise RuntimeError(
+                    'conditional_block output %s is not initialized '
+                    'before the branch' % n)
+            env[en] = env[n]
+    else:
+        carry_names = _subblock_carry(sub.ops, env)
 
     def true_fn(carry):
         local = dict(env)
         local.update(carry)
         _lower_ops(sub.ops, local, step, prefer_test)
-        return {n: local[n] for n in carry_names}
+        return {n: jnp.asarray(local[n]).astype(
+            jnp.asarray(carry[n]).dtype) for n in carry_names}
 
-    init = {n: env[n] for n in carry_names}
+    init = {n: jnp.asarray(env[n]) for n in carry_names}
     pred = jnp.asarray(env[cond_name]).reshape(())
-    final = jax.lax.cond(pred, true_fn, lambda c: c, init)
+    final = jax.lax.cond(pred, true_fn, lambda c: dict(c), init)
     env.update(final)
 
 
@@ -178,6 +348,10 @@ def _make_segment_fn(segment, prefer_test=False):
         _lower_ops(ops, env, step, prefer_test)
         return {n: env[n] for n in output_names}
 
+    # segment identity in traces: ops span + count (reference names SSA
+    # executors' spans per graph; here one jit program per segment)
+    fn.__name__ = 'segment_%s_x%d' % (ops[0].type if ops else 'empty',
+                                      len(ops))
     return fn
 
 
@@ -246,7 +420,8 @@ class Executor(object):
         block = program.global_block()
         items = []  # list of _Segment | ('host', op)
         cur = []
-        CONTROL_FLOW = ('while', 'conditional_block')
+        CONTROL_FLOW = ('while', 'conditional_block', 'while_grad',
+                        'conditional_block_grad')
         for op in block.ops:
             if op.type in CONTROL_FLOW:
                 cur.append(op)

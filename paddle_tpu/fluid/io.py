@@ -185,3 +185,8 @@ def save(program, model_path):
 def load(program, model_path, executor=None):
     load_persistables(executor, os.path.dirname(model_path) or '.',
                       program, filename=os.path.basename(model_path))
+
+
+# reference parity: fluid.io.DataLoader (python/paddle/fluid/reader.py
+# re-exported through fluid.io in v1.6)
+from .reader import DataLoader, PyReader  # noqa: E402,F401

@@ -51,12 +51,19 @@ class While(object):
     IN_WHILE_BLOCK = 1
     AFTER_WHILE_BLOCK = 2
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None,
+                 max_trip_count=None):
+        """max_trip_count bounds the loop so gradients can flow through
+        it: backward re-runs the loop as a masked lax.scan of that
+        length (reference WhileGradOp replays saved step scopes,
+        operators/controlflow/while_op.cc — a shape-static compiler
+        needs the bound instead)."""
         self.helper = LayerHelper('while', name=name)
         self.status = While.BEFORE_WHILE_BLOCK
         if not isinstance(cond, Variable):
             raise TypeError('While cond must be a Variable')
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
 
     def block(self):
         return WhileGuard(self)
@@ -80,13 +87,28 @@ class While(object):
             n for op in while_block.ops for n in op.input_arg_names
             if parent_block._find_var_recursive(n) is not None
             and not while_block.has_var(n)))
+        attrs = {'sub_block': while_block.idx, 'is_test': False}
+        if self.max_trip_count:
+            attrs['max_trip_count'] = int(self.max_trip_count)
         parent_block.append_op(
             'while',
             inputs={'X': x_names, 'Condition': self.cond_var},
             outputs={'Out': inner_writes},
-            attrs={'sub_block': while_block.idx,
-                   'is_test': False},
+            attrs=attrs,
             infer_shape=False)
+        _mark_loop_outputs_differentiable(parent_block, inner_writes)
+
+
+def _mark_loop_outputs_differentiable(parent_block, out_names):
+    """A float var overwritten by a while/conditional_block is loop
+    state: its post-op value is computed by the sub-block, so gradients
+    must be able to reach the op even when the var's initializer (e.g.
+    fill_constant) is marked stop_gradient."""
+    for n in out_names:
+        v = parent_block._find_var_recursive(n)
+        if v is not None and str(v.dtype) in ('float16', 'bfloat16',
+                                              'float32', 'float64'):
+            v.stop_gradient = False
 
 
 def increment(x, value=1.0, in_place=True):
@@ -191,16 +213,19 @@ def array_length(array):
     return _array_len_var(array, helper)
 
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               max_trip_count=None):
     """Functional while (reference layers/control_flow.py while_loop):
     builds a While block; body outputs are assigned back onto the loop
-    vars so the executor's lax.while_loop carry picks them up."""
+    vars so the executor's lax.while_loop carry picks them up.  Pass
+    max_trip_count to make the loop differentiable (see While)."""
     from . import tensor as _t
     if not isinstance(loop_vars, (list, tuple)):
         loop_vars = [loop_vars]
     loop_vars = list(loop_vars)
     pre = cond(*loop_vars)
-    w = While(pre, is_test=is_test, name=name)
+    w = While(pre, is_test=is_test, name=name,
+              max_trip_count=max_trip_count)
     with w.block():
         new_vars = body(*loop_vars)
         if not isinstance(new_vars, (list, tuple)):
@@ -341,13 +366,33 @@ class _CondBlockGuard(object):
         if exc_type is not None:
             return False
         self.program._rollback()
+        # declare the branch's external reads (X) and parent-var writes
+        # (Out) so dataflow analysis and append_backward can see them
+        # (the reference discovers them in ConditionalBlockOp::Run; here
+        # the program IR carries them explicitly)
+        parent = self.program.current_block()
+        sub = self.sub_block
+        writes, seen = [], set()
+        for op in sub.ops:
+            for n in op.output_arg_names:
+                if n in seen:
+                    continue
+                seen.add(n)
+                if parent._find_var_recursive(n) is not None \
+                        and not sub.has_var(n):
+                    writes.append(n)
+        reads = sorted(set(
+            n for op in sub.ops for n in op.input_arg_names
+            if parent._find_var_recursive(n) is not None
+            and not sub.has_var(n)))
         self.cb.helper.append_op(
             'conditional_block',
-            inputs={'Cond': self.cb.pred},
-            outputs={},
+            inputs={'Cond': self.cb.pred, 'X': reads},
+            outputs={'Out': writes},
             attrs={'sub_block': self.sub_block.idx,
                    'is_scalar_condition': True},
             infer_shape=False)
+        _mark_loop_outputs_differentiable(parent, writes)
         return True
 
 

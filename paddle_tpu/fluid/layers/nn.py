@@ -145,9 +145,24 @@ def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
 
 
 def adaptive_pool2d(input, pool_size, pool_type='max', name=None):
-    if list(pool_size) != [1, 1]:
-        raise NotImplementedError('adaptive_pool2d supports [1,1] (global)')
-    return pool2d(input, pool_type=pool_type, global_pooling=True, name=name)
+    """Adaptive pooling to an arbitrary output grid (reference
+    operators/pool_op adaptive mode: window i spans
+    [floor(i*H/oh), ceil((i+1)*H/oh)))."""
+    if list(pool_size) == [1, 1]:
+        return pool2d(input, pool_type=pool_type, global_pooling=True,
+                      name=name)
+    helper = LayerHelper('pool2d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op('pool2d', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'pooling_type': pool_type,
+                            'ksize': list(pool_size),
+                            'adaptive': True},
+                     infer_shape=False)
+    shp = list(input.shape)
+    if len(shp) == 4:
+        out.shape = (shp[0], shp[1], pool_size[0], pool_size[1])
+    return out
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
@@ -698,7 +713,16 @@ def prelu(x, mode, param_attr=None, name=None):
 
 
 def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
-    raise NotImplementedError('lrn: use batch_norm for modern nets')
+    """Cross-channel local response norm (reference layers/nn.py lrn
+    over operators/lrn_op.cc)."""
+    helper = LayerHelper('lrn', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op('lrn', inputs={'X': input},
+                     outputs={'Out': out, 'MidOut': mid},
+                     attrs={'n': n, 'k': k, 'alpha': alpha,
+                            'beta': beta})
+    return out
 
 
 def image_resize(input, out_shape=None, scale=None, name=None,
@@ -832,10 +856,8 @@ def cos_sim(X, Y):
 def nce(input, label, num_total_classes, sample_weight=None,
         param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
         sampler='uniform', custom_dist=None, seed=0, is_sparse=False):
-    """Noise-contrastive estimation loss (uniform sampler on device)."""
-    if custom_dist is not None:
-        raise NotImplementedError('nce: custom_dist is not supported; '
-                                  'only the uniform sampler exists')
+    """Noise-contrastive estimation loss; samplers: uniform and
+    custom_dist (reference operators/nce_op.h CustomSampler)."""
     helper = LayerHelper('nce', param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     dim = input.shape[-1]
@@ -851,13 +873,16 @@ def nce(input, label, num_total_classes, sample_weight=None,
                                     shape=[num_total_classes],
                                     dtype=input.dtype, is_bias=True)
         inputs['Bias'] = b
+    attrs = {'num_total_classes': num_total_classes,
+             'num_neg_samples': num_neg_samples,
+             'seed': seed, 'sampler': sampler}
+    if custom_dist is not None:
+        attrs['sampler'] = 'custom_dist'
+        attrs['custom_dist'] = [float(p) for p in custom_dist]
     helper.append_op('nce', inputs=inputs,
                      outputs={'Cost': cost, 'SampleLogits': s_logits,
                               'SampleLabels': s_labels},
-                     attrs={'num_total_classes': num_total_classes,
-                            'num_neg_samples': num_neg_samples,
-                            'seed': seed, 'sampler': sampler},
-                     infer_shape=False)
+                     attrs=attrs, infer_shape=False)
     return cost
 
 

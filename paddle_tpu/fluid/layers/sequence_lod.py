@@ -19,7 +19,8 @@ def sequence_mask(x, maxlen=None, dtype='int64'):
     return out
 
 
-def _seq_op(op_type, x, mask, attrs, out_slots=('Out',)):
+def _seq_op(op_type, x, mask, attrs, out_slots=('Out',),
+            out_shape=None):
     helper = LayerHelper(op_type)
     inputs = {'X': x}
     if mask is not None:
@@ -27,14 +28,26 @@ def _seq_op(op_type, x, mask, attrs, out_slots=('Out',)):
     outs = {}
     for s in out_slots:
         outs[s] = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs)
+    # LoD ops: build-time var shapes are the ragged rendering while the
+    # runtime batch is padded [B,T,...] — shapes resolve at trace time
+    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs,
+                     infer_shape=False)
+    outs[out_slots[0]].shape = tuple(x.shape) if out_shape is None \
+        else tuple(out_shape)
     return outs[out_slots[0]]
+
+
+def _pooled_shape(x):
+    # sequence_pool reduces [B, T, D] -> [B, D]; build-time lod-style
+    # shapes ([B, D] already) pass through
+    return x.shape[:1] + x.shape[2:] if len(x.shape) >= 3 else x.shape
 
 
 def sequence_pool(input, pool_type, mask=None, is_test=False):
     return _seq_op('sequence_pool', input, mask,
                    {'pooltype': pool_type.upper()},
-                   out_slots=('Out', 'MaxIndex'))
+                   out_slots=('Out', 'MaxIndex'),
+                   out_shape=_pooled_shape(input))
 
 
 def sequence_softmax(input, mask=None, use_cudnn=False, name=None):
@@ -43,12 +56,14 @@ def sequence_softmax(input, mask=None, use_cudnn=False, name=None):
 
 def sequence_first_step(input, mask=None):
     return _seq_op('sequence_pool', input, mask,
-                   {'pooltype': 'FIRST'}, out_slots=('Out', 'MaxIndex'))
+                   {'pooltype': 'FIRST'}, out_slots=('Out', 'MaxIndex'),
+                   out_shape=_pooled_shape(input))
 
 
 def sequence_last_step(input, mask=None):
     return _seq_op('sequence_pool', input, mask,
-                   {'pooltype': 'LAST'}, out_slots=('Out', 'MaxIndex'))
+                   {'pooltype': 'LAST'}, out_slots=('Out', 'MaxIndex'),
+                   out_shape=_pooled_shape(input))
 
 
 def sequence_expand(x, y, ref_level=-1, name=None):
@@ -72,7 +87,9 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                   param_attr=None, act=None, name=None):
     helper = LayerHelper('sequence_conv', param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
-    d = input.shape[2]
+    # last dim is the feature dim in both the LoD ([B,T,D] padded) and
+    # the flattened ([B*T?,D]) build-time renderings
+    d = input.shape[-1]
     w = helper.create_parameter(param_attr,
                                 shape=[filter_size * d, num_filters],
                                 dtype=input.dtype)
@@ -83,7 +100,9 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     helper.append_op('sequence_conv', inputs=inputs,
                      outputs={'Out': out},
                      attrs={'contextLength': filter_size,
-                            'contextStart': -(filter_size // 2)})
+                            'contextStart': -(filter_size // 2)},
+                     infer_shape=False)
+    out.shape = tuple(input.shape[:-1]) + (num_filters,)
     pre_act = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr)
     return helper.append_activation(pre_act, act)
 

@@ -44,9 +44,16 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
 
 
 def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
-                       act='sigmoid', pool_type='max'):
-    raise NotImplementedError('sequence_conv_pool: sequence ops land with '
-                              'the LoD bucketing subsystem')
+                       act='sigmoid', pool_type='max', mask=None):
+    """Reference nets.py sequence_conv_pool (context-window conv over
+    time + sequence pool).  On the padded+mask representation: pass
+    `mask` ([B, T], e.g. a BucketedGeneratorLoader '@MASK' feed or
+    layers.sequence_mask) so padded steps neither convolve nor pool."""
+    conv = layers.sequence_conv(input, num_filters,
+                                filter_size=filter_size,
+                                param_attr=param_attr, act=act,
+                                mask=mask)
+    return layers.sequence_pool(conv, pool_type, mask=mask)
 
 
 def glu(input, dim=-1):

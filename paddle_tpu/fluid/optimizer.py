@@ -658,10 +658,13 @@ class PipelineOptimizer(object):
         if self._cut_list:
             from ..parallel.program_pipeline import split_program_stages
             program = loss.block.program
-            cut_names = [v.name if hasattr(v, 'name') else v
-                         for cuts in self._cut_list for v in
-                         (cuts if isinstance(cuts, (list, tuple))
-                          else [cuts])]
+            # preserve grouping: each cut_list entry is ONE stage
+            # boundary (possibly multiple vars — multi-slot scope queue)
+            cut_groups = [
+                [v.name if hasattr(v, 'name') else v for v in
+                 (cuts if isinstance(cuts, (list, tuple)) else [cuts])]
+                for cuts in self._cut_list]
+            cut_names = [n for grp in cut_groups for n in grp]
             feeds = [v.name for v in program.global_block().vars.values()
                      if getattr(v, 'is_data', False)]
             # the pipeline input is the data var the FIRST stage reads
@@ -683,11 +686,11 @@ class PipelineOptimizer(object):
                     'an explicit input_name' % (candidates,))
             input_name = candidates[0]
             # validate the cut now so bad cut_lists fail at build
-            split_program_stages(program, input_name, cut_names,
+            split_program_stages(program, input_name, cut_groups,
                                  loss.name, allow_data_reads=True)
             program._pipeline_plan = {
                 'input': input_name, 'cuts': cut_names,
-                'output': loss.name}
+                'cut_groups': cut_groups, 'output': loss.name}
         return self._optimizer.minimize(loss, startup_program,
                                         parameter_list, no_grad_set)
 

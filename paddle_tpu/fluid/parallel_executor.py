@@ -294,6 +294,12 @@ def run_collective(executor, program, feed, fetch_list, scope,
     executor._step += 1
     fetched = {}
     batch_feeds = _batch_feed_names(program, feed)
+    if any(not isinstance(it, _Segment) for it in plan):
+        # host ops read their inputs through the scope (same contract
+        # as Executor._run_plan): make feeds visible
+        for k, v in feed.items():
+            scope.set_var(k, v.data if isinstance(v, _core.LoDTensor)
+                          else v)
     for item in plan:
         if not isinstance(item, _Segment):
             from ..ops import registry

@@ -1,9 +1,12 @@
 """DataLoader. Reference: python/paddle/fluid/reader.py —
-DataLoader.from_generator(:75) feeding a LoDTensorBlockingQueue(:298).
+DataLoader.from_generator(:75) feeding a LoDTensorBlockingQueue(:298),
+DataLoader.from_dataset(:261) over the Dataset runtime.
 
-Round-1 implementation is a synchronous host iterator; the C++
-double-buffered feeder (operators/reader/buffered_reader.cc analog)
-lands with the native runtime components.
+The LoD-replacement front-end lives here too: BucketedGeneratorLoader
+groups genuinely ragged samples into a small set of padded shapes
+("length bucketing"), so XLA compiles ONE executable per bucket —
+bounded recompiles where the reference used LoD offset vectors
+(framework/lod_tensor.h:219, operators/math/sequence_padding.h).
 """
 
 import numpy as np
@@ -15,13 +18,61 @@ class DataLoader(object):
     @staticmethod
     def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
                        iterable=True, return_list=False,
-                       use_multiprocess=False):
+                       use_multiprocess=False, bucket_boundaries=None,
+                       batch_size=None, mask_map=None, drop_last=False,
+                       ragged_fields=None):
+        """bucket_boundaries + batch_size turn the loader into the
+        bucketing front-end for variable-length data (see
+        BucketedGeneratorLoader)."""
+        if bucket_boundaries is not None:
+            if not batch_size:
+                raise ValueError('bucketed DataLoader needs batch_size')
+            return BucketedGeneratorLoader(
+                feed_list, bucket_boundaries, batch_size,
+                mask_map=mask_map, drop_last=drop_last,
+                capacity=capacity, iterable=iterable,
+                ragged_fields=ragged_fields)
         return GeneratorLoader(feed_list, capacity, iterable)
 
     @staticmethod
     def from_dataset(dataset, places, drop_last=True):
-        raise NotImplementedError('from_dataset: Dataset runtime lands '
-                                  'with the trainer subsystem')
+        """Iterate the Dataset runtime's batches (reference
+        reader.py:261 DatasetLoader over the C++ Trainer pipeline; here
+        the native feeder inside fluid.dataset does the file IO)."""
+        return DatasetLoader(dataset, places, drop_last)
+
+
+class DatasetLoader(object):
+    """Reference: reader.py:261 — iterable view over a
+    fluid.DatasetFactory dataset (QueueDataset/InMemoryDataset)."""
+
+    def __init__(self, dataset, places, drop_last=True):
+        self._dataset = dataset
+        self._places = places
+        self._drop_last = drop_last
+
+    def _batches(self):
+        full = None
+        for feed in self._dataset.batches():
+            if self._drop_last:
+                n = min(np.asarray(v).shape[0] for v in feed.values())
+                if full is None:
+                    full = n
+                elif n < full:
+                    continue  # short tail batch: shape-stable training
+            yield feed
+
+    def __iter__(self):
+        return iter(self._batches())
+
+    def start(self):
+        self._iter = iter(self._batches())
+
+    def next(self):
+        return next(self._iter)
+
+    def reset(self):
+        self._iter = iter(self._batches())
 
 
 class GeneratorLoader(object):
@@ -83,6 +134,116 @@ class GeneratorLoader(object):
         self._iter = iter(self._generator())
 
 
+
+
+class BucketedGeneratorLoader(GeneratorLoader):
+    """Length-bucketing loader for genuinely ragged samples.
+
+    Each sample is a tuple aligned with feed_list; ragged fields
+    (feed vars with lod_level > 0, or any field whose value is a
+    variable-length sequence) are padded to the sample's bucket
+    boundary — the smallest boundary >= the sample's longest ragged
+    field.  Batches are emitted per bucket once batch_size samples of
+    that bucket accumulate, so the executor sees at most
+    len(bucket_boundaries) distinct shapes and jax.jit caches one
+    executable per bucket (the recompile bound the reference got from
+    LoD + sequence_padding kernels).
+
+    For every ragged field a float mask [B, T] is emitted under
+    mask_map[name] (default '<name>@MASK' — feed vars with those names
+    pick it up; sequence ops consume it as their Mask input).
+    """
+
+    def __init__(self, feed_list, bucket_boundaries, batch_size,
+                 mask_map=None, drop_last=False, capacity=64,
+                 iterable=True, ragged_fields=None):
+        super(BucketedGeneratorLoader, self).__init__(
+            feed_list, capacity, iterable)
+        self.boundaries = sorted(int(b) for b in bucket_boundaries)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._mask_map = dict(mask_map or {})
+        if ragged_fields is None:
+            self._ragged = [getattr(v, 'lod_level', 0) > 0
+                            for v in self._feed_list]
+        else:
+            ragged_fields = set(ragged_fields)
+            self._ragged = [v.name in ragged_fields
+                            for v in self._feed_list]
+        if not any(self._ragged):
+            raise ValueError(
+                'bucketed DataLoader: no ragged fields — mark feed vars '
+                'with lod_level>0 or pass ragged_fields=[names]')
+
+    def _bucket_of(self, length):
+        for b in self.boundaries:
+            if length <= b:
+                return b
+        raise ValueError(
+            'sample length %d exceeds the largest bucket boundary %d'
+            % (length, self.boundaries[-1]))
+
+    def _mask_name(self, var):
+        return self._mask_map.get(var.name, var.name + '@MASK')
+
+    def _pad_batch(self, samples, boundary):
+        out = {}
+        for i, var in enumerate(self._feed_list):
+            col = [s[i] for s in samples]
+            if not self._ragged[i]:
+                out[var.name] = np.asarray(col)
+                continue
+            dtype = core.convert_dtype(var.dtype)
+            first = np.asarray(col[0])
+            tail_shape = first.shape[1:]
+            b = len(col)
+            padded = np.zeros((b, boundary) + tail_shape, dtype)
+            mask = np.zeros((b, boundary), 'float32')
+            for r, seq in enumerate(col):
+                seq = np.asarray(seq, dtype)
+                padded[r, :len(seq)] = seq
+                mask[r, :len(seq)] = 1.0
+            out[var.name] = padded
+            out[self._mask_name(var)] = mask
+        return out
+
+    def set_sample_list_generator(self, reader, places=None):
+        raise NotImplementedError(
+            'bucketed DataLoader consumes per-SAMPLE generators (it '
+            'forms the batches itself, one bucket at a time): use '
+            'set_sample_generator')
+
+    def set_batch_generator(self, reader, places=None):
+        raise NotImplementedError(
+            'bucketed DataLoader consumes per-SAMPLE generators (it '
+            'forms the batches itself, one bucket at a time): use '
+            'set_sample_generator')
+
+    def set_sample_generator(self, reader, batch_size=None,
+                             drop_last=None, places=None):
+        if batch_size is not None:
+            self.batch_size = batch_size
+        if drop_last is not None:
+            self.drop_last = drop_last
+
+        def gen():
+            buckets = {b: [] for b in self.boundaries}
+            for sample in reader():
+                longest = max(
+                    len(np.asarray(sample[i]))
+                    for i in range(len(self._feed_list))
+                    if self._ragged[i])
+                b = self._bucket_of(longest)
+                buckets[b].append(sample)
+                if len(buckets[b]) == self.batch_size:
+                    yield self._pad_batch(buckets[b], b)
+                    buckets[b] = []
+            if not self.drop_last:
+                for b, rest in buckets.items():
+                    if rest:
+                        yield self._pad_batch(rest, b)
+        self._generator = gen
+        return self
 
 
 class PyReader(GeneratorLoader):

@@ -6,9 +6,15 @@ collective.py (GradAllReduce:178, LocalSGD:270) inserts collective ops.
 
 TPU-native: NCCL2/collective mode maps to the shard_map collective
 runtime (the rewrite inserts c_allreduce ops exactly like the
-reference); PS mode's sparse tables map to the sharded-embedding design
-(parallel/sparse_embedding planned) — classic CPU parameter-server
-program splitting is intentionally not reproduced on TPU.
+reference).  PS mode routes to the EMBEDDED parameter-server runtime:
+there are no pserver processes — sparse lookup_table ops are rewritten
+onto host-sharded embedding tables (parallel/sparse_embedding.py, which
+shard by id across trainer processes under jax.distributed), and in
+async mode dense optimizer ops move off the trainer program onto the
+in-process store + communicator (distributed/communicator.py), exactly
+the trainer-side shape the reference transpiler produces
+(distribute_transpiler.py:634 send/recv rewrite, :1110
+get_pserver_program) with the RPC legs replaced by host collectives.
 """
 
 from .collective import GradAllReduce, LocalSGD
@@ -39,6 +45,7 @@ class DistributeTranspiler(object):
         self.config = config or DistributeTranspilerConfig()
         self._trainer_id = 0
         self._trainers = 1
+        self._startup_program = None
 
     def transpile(self, trainer_id, program=None, pservers='127.0.0.1:0',
                   trainers=1, sync_mode=True, startup_program=None,
@@ -55,26 +62,170 @@ class DistributeTranspiler(object):
             program._collective_dp = True
             self.trainer_program = program
             return
+        if mode in ('pserver', 'ps', 'geo'):
+            self._startup_program = startup_program
+            self._transpile_ps(program, sync_mode)
+            return
         raise NotImplementedError(
-            "DistributeTranspiler mode='%s': the CPU parameter-server "
-            "path is replaced on TPU by sharded embeddings + collective "
-            "dense sync; use fleet.distributed_optimizer "
-            "(incubate.fleet.collective) or mode='nccl2'" % mode)
+            "DistributeTranspiler mode='%s' is not a mode "
+            "(nccl2 | collective | pserver | geo)" % mode)
+
+    # -- embedded parameter-server rewrite --------------------------------
+    def _transpile_ps(self, program, sync_mode):
+        """Rewrite a minimized trainer program for the embedded PS
+        runtime.  Reference: DistributeTranspiler PS mode
+        (distribute_transpiler.py:634) strips optimizer ops from the
+        trainer and moves params to pservers; here:
+
+        * sparse lookup_table(is_sparse/is_distributed) ops (and their
+          grad + optimizer ops) are rewritten onto host-sharded
+          embedding tables — pull/push sparse, sharded by id across
+          processes when jax.distributed is multi-process;
+        * async mode additionally strips the dense optimizer ops and
+          routes dense grads through the AsyncCommunicator to the
+          in-process store (bounded staleness), like a transpiled async
+          trainer;
+        * sync mode keeps dense optimizer ops in-program (the embedded
+          "server" is this process; a barriered sync PS step is exactly
+          a local/allreduced update).
+        """
+        block = program.global_block()
+        self._rewrite_sparse_tables(program, block)
+        if not sync_mode:
+            self._strip_dense_optimizer(program, block)
+        self.trainer_program = program
+
+    def _rewrite_sparse_tables(self, program, block):
+        ops = list(block.ops)
+        sparse_params = []
+        for op in ops:
+            if op.type not in ('lookup_table', 'lookup_table_v2'):
+                continue
+            if not (op.attrs.get('is_sparse') or
+                    op.attrs.get('is_distributed')):
+                continue
+            wname = op.input('W')[0]
+            ids_name = op.input('Ids')[0]
+            out_name = op.output('Out')[0]
+            # forward: pull from the (lazily scope-initialized) host
+            # table so startup initialization is preserved exactly
+            op.type = 'host_emb_lookup'
+            op.inputs = {'Ids': [ids_name]}
+            op.outputs = {'Out': [out_name]}
+            op.attrs = {'table': wname, 'lazy_from_scope': True,
+                        '__op_role__': op.attrs.get('__op_role__',
+                                                    'forward'),
+                        'padding_idx': op.attrs.get('padding_idx')}
+            sparse_params.append((wname, ids_name, out_name))
+        if not sparse_params:
+            return
+        by_w = {w: (i, o) for w, i, o in sparse_params}
+        lr_by_w = {}
+        # backward: lookup_table_grad -> push sparse of the Out cotangent
+        for op in ops:
+            if op.type in ('lookup_table_grad', 'lookup_table_v2_grad'):
+                wname = op.input('W')[0]
+                if wname not in by_w:
+                    continue
+                ids_name, _ = by_w[wname]
+                cot = op.input('GRAD::Out')[0]
+                op.type = 'host_emb_update'
+                op.inputs = {'Ids': [ids_name], 'Grad': [cot]}
+                op.outputs = {}
+                op.attrs = {'table': wname, '__op_role__': 'backward'}
+        # optimizer ops for the table move into the push (per-row sgd)
+        keep = []
+        for op in block.ops:
+            if op.attrs.get('__op_role__') == 'optimize' and \
+                    op.input('Param') and op.input('Param')[0] in by_w:
+                lr_by_w[op.input('Param')[0]] = \
+                    self._read_lr(program, op)
+                continue
+            keep.append(op)
+        block.ops[:] = keep
+        program._host_emb_lr = lr_by_w
+        program._bump_version()
+
+    def _strip_dense_optimizer(self, program, block):
+        """Async mode: dense updates move to the embedded server
+        (reference async trainer: grads sent to pservers, params
+        recv'd — operators/distributed/communicator.h:175)."""
+        pairs = []
+        lr = None
+        keep = []
+        for op in block.ops:
+            if op.attrs.get('__op_role__') == 'optimize' and \
+                    op.input('Param'):
+                if op.type != 'sgd':
+                    raise NotImplementedError(
+                        'embedded async PS applies updates with the SGD '
+                        'rule (DownpourSGD analog); transpile a program '
+                        'minimized with SGD, or use sync_mode=True')
+                pairs.append((op.input('Param')[0], op.input('Grad')[0]))
+                lr = self._read_lr(program, op)
+                continue
+            keep.append(op)
+        block.ops[:] = keep
+        if not pairs:
+            return
+        from ..incubate.fleet.parameter_server import fleet as ps_fleet
+        ps_fleet._optimizer = _TranspiledHolder(lr if lr is not None
+                                                else 0.01)
+        program._ps_async = {'pairs': pairs, 'fleet': ps_fleet}
+        program._extra_output_names = set(
+            getattr(program, '_extra_output_names', ())) | set(
+            g for _, g in pairs)
+        program._bump_version()
+
+    def _read_lr(self, program, op):
+        """Recover the constant learning rate feeding an optimizer op:
+        the var is filled by a fill_constant in the main program (LR
+        schedules) or, for a constant rate, in the startup program."""
+        names = op.input('LearningRate')
+        if not names:
+            return None
+        from .. import framework
+        progs = [program]
+        if self._startup_program is not None:
+            progs.append(self._startup_program)
+        else:
+            progs.append(framework.default_startup_program())
+        for p in progs:
+            for o in p.global_block().ops:
+                if o.type == 'fill_constant' and \
+                        o.output('Out') and o.output('Out')[0] == names[0]:
+                    return float(o.attrs.get('value', 0.01))
+        return None
 
     def get_trainer_program(self, wait_port=True):
         return self.trainer_program
 
     def get_pserver_program(self, endpoint):
-        raise NotImplementedError(
-            'no parameter servers on TPU; see transpile() notes')
+        """Embedded runtime: the server lives inside the trainer
+        process, so the 'pserver program' is an empty no-op program —
+        reference scripts that run it on PSERVER roles return
+        immediately instead of blocking in listen_and_serv."""
+        from .. import framework
+        prog = framework.Program()
+        prog._embedded_ps = True
+        return prog
 
     def get_pserver_programs(self, endpoint):
-        raise NotImplementedError(
-            'no parameter servers on TPU; see transpile() notes')
+        return [self.get_pserver_program(endpoint)]
 
     def get_startup_program(self, endpoint, pserver_program=None):
-        raise NotImplementedError(
-            'no parameter servers on TPU; see transpile() notes')
+        from .. import framework
+        prog = framework.Program()
+        prog._embedded_ps = True
+        return prog
+
+
+class _TranspiledHolder(object):
+    """Minimal optimizer stand-in carrying the server lr for
+    ps_async_step/init_server (fleet normally stores its own)."""
+
+    def __init__(self, lr):
+        self._server_lr = lr
 
 
 class HashName(object):

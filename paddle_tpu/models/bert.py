@@ -16,7 +16,7 @@ from paddle_tpu.fluid import layers
 class BertConfig(object):
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  intermediate=3072, max_pos=512, type_vocab=2,
-                 dropout=0.1):
+                 dropout=0.1, attn_dropout=None, use_flash=True):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -25,6 +25,18 @@ class BertConfig(object):
         self.max_pos = max_pos
         self.type_vocab = type_vocab
         self.dropout = dropout
+        # dropout on the attention probabilities: incompatible with the
+        # flash kernel (the probs never materialize) — set to 0 to take
+        # the flash path in training
+        self.attn_dropout = dropout if attn_dropout is None \
+            else attn_dropout
+        self.use_flash = use_flash
+        # measured on one v5e-class chip (BENCHMARKS.md): the batched
+        # XLA chain wins at seq<=512 (d=64 per-head blocks underfill
+        # the MXU in the blockwise kernel) and ties at 2048 — where
+        # flash's value is MEMORY: no [T,T] probs in HBM, so long
+        # contexts fit (and compose with ring attention)
+        self.flash_min_len = 1024
 
 
 BASE = BertConfig()
@@ -32,13 +44,45 @@ TINY = BertConfig(vocab_size=1000, hidden=64, layers=2, heads=4,
                   intermediate=128, max_pos=128)
 
 
-def multi_head_attention(x, attn_bias, cfg, is_test):
+def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None):
     """Self-attention: fused QKV projection -> scaled dot product ->
-    output projection."""
+    output projection.  When the config allows it (no attention-probs
+    dropout needed) the scaled-dot-product chain runs as ONE Pallas
+    flash-attention kernel fwd+bwd (ops/pallas/flash_attention.py) —
+    the reference's multihead_matmul fusion
+    (operators/fused/multihead_matmul_op.cu), TPU-style."""
     h, heads = cfg.hidden, cfg.heads
     d = h // heads
     qkv = layers.fc(x, size=3 * h, num_flatten_dims=2)
     q, k, v = layers.split(qkv, 3, dim=2)
+
+    seq_len = x.shape[1] if len(x.shape) >= 2 else 0
+    use_flash = getattr(cfg, 'use_flash', False) and \
+        (is_test or not getattr(cfg, 'attn_dropout', cfg.dropout)) and \
+        (seq_len is None or seq_len < 0 or
+         seq_len >= getattr(cfg, 'flash_min_len', 1024)) and \
+        (attn_bias is None or key_bias is not None)
+    # the flash kernel consumes the [B, T] key_bias form only: with a
+    # general attn_bias and no key_bias we must keep the naive chain
+    # rather than silently dropping the mask
+    if use_flash:
+        from ..fluid.layer_helper import LayerHelper
+
+        def to_bthd(t):
+            return layers.reshape(t, [0, 0, heads, d])
+
+        q3, k3, v3 = to_bthd(q), to_bthd(k), to_bthd(v)
+        helper = LayerHelper('fused_multihead_attention')
+        out = helper.create_variable_for_type_inference(x.dtype)
+        inputs = {'Q': q3, 'K': k3, 'V': v3}
+        if key_bias is not None:
+            inputs['KeyBias'] = key_bias
+        helper.append_op('fused_multihead_attention', inputs=inputs,
+                         outputs={'Out': out},
+                         attrs={'causal': False}, infer_shape=False)
+        out.shape = tuple(q3.shape)
+        ctx = layers.reshape(out, [0, 0, h])
+        return layers.fc(ctx, size=h, num_flatten_dims=2)
 
     def to_heads(t):
         t = layers.reshape(t, [0, 0, heads, d])
@@ -49,8 +93,10 @@ def multi_head_attention(x, attn_bias, cfg, is_test):
     if attn_bias is not None:
         scores = layers.elementwise_add(scores, attn_bias)
     probs = layers.softmax(scores)
-    if not is_test and cfg.dropout:
-        probs = layers.dropout(probs, cfg.dropout, is_test=is_test,
+    if not is_test and getattr(cfg, 'attn_dropout', cfg.dropout):
+        probs = layers.dropout(probs,
+                               getattr(cfg, 'attn_dropout', cfg.dropout),
+                               is_test=is_test,
                                dropout_implementation='upscale_in_train')
     ctx = layers.matmul(probs, v)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
@@ -58,8 +104,9 @@ def multi_head_attention(x, attn_bias, cfg, is_test):
     return layers.fc(ctx, size=h, num_flatten_dims=2)
 
 
-def encoder_layer(x, attn_bias, cfg, is_test):
-    attn = multi_head_attention(x, attn_bias, cfg, is_test)
+def encoder_layer(x, attn_bias, cfg, is_test, key_bias=None):
+    attn = multi_head_attention(x, attn_bias, cfg, is_test,
+                                key_bias=key_bias)
     if not is_test and cfg.dropout:
         attn = layers.dropout(attn, cfg.dropout, is_test=is_test,
                               dropout_implementation='upscale_in_train')
@@ -85,13 +132,13 @@ def bert_encoder(src_ids, pos_ids, sent_ids, input_mask, cfg,
     if not is_test and cfg.dropout:
         x = layers.dropout(x, cfg.dropout, is_test=is_test,
                            dropout_implementation='upscale_in_train')
-    # [B, T] mask -> additive bias [B, 1, 1, T]: 0 where attended,
-    # -10000 where padded
-    bias = layers.scale(
-        layers.unsqueeze(layers.unsqueeze(input_mask, [1]), [1]),
-        scale=10000.0, bias=-10000.0)
+    # [B, T] mask -> additive bias: 0 where attended, -10000 where
+    # padded.  The flash path consumes the [B, T] form directly; the
+    # naive chain broadcasts the [B, 1, 1, T] form over heads/rows.
+    key_bias = layers.scale(input_mask, scale=10000.0, bias=-10000.0)
+    bias = layers.unsqueeze(layers.unsqueeze(key_bias, [1]), [1])
     for _ in range(cfg.layers):
-        x = encoder_layer(x, bias, cfg, is_test)
+        x = encoder_layer(x, bias, cfg, is_test, key_bias=key_bias)
     return x
 
 

@@ -82,10 +82,20 @@ def _pos_encoding(seq_len, d_model):
 
 
 def _embed(ids, vocab, seq_len, cfg, is_test):
+    from ..fluid.layer_helper import LayerHelper
     emb = layers.embedding(ids, size=[vocab, cfg.d_model])
     emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
-    pe = layers.assign(_pos_encoding(seq_len, cfg.d_model))
-    x = layers.elementwise_add(emb, layers.unsqueeze(pe, [0]))
+    # trace-time position encoding: sized from the RUNTIME sequence
+    # length, so one program serves every length bucket
+    # (reader.BucketedGeneratorLoader) with one executable per bucket
+    helper = LayerHelper('position_encoding')
+    pe = helper.create_variable_for_type_inference(emb.dtype)
+    pe.stop_gradient = True
+    helper.append_op('position_encoding', inputs={'X': emb},
+                     outputs={'Out': pe},
+                     attrs={'d_model': cfg.d_model}, infer_shape=False)
+    pe.shape = (1, seq_len, cfg.d_model)
+    x = layers.elementwise_add(emb, pe)
     if not is_test and cfg.dropout:
         x = layers.dropout(x, cfg.dropout, is_test=is_test,
                            dropout_implementation='upscale_in_train')
@@ -99,10 +109,17 @@ def _pad_bias(mask):
         scale=10000.0, bias=-10000.0)
 
 
-def _causal_bias(seq_len):
-    m = np.triu(np.full((seq_len, seq_len), -1e9, np.float32), k=1)
-    b = layers.assign(m)
-    return layers.unsqueeze(layers.unsqueeze(b, [0]), [0])
+def _causal_bias(x, seq_len):
+    """Additive causal bias sized from x's runtime length (bucketed
+    batches re-trace per length; see _embed)."""
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper('causal_mask_like')
+    b = helper.create_variable_for_type_inference(x.dtype)
+    b.stop_gradient = True
+    helper.append_op('causal_mask_like', inputs={'X': x},
+                     outputs={'Out': b}, infer_shape=False)
+    b.shape = (1, 1, seq_len, seq_len)
+    return b
 
 
 def encoder(src_ids, src_mask, seq_len, cfg, is_test=False):
@@ -116,7 +133,7 @@ def encoder(src_ids, src_mask, seq_len, cfg, is_test=False):
 
 def decoder(tgt_ids, enc_out, enc_bias, tgt_len, cfg, is_test=False):
     x = _embed(tgt_ids, cfg.tgt_vocab, tgt_len, cfg, is_test)
-    self_bias = _causal_bias(tgt_len)
+    self_bias = _causal_bias(x, tgt_len)
     for _ in range(cfg.dec_layers):
         x = _add_norm(x, _attention(x, x, self_bias, cfg, is_test))
         x = _add_norm(x, _attention(x, enc_out, enc_bias, cfg, is_test))

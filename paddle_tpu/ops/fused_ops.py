@@ -14,14 +14,17 @@ from .registry import register
 
 @register('fused_multihead_attention')
 def fused_multihead_attention(ctx, ins, attrs):
-    """Q,K,V: [B, T, H, D] -> Out [B, T, H, D] via the Pallas flash
-    attention kernel (interpret mode off-TPU)."""
+    """Q,K,V: [B, T, H, D] (+ optional KeyBias [B, T] additive score
+    bias, e.g. a padding mask) -> Out [B, T, H, D] via the Pallas flash
+    attention kernels, forward and backward (interpret mode off-TPU)."""
     from .pallas.flash_attention import flash_attention
     q = ins['Q'][0]
     k = ins['K'][0]
     v = ins['V'][0]
+    bias = ins['KeyBias'][0] if ins.get('KeyBias') else None
     return {'Out': [flash_attention(q, k, v,
-                                    causal=attrs.get('causal', False))]}
+                                    causal=attrs.get('causal', False),
+                                    key_bias=bias)]}
 
 
 @register('fused_elemwise_activation')

@@ -370,15 +370,26 @@ def nce(ctx, ins, attrs):
     num_true = label.shape[1]
     num_neg = int(attrs.get('num_neg_samples', 10))
     sampler = attrs.get('sampler', 'uniform')
-    if sampler not in ('uniform', 0):
+    custom_dist = attrs.get('custom_dist')
+    key = ctx.rng(salt=1 + int(attrs.get('seed', 0) or 0))
+    if custom_dist is not None and sampler in ('custom_dist', 2):
+        # custom negative-sampling distribution (reference nce_op.h
+        # CustomSampler built from alias tables; on TPU one categorical
+        # draw over log-probs does the same job)
+        dist = jnp.asarray(np.asarray(custom_dist, np.float32))
+        dist = dist / jnp.sum(dist)
+        logp = jnp.log(jnp.maximum(dist, 1e-30))
+        neg = jax.random.categorical(key, logp[None, :],
+                                     shape=(b, num_neg)).astype(jnp.int32)
+        p_of = lambda ids: dist[ids]
+    elif sampler in ('uniform', 0, None):
+        neg = jax.random.randint(key, (b, num_neg), 0, v,
+                                 dtype=jnp.int32)
+        p_of = lambda ids: jnp.full(ids.shape, 1.0 / v, jnp.float32)
+    else:
         raise NotImplementedError(
-            'nce: only the uniform sampler is implemented (got %r)'
-            % (sampler,))
-
-    neg = jax.random.randint(
-        ctx.rng(salt=1 + int(attrs.get('seed', 0) or 0)),
-        (b, num_neg), 0, v, dtype=jnp.int32)
-    p_uniform = 1.0 / v
+            'nce: sampler %r is not implemented (uniform | '
+            'custom_dist)' % (sampler,))
 
     def logits_of(ids):
         wl = w[ids]                                  # [B, K, D]
@@ -387,10 +398,9 @@ def nce(ctx, ins, attrs):
             z = z + bias[ids]
         return z
 
-    # logit - log(num_neg * P(w)): NCE's unigram correction
-    corr = jnp.log(num_neg * p_uniform)
-    z_true = logits_of(label) - corr
-    z_neg = logits_of(neg) - corr
+    # logit - log(num_neg * P(w)): NCE's sampling correction
+    z_true = logits_of(label) - jnp.log(num_neg * p_of(label))
+    z_neg = logits_of(neg) - jnp.log(num_neg * p_of(neg))
     pos_loss = jnp.sum(jax.nn.softplus(-z_true), axis=1)
     neg_loss = jnp.sum(jax.nn.softplus(z_neg), axis=1)
     cost = (pos_loss + neg_loss) / num_true

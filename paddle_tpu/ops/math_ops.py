@@ -33,11 +33,10 @@ def matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ty:
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    if attrs.get('__amp__') and x.dtype == jnp.float32:
-        # AMP: uniform bf16 matmul (f32 MXU accumulation internally),
-        # cast back — keeps the dot transpose rule dtype-consistent
-        out = jnp.matmul(x.astype(jnp.bfloat16),
-                         y.astype(jnp.bfloat16)).astype(jnp.float32)
+    if attrs.get('__amp__') and x.dtype in (jnp.float32, jnp.bfloat16):
+        # AMP: bf16 matmul (f32 MXU accumulation internally); the bf16
+        # output propagates so downstream activations stay bf16 in HBM
+        out = jnp.matmul(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
     else:
         out = jnp.matmul(x, y, precision=jax.lax.Precision.HIGHEST
                          if x.dtype == jnp.float32 else None)
@@ -64,9 +63,8 @@ def mul(ctx, ins, attrs):
     xs, ys = x.shape, y.shape
     x2 = x.reshape(int(np.prod(xs[:xn])), -1)
     y2 = y.reshape(int(np.prod(ys[:yn])), -1)
-    if attrs.get('__amp__') and x.dtype == jnp.float32:
-        out = jnp.matmul(x2.astype(jnp.bfloat16),
-                         y2.astype(jnp.bfloat16)).astype(jnp.float32)
+    if attrs.get('__amp__') and x.dtype in (jnp.float32, jnp.bfloat16):
+        out = jnp.matmul(x2.astype(jnp.bfloat16), y2.astype(jnp.bfloat16))
     else:
         out = jnp.matmul(x2, y2, precision=jax.lax.Precision.HIGHEST
                          if x.dtype == jnp.float32 else None)

@@ -273,3 +273,31 @@ def deformable_roi_pooling(ctx, ins, attrs):
     outs = jax.vmap(one, in_axes=(0, 0, 0))(
         rois.reshape(-1, 4), jnp.arange(rois.shape[0]), batch_idx)
     return {'Output': [outs], 'TopCount': [jnp.ones_like(outs)]}
+
+
+@register('position_encoding')
+def position_encoding(ctx, ins, attrs):
+    """Sinusoidal position encoding sized from X's runtime sequence
+    length ([B, T, D] -> [1, T, D]).  Trace-time shape derivation is
+    what makes the Transformer shape-polymorphic across length buckets
+    (the LoD-replacement design: reader.BucketedGeneratorLoader); the
+    reference computed it host-side per LoD batch."""
+    x = ins['X'][0]
+    t = x.shape[1]
+    d = attrs['d_model']
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, (2.0 * jnp.floor(i / 2.0)) / d)
+    pe = jnp.where((jnp.arange(d) % 2 == 0)[None, :],
+                   jnp.sin(angle), jnp.cos(angle))
+    return {'Out': [pe[None].astype(x.dtype)]}
+
+
+@register('causal_mask_like')
+def causal_mask_like(ctx, ins, attrs):
+    """[B, T, D] -> additive causal bias [1, 1, T, T] sized from X's
+    runtime sequence length (see position_encoding)."""
+    x = ins['X'][0]
+    t = x.shape[1]
+    m = jnp.triu(jnp.full((t, t), -1e9, jnp.float32), k=1)
+    return {'Out': [m[None, None].astype(x.dtype)]}

@@ -51,10 +51,13 @@ def conv2d(ctx, ins, attrs):
     pad = _conv_padding(attrs.get('paddings', [0, 0]),
                         attrs.get('padding_algorithm', 'EXPLICIT'),
                         w.shape[-2:], strides, dilations)
-    amp = attrs.get('__amp__') and x.dtype == jnp.float32
+    amp = attrs.get('__amp__') and x.dtype in (jnp.float32, jnp.bfloat16)
     if amp:
-        # uniform bf16 in AND out: keeps the conv transpose (vjp) rule
-        # dtype-consistent; the MXU still accumulates in f32 internally
+        # bf16 in AND out: the MXU accumulates in f32 internally, and the
+        # bf16 output propagates through the gray-list tail (batch_norm,
+        # relu, add, pool all follow their input dtype) so activations
+        # stay bf16 in HBM end-to-end — black-list ops cast up to f32
+        # themselves
         x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
@@ -64,7 +67,9 @@ def conv2d(ctx, ins, attrs):
                    if x.dtype == jnp.float32 else None),
         preferred_element_type=None if amp else (
             jnp.float32 if x.dtype != jnp.float64 else None))
-    return {'Output': [out.astype(ins['Input'][0].dtype)]}
+    if not amp:
+        out = out.astype(ins['Input'][0].dtype)
+    return {'Output': [out]}
 
 
 @register('depthwise_conv2d')
@@ -108,6 +113,33 @@ def pool2d(ctx, ins, attrs):
         else:
             out = jnp.mean(x, axis=hw, keepdims=True)
         return {'Out': [out]}
+    if attrs.get('adaptive', False):
+        # arbitrary output grid: window i spans [floor(i*H/oh),
+        # ceil((i+1)*H/oh)) (reference operators/pool_op.h AdaptStart/
+        # AdaptEnd); oh/ow are static so the windows unroll at trace
+        # time into oh*ow fused reductions
+        oh, ow = ksize
+        hdim, wdim = hw
+        h_in, w_in = x.shape[hdim], x.shape[wdim]
+        red = jnp.max if ptype == 'max' else jnp.mean
+        rows = []
+        for i in range(oh):
+            cols = []
+            hs = (i * h_in) // oh
+            he = -(-((i + 1) * h_in) // oh)
+            for j in range(ow):
+                ws = (j * w_in) // ow
+                we = -(-((j + 1) * w_in) // ow)
+                win = jax.lax.slice_in_dim(
+                    jax.lax.slice_in_dim(x, hs, he, axis=hdim),
+                    ws, we, axis=wdim)
+                cols.append(red(win, axis=(hdim, wdim)))
+            rows.append(jnp.stack(cols, axis=-1))
+        out = jnp.stack(rows, axis=-2)  # [..., oh, ow] on trailing dims
+        if nchw:
+            return {'Out': [out]}
+        # NHWC: moved pooled dims to the end; restore channel-last
+        return {'Out': [jnp.moveaxis(out, 1, -1)]}
     window = [1, 1, 1, 1]
     stride4 = [1, 1, 1, 1]
     pad4 = [(0, 0)] * 4
@@ -160,8 +192,14 @@ def batch_norm(ctx, ins, attrs):
         m, v = mean, var
         saved_m, saved_v = mean, var
     else:
-        m = jnp.mean(xf, axis=red)
-        v = jnp.var(xf, axis=red)
+        # one-pass statistics: E[x] and E[x^2] reduce in a single fused
+        # multi-output pass over x (jnp.mean + jnp.var would read the
+        # conv output twice — measurable at 128x56x56x256)
+        cnt = float(np.prod([x.shape[i] for i in red]))
+        s1 = jnp.sum(xf, axis=red)
+        s2 = jnp.sum(xf * xf, axis=red)
+        m = s1 / cnt
+        v = jnp.maximum(s2 / cnt - m * m, 0.0)
         saved_m, saved_v = m, v
     inv = jax.lax.rsqrt(v.astype(jnp.float32) + eps)
     y = (xf - m.reshape(bshape)) * inv.reshape(bshape)

@@ -1,14 +1,23 @@
-"""Flash attention forward as a Pallas TPU kernel.
+"""Flash attention forward AND backward as Pallas TPU kernels.
 
 Replaces the reference's fused attention chain
 (operators/fused/multihead_matmul_op.cu: QK^T -> softmax -> PV as cuBLAS
-+ custom softmax kernels) with one online-softmax kernel: Q blocks ride
-the MXU against K/V blocks streamed through VMEM; no [T, T] score matrix
++ custom softmax kernels) with online-softmax kernels: Q blocks ride the
+MXU against K/V blocks streamed through VMEM; no [T, T] score matrix
 ever materializes in HBM.
 
-Backward uses custom_vjp with recomputation lowered to XLA (flash-bwd
-Pallas kernel is a follow-up); on non-TPU platforms the kernel runs in
-interpreter mode so tests cover it everywhere.
+Backward is the standard two-pass flash scheme wired through custom_vjp:
+the forward additionally emits the per-row log-sum-exp (lse); backward
+precomputes delta = rowsum(dO * O), then one kernel recomputes p blocks
+to accumulate dQ (grid over Q blocks) and a second accumulates dK/dV
+(+ the key-bias gradient) with a grid over K blocks.
+
+An optional additive key bias [B, T] (padding masks, per-key biases)
+is applied to the scores inside the kernels — the BERT input-mask path
+(models/bert.py) — and receives a real gradient so learned biases work.
+
+On non-TPU platforms the kernels run in interpreter mode so tests cover
+them everywhere.
 """
 
 import functools
@@ -21,9 +30,15 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
-                      block_k):
-    # q_ref: [1, bq, d]; k_ref/v_ref: [1, T, d]; o_ref: [1, bq, d]
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                      block_k, has_bias):
+    if has_bias:
+        bias_ref, o_ref, lse_ref = rest
+    else:
+        bias_ref, (o_ref, lse_ref) = None, rest
+    # q_ref: [1, bq, d]; k/v_ref: [1, T, d]; bias_ref: [1, 1, T];
+    # o_ref: [1, bq, d]; lse_ref: [1, 1, bq]  (the singleton middle dim
+    # satisfies the TPU block-shape rule for 1-D-per-row operands)
     q = q_ref[0].astype(jnp.float32)
     bq, d = q.shape
     t = k_ref.shape[1]
@@ -40,6 +55,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * scale
+        if has_bias:
+            bias = bias_ref[0, 0, pl.dslice(i * block_k,
+                                            block_k)].astype(jnp.float32)
+            s = s + bias[None, :]
         if causal:
             qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq,
                                                                 block_k),
@@ -68,8 +87,135 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
     else:
         nk_eff = nk
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-20)[:, None]
+    l_safe = jnp.maximum(l, 1e-20)
+    out = acc / l_safe[:, None]
     o_ref[0] = out.astype(o_ref.dtype)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    lse_ref[0, 0] = (m_safe + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                         block_k, has_bias):
+    if has_bias:
+        bias_ref, do_ref, lse_ref, delta_ref, dq_ref = rest
+    else:
+        bias_ref = None
+        do_ref, lse_ref, delta_ref, dq_ref = rest
+    """Grid (BH, T/bq): recompute p row-blocks from q and lse, then
+    dq = sum_k (p * (dO V^T - delta)) K * scale."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0].astype(jnp.float32)
+    delta = delta_ref[0, 0].astype(jnp.float32)
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    q_off = pl.program_id(1) * bq
+    nk = t // block_k
+
+    def body(i, dq):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(
+            jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale
+        if has_bias:
+            bias = bias_ref[0, 0, pl.dslice(i * block_k,
+                                            block_k)].astype(jnp.float32)
+            s = s + bias[None, :]
+        if causal:
+            qpos = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s),
+                      jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last = (q_off + bq + block_k - 1) // block_k
+        nk_eff = jnp.minimum(nk, last)
+    else:
+        nk_eff = nk
+    dq = jax.lax.fori_loop(0, nk_eff, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                          block_q, has_bias):
+    if has_bias:
+        (bias_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dbias_ref) = rest
+    else:
+        bias_ref = dbias_ref = None
+        do_ref, lse_ref, delta_ref, dk_ref, dv_ref = rest
+    """Grid (BH, T/bk): for one K/V block, stream Q row-blocks:
+    dv = sum_q p^T dO;  ds_raw = p * (dO V^T - delta);
+    dk = sum_q ds_raw^T Q * scale;  dbias = sum_q ds_raw (per key)."""
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bias = bias_ref[0, 0].astype(jnp.float32) if has_bias else None
+    bk, d = k.shape
+    t = q_ref.shape[1]
+    k_off = pl.program_id(1) * bk
+    nq = t // block_q
+
+    def body(j, carry):
+        dk, dv, dbias = carry
+        q = q_ref[0, pl.dslice(j * block_q, block_q), :].astype(
+            jnp.float32)
+        do = do_ref[0, pl.dslice(j * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
+            jnp.float32)
+        delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale
+        if has_bias:
+            s = s + bias[None, :]
+        if causal:
+            qpos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            kpos = k_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s),
+                      jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds_raw = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds_raw, q, (((0,), (0,)), ((), ()))) * scale
+        if has_bias:
+            dbias = dbias + jnp.sum(ds_raw, axis=0)
+        return dk, dv, dbias
+
+    if causal:
+        # q blocks strictly above the diagonal contribute nothing
+        j0 = k_off // block_q
+    else:
+        j0 = 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    db0 = jnp.zeros((bk,), jnp.float32)
+    dk, dv, dbias = jax.lax.fori_loop(j0, nq, body, (dk0, dv0, db0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if has_bias:
+        dbias_ref[0, 0] = dbias.astype(dbias_ref.dtype)
 
 
 def _on_tpu():
@@ -80,31 +226,142 @@ def _on_tpu():
         return False
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D]."""
-    bh, t, d = q.shape
+def _block_sizes(t, block_q, block_k):
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     while t % block_q:
         block_q //= 2
     while t % block_k:
         block_k //= 2
+    return block_q, block_k
+
+
+def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D], bias: [B, T] or None
+    -> (o [BH,T,D], lse [BH,T])."""
+    bh, t, d = q.shape
+    block_q, block_k = _block_sizes(t, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
+    has_bias = bias is not None
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
-                               causal=causal, block_k=block_k)
+                               causal=causal, block_k=block_k,
+                               has_bias=has_bias)
     grid = (bh, t // block_q)
-    return pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+    ]
+    operands = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, t),
+                                     lambda i, j: (i // h, 0, 0)))
+        operands.append(bias[:, None, :])
+    o, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=in_specs,
+        out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+    return o, lse3[:, 0, :]
+
+
+def _flash_bwd(q, k, v, bias, o, lse, do, h, causal, block_q, block_k,
+               interpret):
+    bh, t, d = q.shape
+    block_q, block_k = _block_sizes(t, block_q, block_k)
+    scale = 1.0 / (d ** 0.5)
+    # delta = rowsum(dO * O): one fused elementwise+reduce in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+    has_bias = bias is not None
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                                  causal=causal, block_k=block_k,
+                                  has_bias=has_bias)
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+    ]
+    dq_operands = [q, k, v]
+    if has_bias:
+        dq_specs.append(pl.BlockSpec((1, 1, t),
+                                     lambda i, j: (i // h, 0, 0)))
+        dq_operands.append(bias[:, None, :])
+    dq_specs += [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+    ]
+    dq_operands += [do, lse3, delta3]
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, t // block_q),
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(*dq_operands)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=block_q,
+                                   has_bias=has_bias)
+    dkv_specs = [
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+    ]
+    dkv_operands = [q, k, v]
+    if has_bias:
+        dkv_specs.append(pl.BlockSpec((1, 1, block_k),
+                                      lambda i, j: (i // h, 0, j)))
+        dkv_operands.append(bias[:, None, :])
+    dkv_specs += [
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
+    ]
+    dkv_operands += [do, lse3, delta3]
+    out_specs = [
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    if has_bias:
+        out_specs.append(pl.BlockSpec((1, 1, block_k),
+                                      lambda i, j: (i, 0, j)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, t), jnp.float32))
+    res = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, t // block_k),
+        in_specs=dkv_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*dkv_operands)
+    if has_bias:
+        dk, dv, dbias_bh = res
+        # bias is per (batch, key): sum head lanes
+        b = bh // h
+        dbias = dbias_bh.reshape(b, h, t).sum(axis=1)
+    else:
+        dk, dv = res
+        dbias = None
+    return dq, dk, dv, dbias
 
 
 def _dense_reference(q, k, v, causal):
@@ -120,34 +377,43 @@ def _dense_reference(q, k, v, causal):
         q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, bias, h, causal):
     interpret = not _on_tpu()
-    return _flash_fwd(q, k, v, causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-                      interpret)
+    o, _ = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
+                      DEFAULT_BLOCK_K, interpret)
+    return o
 
 
-def _flash_fwd_rule(q, k, v, causal):
-    out = _flash(q, k, v, causal)
-    return out, (q, k, v)
+def _flash_fwd_rule(q, k, v, bias, h, causal):
+    interpret = not _on_tpu()
+    o, lse = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
+                        DEFAULT_BLOCK_K, interpret)
+    return o, (q, k, v, bias, o, lse)
 
 
-def _flash_bwd_rule(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+def _flash_bwd_rule(h, causal, res, g):
+    q, k, v, bias, o, lse = res
+    interpret = not _on_tpu()
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g, h, causal,
+                                   DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                   interpret)
+    return dq, dk, dv, (None if bias is None
+                        else dbias.astype(bias.dtype))
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, causal=False):
-    """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+def flash_attention(q, k, v, causal=False, key_bias=None):
+    """q,k,v: [B, T, H, D]; key_bias: optional [B, T] additive score
+    bias (e.g. padding mask as 0 / -10000) -> [B, T, H, D]."""
     b, t, h, d = q.shape
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal)
+    if key_bias is not None:
+        key_bias = key_bias.astype(jnp.float32)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), key_bias, h, causal)
     return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))

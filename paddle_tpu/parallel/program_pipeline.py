@@ -3,7 +3,8 @@ variables into per-device stages and train with the GPipe schedule.
 
 Reference: PipelineOptimizer cut_list (python/paddle/fluid/
 optimizer.py:3311) slices the ProgramDesc into sections executed by
-SectionWorker threads over scope queues (framework/pipeline_trainer.cc).
+SectionWorker threads over scope queues (framework/pipeline_trainer.cc:
+26-47 — the scope queue carries EVERY variable a later section reads).
 
 TPU-native re-design: the cut produces per-stage jax closures over the
 program's op lowerings; the GPipe schedule runs inside one shard_map
@@ -12,11 +13,18 @@ and activations hop via ppermute (parallel/pipeline.py).  The loss is
 applied OUTSIDE the pipelined region (labels never enter the ring), so
 jax.grad reverses the whole pipeline automatically.
 
-Restrictions (validated with clear errors):
-- every cut activation must share one shape/dtype (the classic GPipe
-  rotating-buffer restriction);
-- each stage may read exactly one upstream activation: the previous cut
-  (no skip connections across stage boundaries).
+The ring buffer is a DICT of boundary activations (the scope-queue
+analog): each boundary may carry MULTIPLE cut vars, of different
+shapes/dtypes, and an activation produced in an early stage rides the
+ring until its consuming stage — skip connections across stage
+boundaries just work.  Per-boundary shapes come from chaining the
+stages once under jax.eval_shape.
+
+Remaining restrictions (validated with clear errors):
+- feed vars other than the pipeline input must not be read inside the
+  pipelined region (apply the loss outside via build_train_step);
+- a parameter may be read by exactly one stage (no cross-stage weight
+  sharing).
 """
 
 import numpy as np
@@ -27,12 +35,20 @@ from jax.sharding import PartitionSpec as P
 from ..ops import registry
 
 
-def split_program_stages(program, input_name, cut_var_names,
-                         output_name, allow_data_reads=False):
+def _cut_groups(cut_list):
+    return [[c] if isinstance(c, str) else list(c) for c in cut_list]
+
+
+def split_program_stages(program, input_name, cut_list, output_name,
+                         allow_data_reads=False):
     """Slice the program's device ops into stages at the producers of
-    `cut_var_names`.  Returns (stage_fns, stage_param_names):
-    stage_fns[s](params_dict, x) -> y closures over the op lowerings.
+    `cut_list` (each entry a var name or a LIST of var names cut at one
+    boundary).  Returns (raw_fns, stage_param_names, alive, union_keys):
+    raw_fns[s](params_dict, in_dict, step) -> dict of the boundary vars
+    stage s produces (+ output_name for the last stage).
+    alive[s] = boundary vars entering stage s.
     """
+    groups = _cut_groups(cut_list)
     block = program.global_block()
     fwd_ops = []
     for op in block.ops:
@@ -47,70 +63,96 @@ def split_program_stages(program, input_name, cut_var_names,
         raise ValueError('output %r is not produced by the program'
                          % output_name)
 
+    # stage boundaries: stage s ends at the LAST producer among its
+    # cut group
+    producer_idx = {}
+    for i, op in enumerate(fwd_ops):
+        for n in op.output_arg_names:
+            producer_idx.setdefault(n, i)
+    ends = []
+    prev = -1
+    for g, grp in enumerate(groups):
+        idxs = []
+        for n in grp:
+            if n not in producer_idx:
+                raise ValueError('cut var %r is not produced before %r'
+                                 % (n, output_name))
+            idxs.append(producer_idx[n])
+        e = max(idxs)
+        if e <= prev:
+            raise ValueError(
+                'cut group %d (%r) is not strictly after group %d'
+                % (g, grp, g - 1))
+        ends.append(e)
+        prev = e
     stages = []
-    cur = []
-    cuts = list(cut_var_names)
-    for op in fwd_ops:
-        cur.append(op)
-        if cuts and cuts[0] in op.output_arg_names:
-            stages.append(cur)
-            cur = []
-            cuts.pop(0)
-    if cuts:
-        raise ValueError('cut vars %r are not produced before %r'
-                         % (cuts, output_name))
-    stages.append(cur)
+    start = 0
+    for e in ends:
+        stages.append(fwd_ops[start:e + 1])
+        start = e + 1
+    stages.append(fwd_ops[start:])
+    n_stages = len(stages)
 
-    boundaries = [input_name] + list(cut_var_names)
     persistable = set()
     for v in (block._find_var_recursive(n) for op in fwd_ops
               for n in op.input_arg_names):
         if v is not None and getattr(v, 'persistable', False):
             persistable.add(v.name)
 
-    stage_fns, stage_params = [], []
+    def _is_data(n):
+        v = block._find_var_recursive(n)
+        return v is not None and getattr(v, 'is_data', False)
+
+    # per-stage produced / external activation reads
+    produced_in = {}   # var -> stage
+    stage_reads = []   # stage -> activation names read from outside it
+    stage_params = []
     for s, ops in enumerate(stages):
-        produced = set()
+        local = set()
         reads = []
         for op in ops:
             for n in op.input_arg_names:
-                if n not in produced and n not in reads:
+                if n not in local and n not in reads:
                     reads.append(n)
-            produced.update(op.output_arg_names)
-        def _is_data(n):
-            v = block._find_var_recursive(n)
-            return v is not None and getattr(v, 'is_data', False)
-        data_reads = [n for n in reads if _is_data(n)
-                      and n != boundaries[s]]
-        acts = [n for n in reads
-                if n not in persistable and n != boundaries[s]
-                and n not in data_reads]
-        if acts:
-            raise ValueError(
-                'stage %d reads %r from outside its boundary — '
-                'cross-stage skip connections are not supported; move '
-                'the cut or restructure the model' % (s, acts))
-        if data_reads and not allow_data_reads:
+            local.update(op.output_arg_names)
+        for n in local:
+            produced_in.setdefault(n, s)
+        acts, params, datas = [], [], []
+        for n in reads:
+            if n in persistable:
+                params.append(n)
+            elif n == input_name:
+                acts.append(n)
+            elif _is_data(n):
+                datas.append(n)
+            else:
+                acts.append(n)
+        if datas and not allow_data_reads:
             raise ValueError(
                 'stage %d reads feed vars %r: cut at the model output '
                 'and apply the loss outside the pipeline '
-                '(build_train_step loss_fn)' % (s, data_reads))
-        params = sorted(n for n in reads if n in persistable)
-        out_name = (cut_var_names[s] if s < len(cut_var_names)
-                    else output_name)
+                '(build_train_step loss_fn)' % (s, datas))
+        stage_reads.append(acts)
+        stage_params.append(sorted(params))
 
-        def make(ops, in_name, out_name, param_names):
-            def stage_fn(params_dict, x, step=0):
-                env = dict(params_dict)
-                env[in_name] = x
-                from ..fluid.executor import _lower_ops
-                _lower_ops(ops, env, step, False)
-                return env[out_name]
-            return stage_fn
+    # boundary liveness: var produced in stage p (or the pipeline input,
+    # p = -1) and read in stage c rides boundaries p+1..c
+    alive = [set() for _ in range(n_stages)]
+    for s, acts in enumerate(stage_reads):
+        for n in acts:
+            p = -1 if n == input_name else produced_in.get(n)
+            if p is None:
+                raise ValueError(
+                    'stage %d reads %r which no stage produces (feed it '
+                    'as the pipeline input or move the cut)' % (s, n))
+            if p >= s:
+                raise ValueError(
+                    'stage %d reads %r produced in a LATER stage %d — '
+                    'the cut is not a topological split' % (s, n, p))
+            for b in range(p + 1, s + 1):
+                alive[b].add(n)
+    alive[0].add(input_name)
 
-        stage_fns.append(make(list(ops), boundaries[s], out_name,
-                              params))
-        stage_params.append(params)
     seen = {}
     for s, names in enumerate(stage_params):
         for n in names:
@@ -121,53 +163,143 @@ def split_program_stages(program, input_name, cut_var_names,
                     'independent copies; untie the weight or move the '
                     'cut' % (n, seen[n], s))
             seen[n] = s
-    return stage_fns, stage_params
+
+    union_keys = sorted(set().union(*alive) | {output_name})
+
+    raw_fns = []
+    for s, ops in enumerate(stages):
+        # vars this stage must hand to later boundaries
+        if s < n_stages - 1:
+            emits = sorted(n for n in alive[s + 1]
+                           if produced_in.get(n) == s)
+        else:
+            emits = [output_name]
+
+        def make(ops, in_names, emit_names):
+            def raw_fn(params_dict, in_dict, step=0):
+                from ..fluid.executor import _lower_ops
+                env = dict(params_dict)
+                for n in in_names:
+                    env[n] = in_dict[n]
+                _lower_ops(ops, env, step, False)
+                return {n: env[n] for n in emit_names}
+            return raw_fn
+
+        raw_fns.append(make(list(ops), sorted(alive[s]), emits))
+    return raw_fns, stage_params, alive, union_keys
 
 
-def pipeline_forward_hetero(stage_fns, stage_params, x, mesh,
-                            axis='pp', n_microbatches=4, step_idx=0):
+def _chain_boundary_specs(raw_fns, stage_params, alive, x_micro_aval):
+    """Abstractly run the stage chain once to learn every boundary
+    var's micro-batch shape/dtype (the scope-queue variable specs)."""
+    specs = {}
+    in0 = sorted(alive[0])
+    assert len(in0) == 1, in0
+    specs[in0[0]] = jax.ShapeDtypeStruct(x_micro_aval.shape,
+                                         x_micro_aval.dtype)
+    for s, fn in enumerate(raw_fns):
+        ins = {n: specs[n] for n in sorted(alive[s])} if s < len(alive) \
+            else {}
+        out = jax.eval_shape(lambda p, i: fn(p, i), stage_params[s], ins)
+        for n, aval in out.items():
+            specs[n] = jax.ShapeDtypeStruct(aval.shape, aval.dtype)
+    return specs
+
+
+def pipeline_forward_hetero(raw_fns, stage_params, x, mesh, alive,
+                            union_keys, output_name, axis='pp',
+                            n_microbatches=4, step_idx=0):
     """GPipe forward over HETEROGENEOUS stages: every device applies its
     own stage via lax.switch (params replicated; per-stage placement is
-    a memory follow-up), activations hop via ppermute."""
-    from .pipeline import pipeline_apply_inner
+    a memory follow-up); the ring buffer is a dict of boundary
+    activations hopping via ppermute."""
     n_stages = mesh.shape[axis]
-    if len(stage_fns) != n_stages:
+    if len(raw_fns) != n_stages:
         raise ValueError('%d stages but %s axis has %d devices'
-                         % (len(stage_fns), axis, n_stages))
+                         % (len(raw_fns), axis, n_stages))
     b = x.shape[0]
     assert b % n_microbatches == 0, 'batch must divide microbatches'
     x_micro = x.reshape((n_microbatches, b // n_microbatches)
                         + x.shape[1:])
+    in_key = sorted(alive[0])[0]
+    specs = _chain_boundary_specs(
+        raw_fns, stage_params, alive,
+        jax.ShapeDtypeStruct(x_micro.shape[1:], x_micro.dtype))
+    union_zero = {n: jnp.zeros(specs[n].shape, specs[n].dtype)
+                  for n in union_keys}
 
     def switched(all_params, buf):
-        branches = [
-            (lambda bb, f=f, p=p: f(p, bb, step_idx))
-            for f, p in zip(stage_fns, all_params)]
+        def branch(s):
+            def run(buf):
+                out = raw_fns[s](all_params[s], buf, step_idx)
+                nxt = dict(buf)
+                nxt.update(out)
+                return nxt
+            return run
         idx = jax.lax.axis_index(axis)
-        return jax.lax.switch(idx, branches, buf)
+        return jax.lax.switch(idx, [branch(s) for s in
+                                    range(n_stages)], buf)
 
     def inner(all_params, xm):
-        return pipeline_apply_inner(switched, all_params, xm, axis)
+        n_micro = xm.shape[0]
+        idx = jax.lax.axis_index(axis)
+        total = n_micro + n_stages - 1
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        out_spec = specs[output_name]
+        out = jnp.zeros((n_micro,) + out_spec.shape, out_spec.dtype)
+        buf0 = dict(union_zero)
+
+        def body(t, carry):
+            buf, out = carry
+            feed = xm[jnp.minimum(t, n_micro - 1)]
+            # stage 0 ingests a fresh microbatch dict
+            fresh = dict(union_zero)
+            fresh[in_key] = feed
+            buf = jax.tree.map(
+                lambda f, cur: jnp.where(idx == 0, f, cur), fresh, buf)
+            buf = switched(all_params, buf)
+            mi = t - (n_stages - 1)
+            emit = jnp.logical_and(idx == n_stages - 1, mi >= 0)
+            y = buf[output_name]
+            out = jax.lax.cond(
+                emit,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(mi, 0), 0),
+                lambda o: o, out)
+            buf = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis, perm), buf)
+            return buf, out
+
+        _, out = jax.lax.fori_loop(0, total, body, (buf0, out))
+        src = n_stages - 1
+        mask = (idx == src)
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis)
 
     f = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(tuple(P() for _ in stage_fns), P()),
+        in_specs=(tuple(P() for _ in raw_fns), P()),
         out_specs=P(), check_vma=False)
-    return f(tuple(stage_params), x_micro).reshape((b,) + x.shape[1:])
+    out = f(tuple(stage_params), x_micro)
+    return out.reshape((b,) + out.shape[2:])
 
 
-def build_train_step(program, scope, input_name, cut_var_names,
+def build_train_step(program, scope, input_name, cut_list,
                      output_name, loss_fn, mesh, axis='pp',
                      n_microbatches=4, learning_rate=0.01):
     """Compile a full GPipe SGD train step from a cut program.
+
+    cut_list entries may be single var names or LISTS of var names per
+    boundary (multi-slot scope queues); skip connections across stage
+    boundaries ride the ring automatically.
 
     loss_fn(output, *labels) -> scalar is applied OUTSIDE the pipeline.
     Returns (step, params): step(params, x, *labels) -> (loss,
     new_params), jitted over `mesh`.
     """
     from ..fluid import core
-    stage_fns, stage_param_names = split_program_stages(
-        program, input_name, cut_var_names, output_name)
+    raw_fns, stage_param_names, alive, union_keys = \
+        split_program_stages(program, input_name, cut_list, output_name)
     params = tuple(
         {n: np.asarray(core.as_array(scope.find_var(n)))
          for n in names}
@@ -176,8 +308,8 @@ def build_train_step(program, scope, input_name, cut_var_names,
     def step_impl(params, step_idx, x, *labels):
         def loss_of(params):
             out = pipeline_forward_hetero(
-                stage_fns, params, x, mesh, axis, n_microbatches,
-                step_idx=step_idx)
+                raw_fns, params, x, mesh, alive, union_keys,
+                output_name, axis, n_microbatches, step_idx=step_idx)
             return loss_fn(out, *labels)
         loss, grads = jax.value_and_grad(loss_of)(params)
         new_params = jax.tree.map(

@@ -3,7 +3,8 @@
 Reference: the large-scale sparse path — FleetWrapper::PullSparse/
 PushSparse against PSLib (framework/fleet/fleet_wrapper.h:77-145),
 SelectedRows sparse grads (framework/selected_rows.h), distributed
-lookup-table prefetch (operators/distributed/parameter_prefetch.h).
+lookup-table prefetch (operators/distributed/parameter_prefetch.h),
+listen_and_serv (operators/distributed_ops/listen_and_serv_op.cc:110).
 
 TPU-native re-design, two tiers:
 1. device-sharded: table rows sharded over a mesh axis via GSPMD
@@ -13,9 +14,18 @@ TPU-native re-design, two tiers:
    each step a host op gathers the touched rows ("pull sparse"), the
    device computes with a dense [B,S,dim] activation, and after backward
    a host op applies the row-sparse update ("push sparse") with a
-   per-row adagrad/sgd.  Duplicate ids accumulate via np.add.at, the
-   SelectedRows merge-add semantics (operators/math/
-   selected_rows_functor.cc).
+   per-row adagrad/sgd.  Duplicate ids merge first (unique-id
+   compaction), the SelectedRows merge-add semantics
+   (operators/math/selected_rows_functor.cc), so every step is
+   O(touched rows), never O(vocab).
+
+Under a multi-process jax.distributed runtime the table is additionally
+SHARDED BY ID across processes (owner = id % world, the reference's
+RoundRobin block dispatch analog): pull gathers the touched rows from
+their owner processes and push routes merged row-grads back to owners,
+both riding the host collective fabric (distributed.collective_utils).
+This replaces the reference's gRPC parameter_prefetch / parameter_send
+with padded-capacity collectives whose shapes stay jit-cache friendly.
 """
 
 import numpy as np
@@ -26,17 +36,49 @@ from ..fluid import unique_name
 from ..ops import registry
 
 
+def _next_pow2(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class HostShardedEmbedding(object):
     _REGISTRY = {}
 
     def __init__(self, name, vocab_size, dim, optimizer='adagrad',
                  learning_rate=0.05, initializer_scale=0.01, seed=0,
-                 dtype='float32'):
+                 dtype='float32', distributed=None):
+        """distributed=None: shard by id across processes iff the
+        jax.distributed runtime has >1 process."""
         self.name = name or unique_name.generate('host_embedding')
-        rng = np.random.RandomState(seed)
-        self.table = (rng.randn(vocab_size, dim) *
-                      initializer_scale).astype(dtype)
-        self.acc = np.zeros((vocab_size, 1), dtype) \
+        if distributed is None:
+            try:
+                import jax
+                distributed = jax.process_count() > 1
+            except Exception:
+                distributed = False
+        if distributed:
+            import jax
+            self.world, self.rank = jax.process_count(), \
+                jax.process_index()
+        else:
+            self.world, self.rank = 1, 0
+        if initializer_scale:
+            rng = np.random.RandomState(seed)
+            full = (rng.randn(vocab_size, dim) *
+                    initializer_scale).astype(dtype)
+        else:  # caller fills the rows itself (lazy_from_scope path)
+            full = np.zeros((vocab_size, dim), dtype)
+        # owner(id) = id % world; local row index = id // world.  The
+        # full table is generated identically on every process so a
+        # k-process shard set equals the 1-process table row-for-row
+        # (deterministic resharding; the reference reshards PSLib
+        # tables the same way via its block dispatcher).
+        self.table = np.ascontiguousarray(full[self.rank::self.world]) \
+            if self.world > 1 else full
+        self.vocab_size = vocab_size
+        self.acc = np.zeros((self.table.shape[0], 1), dtype) \
             if optimizer == 'adagrad' else None
         self.optimizer = optimizer
         self.lr = learning_rate
@@ -76,24 +118,97 @@ class HostShardedEmbedding(object):
 
     # -- host kernels -----------------------------------------------------
     def _pull(self, ids):
-        return self.table[ids]
+        if self.world == 1:
+            return self.table[ids]
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        rows = self._pull_uniq_remote(uniq)
+        out = rows[inv].reshape(list(np.asarray(ids).shape) + [self.dim])
+        return out.astype(self.table.dtype)
+
+    def _allgather_ids(self, uniq, extra=None):
+        """Padded-capacity allgather of each process's unique-id set
+        (+ optionally a per-id payload row array): returns (counts
+        [world], ids [world, cap], payload [world, cap, dim] or None).
+        Capacity rounds up to a power of two so the underlying jitted
+        collective re-compiles O(log) times, not per batch."""
+        from ..distributed.collective_utils import process_sum
+        world, rank = self.world, self.rank
+        counts = np.zeros(world, np.int64)
+        counts[rank] = uniq.size
+        counts = process_sum([counts])[0].astype(np.int64)
+        cap = _next_pow2(max(int(counts.max()), 1))
+        ids_buf = np.zeros((world, cap), np.int64)
+        ids_buf[rank, :uniq.size] = uniq
+        leaves = [ids_buf]
+        if extra is not None:
+            pay = np.zeros((world, cap, self.dim), np.float32)
+            pay[rank, :uniq.size] = extra
+            leaves.append(pay)
+        out = process_sum(leaves)
+        ids_buf = out[0].astype(np.int64)
+        return counts, ids_buf, (out[1] if extra is not None else None)
+
+    def _pull_uniq_remote(self, uniq):
+        """Gather rows for locally-touched unique ids from their owner
+        processes (reference: parameter_prefetch.h — gRPC prefetch of
+        split id chunks; here two padded collectives)."""
+        from ..distributed.collective_utils import process_sum
+        world, rank = self.world, self.rank
+        counts, req, _ = self._allgather_ids(uniq)
+        cap = req.shape[1]
+        resp = np.zeros((world, cap, self.dim), np.float32)
+        for p in range(world):
+            req_p = req[p, :counts[p]]
+            own = np.where(req_p % world == rank)[0]
+            resp[p, own] = self.table[req_p[own] // world]
+        resp = process_sum([resp])[0]
+        return resp[rank, :uniq.size]
 
     def _push(self, ids, grad):
-        flat_ids = ids.reshape(-1)
-        flat_g = grad.reshape(-1, self.dim)
+        """Row-sparse update, O(touched rows): duplicate ids merge-add
+        first (SelectedRows merge semantics), then one optimizer step
+        per touched row — the reference merges before updating too
+        (operators/math/selected_rows_functor.cc MergeAdd +
+        optimizers/adagrad_op.h sparse path)."""
+        flat_ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        flat_g = np.asarray(grad).reshape(-1, self.dim).astype(
+            np.float32)
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        g = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(g, inv, flat_g)
+        if self.world > 1:
+            # uniq becomes LOCAL row indices of owned ids after routing
+            uniq, g = self._route_grads_to_owners(uniq, g)
+        self._apply_rows(uniq, g)
+
+    def _route_grads_to_owners(self, uniq, g):
+        """All processes exchange (id, row-grad) sets; each process
+        keeps the merged average for the ids it owns.  Averaging across
+        processes matches the dense GradAllReduce (allreduce_sum +
+        1/nranks scale, transpiler/collective.py) so sparse and dense
+        parameters see the same data-parallel semantics."""
+        world, rank = self.world, self.rank
+        counts, ids_buf, g_buf = self._allgather_ids(uniq, extra=g)
+        all_ids = np.concatenate(
+            [ids_buf[p, :counts[p]] for p in range(world)])
+        all_g = np.concatenate(
+            [g_buf[p, :counts[p]] for p in range(world)])
+        muniq, minv = np.unique(all_ids, return_inverse=True)
+        mg = np.zeros((muniq.size, self.dim), np.float32)
+        np.add.at(mg, minv, all_g)
+        mg /= world
+        own = np.where(muniq % world == rank)[0]
+        return muniq[own] // world, mg[own]
+
+    def _apply_rows(self, rows, g):
+        g = g.astype(self.table.dtype)
         if self.optimizer == 'adagrad':
-            sq = np.zeros((self.table.shape[0], 1), self.table.dtype)
-            np.add.at(sq, flat_ids,
-                      (flat_g ** 2).mean(-1, keepdims=True))
-            self.acc += sq
-            scale = self.lr / (np.sqrt(self.acc[flat_ids]) + 1e-6)
-            upd = np.zeros_like(self.table)
-            np.add.at(upd, flat_ids, scale * flat_g)
-            self.table -= upd
+            self.acc[rows] += (g ** 2).mean(-1, keepdims=True)
+            self.table[rows] -= self.lr / (np.sqrt(self.acc[rows]) +
+                                           1e-6) * g
         else:  # sgd
-            upd = np.zeros_like(self.table)
-            np.add.at(upd, flat_ids, flat_g)
-            self.table -= self.lr * upd
+            self.table[rows] -= self.lr * g
 
     def state_dict(self):
         out = {self.name + '.table': self.table}
@@ -107,16 +222,46 @@ class HostShardedEmbedding(object):
             self.acc = d[self.name + '.acc']
 
 
+def _ensure_table(op, scope):
+    """Resolve the op's table, creating it lazily from the scope var on
+    first touch when the op came from DistributeTranspiler PS rewriting
+    ('lazy_from_scope') — this preserves the startup program's
+    initialization exactly (the reference pserver receives the
+    startup-initialized blocks the same way)."""
+    name = op.attr('table')
+    t = HostShardedEmbedding._REGISTRY.get(name)
+    if t is not None:
+        return t
+    if not op.attr('lazy_from_scope'):
+        raise KeyError('host embedding table %s was never created'
+                       % name)
+    w = np.asarray(core.as_array(scope.find_var(name)))
+    lr_map = getattr(op.block.program, '_host_emb_lr', None) or {}
+    lr = lr_map.get(name)
+    t = HostShardedEmbedding(name, w.shape[0], w.shape[1],
+                             optimizer='sgd',
+                             learning_rate=0.01 if lr is None else lr,
+                             initializer_scale=0, dtype=str(w.dtype))
+    t.table = np.ascontiguousarray(w[t.rank::t.world]) \
+        if t.world > 1 else np.array(w, copy=True)
+    return t
+
+
 @registry.register_host('host_emb_lookup')
 def host_emb_lookup(executor, scope, op):
-    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    table = _ensure_table(op, scope)
     ids = np.asarray(core.as_array(scope.find_var(op.input('Ids')[0])))
-    scope.set_var(op.output('Out')[0], table._pull(ids))
+    rows = table._pull(ids)
+    pi = op.attr('padding_idx')
+    if pi is not None and pi >= 0:
+        rows = np.where((ids == pi)[..., None], 0.0, rows).astype(
+            rows.dtype)
+    scope.set_var(op.output('Out')[0], rows)
 
 
 @registry.register_host('host_emb_update')
 def host_emb_update(executor, scope, op):
-    table = HostShardedEmbedding._REGISTRY[op.attr('table')]
+    table = _ensure_table(op, scope)
     ids = np.asarray(core.as_array(scope.find_var(op.input('Ids')[0])))
     grad = np.asarray(core.as_array(
         scope.find_var(op.input('Grad')[0])))

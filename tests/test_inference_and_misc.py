@@ -73,8 +73,11 @@ def test_transpiler_nccl2_marks_program():
     t = fluid.DistributeTranspiler(config=cfg)
     t.transpile(trainer_id=0, program=main, trainers=2)
     assert getattr(main, '_collective_dp', False)
-    with pytest.raises(NotImplementedError):
-        t.get_pserver_program('127.0.0.1:6174')
+    # embedded PS runtime: pserver programs are explicit no-ops now
+    # (round 2: transpiler PS mode routes to host-sharded tables)
+    pserver = t.get_pserver_program('127.0.0.1:6174')
+    assert getattr(pserver, '_embedded_ps', False)
+    assert not pserver.global_block().ops
 
 
 def test_grad_allreduce_transpiler_rewrite():

@@ -196,3 +196,46 @@ def test_two_process_gspmd_zero_parity(tmp_path):
     # GSPMD fetch is the global mean loss
     np.testing.assert_allclose(
         ref_losses, results[0]['losses'], rtol=1e-3, atol=1e-4)
+
+
+def test_two_process_sparse_ps_parity(tmp_path):
+    """The SPARSE path across 2 real processes: the embedding table is
+    sharded by id (owner = id % world), pull gathers rows from owners,
+    push routes merged row-grads back — loss parity with a
+    single-process full-batch run of the same model (VERDICT round-1
+    'done' criterion for the multi-process sparse PS)."""
+    from dist_worker import build_sparse_model, make_sparse_batches
+    from paddle_tpu.parallel.sparse_embedding import HostShardedEmbedding
+
+    results = _launch_two_workers(tmp_path, 'sparse_ps')
+
+    # single-process full-batch reference (same seeds, world=1)
+    HostShardedEmbedding._REGISTRY.pop('dist_sparse_emb', None)
+    main, startup, loss, emb = build_sparse_model(9)
+    assert emb.world == 1
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        emb.apply_gradients(main)
+    ref_losses = []
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for ids, y in make_sparse_batches():
+            l, = exe.run(main, feed={'ids': ids, 'label': y},
+                         fetch_list=[loss])
+            ref_losses.append(float(np.asarray(l).ravel()[0]))
+
+    # mean of the two workers' per-shard losses == full-batch loss
+    mean_losses = [(a + b) / 2.0 for a, b in
+                   zip(results[0]['losses'], results[1]['losses'])]
+    np.testing.assert_allclose(mean_losses, ref_losses, rtol=2e-4)
+
+    # table parity: worker rank r owns global ids {r, r+2, ...}; its
+    # local row j is global id 2j+r — compare against the reference
+    full = emb.table
+    for r in range(2):
+        shard = np.asarray(results[r]['param'])
+        want = full[r::2][:shard.shape[0]]
+        np.testing.assert_allclose(shard, want, rtol=2e-4, atol=1e-6)
+    HostShardedEmbedding._REGISTRY.pop('dist_sparse_emb', None)

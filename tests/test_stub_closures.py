@@ -1,0 +1,101 @@
+"""Round-2 stub closures: lrn wrapper, adaptive_pool2d arbitrary grids,
+nce custom_dist, multi-target calc_gradient."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+layers = fluid.layers
+
+
+def _run(main, startup, feed, fetch):
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_lrn_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4, 6, 6], dtype='float32')
+        y = layers.lrn(x, n=3, k=1.0, alpha=0.1, beta=0.5)
+    xv = np.random.RandomState(0).randn(2, 4, 6, 6).astype('float32')
+    out, = _run(main, startup, {'x': xv}, [y])
+    # reference formula on channel 1: k + alpha * sum over [0,1,2]
+    sq = xv ** 2
+    acc = sq[:, 0] + sq[:, 1] + sq[:, 2]
+    want = xv[:, 1] / np.sqrt(1.0 + 0.1 * acc)
+    np.testing.assert_allclose(np.asarray(out)[:, 1], want, rtol=1e-5)
+
+
+def test_adaptive_pool2d_arbitrary_grid():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[2, 7, 10], dtype='float32')
+        ya = layers.adaptive_pool2d(x, [3, 4], pool_type='avg')
+        ym = layers.adaptive_pool2d(x, [3, 4], pool_type='max')
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 2, 7, 10).astype('float32')
+    out_a, out_m = _run(main, startup, {'x': xv}, [ya, ym])
+    assert np.asarray(out_a).shape == (2, 2, 3, 4)
+
+    def windows(h, oh):
+        return [((i * h) // oh, -(-((i + 1) * h) // oh))
+                for i in range(oh)]
+
+    for i, (hs, he) in enumerate(windows(7, 3)):
+        for j, (ws, we) in enumerate(windows(10, 4)):
+            win = xv[:, :, hs:he, ws:we]
+            np.testing.assert_allclose(np.asarray(out_a)[:, :, i, j],
+                                       win.mean((2, 3)), rtol=1e-5,
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(out_m)[:, :, i, j],
+                                       win.max((2, 3)), rtol=1e-5,
+                                       atol=1e-6)
+
+
+def test_nce_custom_dist_trains():
+    vocab = 50
+    dist = np.arange(1, vocab + 1, dtype='float64')
+    dist = (dist / dist.sum()).tolist()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[16], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='int64')
+        h = layers.fc(x, 16)
+        cost = layers.nce(h, y, vocab, num_neg_samples=5,
+                          sampler='custom_dist', custom_dist=dist)
+        loss = layers.mean(cost)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    rng = np.random.RandomState(2)
+    xv = rng.randn(32, 16).astype('float32')
+    yv = rng.randint(0, vocab, (32, 1)).astype('int64')
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(20):
+            l, = exe.run(main, feed={'x': xv, 'y': yv},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_calc_gradient_multi_target():
+    # z1 = 2x, z2 = x^2; d(sum(z1) + sum(w2*z2))/dx = 2 + 2*w2*x
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        x.stop_gradient = False
+        z1 = layers.scale(x, scale=2.0)
+        z2 = layers.square(x)
+        w2 = layers.fill_constant([1, 4], 'float32', 3.0)
+        g, = fluid.backward.calc_gradient([z1, z2], [x],
+                                          target_gradients=[None, w2])
+    assert g is not None
+    xv = np.array([[1.0, 2.0, -1.0, 0.5]], np.float32)
+    gv, = _run(main, startup, {'x': xv}, [g.name])
+    np.testing.assert_allclose(np.asarray(gv), 2.0 + 6.0 * xv,
+                               rtol=1e-5)

@@ -1,0 +1,88 @@
+"""Dump the optimized HLO of the ResNet-50 bench step and print the
+definitions of the named fusions that dominate the profile
+(tools/profile_resnet.py), so 'fusion.83' becomes actionable.
+
+Usage: python tools/dump_resnet_hlo.py [fusion.83 fusion.81 ...]
+Writes the full HLO to /tmp/resnet_step_hlo.txt.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu.fluid.executor import _Segment, _make_segment_fn
+
+    layout = 'NHWC'
+    batch = 128
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main_p, startup):
+        feeds, logits, loss, acc = models.resnet.build(data_format=layout)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Momentum(0.1, momentum=0.9),
+            use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 224, 224, 3).astype('float32')
+    y = rng.randint(0, 1000, (batch, 1)).astype('int32')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        plan = exe._build_plan(main_p, ('image', 'label'), ())
+        segs = [it for it in plan if isinstance(it, _Segment)]
+        seg = max(segs, key=lambda s: len(s.ops))
+        fn = _make_segment_fn(seg)
+        state = {n: fluid.core.as_array(scope.find_var(n))
+                 for n in seg.state_names}
+        data = {}
+        for n in seg.input_names:
+            data[n] = {'image': x, 'label': y}.get(
+                n, fluid.core.as_array(scope.find_var(n)))
+        compiled = jax.jit(fn, donate_argnums=(1,)).lower(
+            0, state, data).compile()
+    txt = compiled.as_text()
+    with open('/tmp/resnet_step_hlo.txt', 'w') as f:
+        f.write(txt)
+    print('wrote %d lines to /tmp/resnet_step_hlo.txt'
+          % len(txt.splitlines()))
+    names = sys.argv[1:] or ['fusion.83', 'fusion.81', 'fusion.80',
+                             'fusion.190', 'fusion.191', 'fusion.1718',
+                             'fusion.189', 'convert_reduce_fusion.1',
+                             'fusion.448', 'fusion.912', 'fusion.633']
+    lines = txt.splitlines()
+    for want in names:
+        for i, ln in enumerate(lines):
+            ls = ln.lstrip()
+            if ls.startswith('%' + want + ' ') or \
+                    ls.startswith(want + ' ') or \
+                    (' = ' in ls and ls.split(' = ')[0].strip('%') == want):
+                print('\n=== %s ===' % want)
+                print(ln[:400])
+                # print the fused computation it calls, if named
+                import re
+                m = re.search(r'calls=([%\w.\-]+)', ln)
+                if m:
+                    comp = m.group(1).lstrip('%')
+                    for j, l2 in enumerate(lines):
+                        if l2.startswith(comp + ' ') or \
+                                l2.startswith('%' + comp + ' '):
+                            for k in range(j, min(j + 25, len(lines))):
+                                print(lines[k][:240])
+                                if lines[k].rstrip().endswith('}'):
+                                    break
+                            break
+                break
+
+
+if __name__ == '__main__':
+    main()

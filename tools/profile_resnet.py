@@ -1,0 +1,132 @@
+"""Capture a device profile of the ResNet-50 bench step and print the
+op-level time breakdown.
+
+Usage: python tools/profile_resnet.py [NHWC|NCHW] [batch]
+
+Writes the raw trace under /tmp/paddle_tpu_profile (TensorBoard/Perfetto
+format, from jax.profiler) and prints the top XLA ops by self-time parsed
+from the trace.json.gz so the bottleneck is visible without a UI.
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from collections import defaultdict
+
+import numpy as np
+
+
+def run_profiled(layout='NHWC', batch=128, steps=6):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, logits, loss, acc = models.resnet.build(data_format=layout)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Momentum(0.1, momentum=0.9),
+            use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(0)
+    shape = (batch, 224, 224, 3) if layout == 'NHWC' else \
+        (batch, 3, 224, 224)
+    x = jax.device_put(rng.rand(*shape).astype('float32'))
+    y = jax.device_put(rng.randint(0, 1000, (batch, 1)).astype('int32'))
+
+    logdir = '/tmp/paddle_tpu_profile'
+    os.system('rm -rf %s' % logdir)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={'image': x, 'label': y}, fetch_list=[])
+        l, = exe.run(main, feed={'image': x, 'label': y},
+                     fetch_list=[loss])
+        np.asarray(l)
+        with jax.profiler.trace(logdir):
+            for _ in range(steps):
+                exe.run(main, feed={'image': x, 'label': y},
+                        fetch_list=[])
+            l, = exe.run(main, feed={'image': x, 'label': y},
+                         fetch_list=[loss])
+            np.asarray(l)
+    return logdir, steps + 1
+
+
+def analyze(logdir, steps):
+    paths = glob.glob(logdir + '/**/*.trace.json.gz', recursive=True)
+    if not paths:
+        print('no trace.json.gz found under', logdir)
+        print('files:', glob.glob(logdir + '/**/*', recursive=True)[:20])
+        return
+    path = sorted(paths)[-1]
+    with gzip.open(path, 'rt') as f:
+        trace = json.load(f)
+    events = trace.get('traceEvents', [])
+    # device-lane complete events: aggregate self time by op name
+    by_name = defaultdict(float)
+    count = defaultdict(int)
+    pid_names = {}
+    for e in events:
+        if e.get('ph') == 'M' and e.get('name') == 'process_name':
+            pid_names[e.get('pid')] = e.get('args', {}).get('name', '')
+    device_pids = set(p for p, n in pid_names.items()
+                      if 'TPU' in n or 'Device' in n or 'XLA' in n
+                      or '/device' in n.lower())
+    for e in events:
+        if e.get('ph') != 'X':
+            continue
+        if device_pids and e.get('pid') not in device_pids:
+            continue
+        name = e.get('name', '?')
+        by_name[name] += e.get('dur', 0)
+        count[name] += 1
+    # the step-level spans (whole-module executions) double-count the
+    # kernels inside them: split them out
+    import re
+    step_spans = {}
+    kernels = {}
+    for name, us in by_name.items():
+        if name.startswith('jit_') or re.fullmatch(r'\d+', name):
+            step_spans[name] = us
+        else:
+            kernels[name] = us
+    total = sum(kernels.values())
+    module_time = sum(us for n, us in step_spans.items()
+                      if n.startswith('jit_'))
+    print('process lanes:', sorted(set(pid_names.values())))
+    print('module span: %.1f ms; kernel busy: %.1f ms (%.0f%% busy) '
+          'across %d distinct kernels, ~%d launches/step'
+          % (module_time / 1e3, total / 1e3,
+             100.0 * total / max(module_time, 1),
+             len(kernels), sum(count[n] for n in kernels) // steps))
+    # category rollup: strip trailing .N / digits
+    cats = defaultdict(float)
+    for name, us in kernels.items():
+        cat = re.sub(r'[.\d]+$', '', name)
+        cats[cat] += us
+    print('\n-- by category --')
+    for name, us in sorted(cats.items(), key=lambda kv: -kv[1])[:20]:
+        print('%-48s %10.2f ms %5.1f%%'
+              % (name[:48], us / 1e3, 100.0 * us / max(total, 1)))
+    print('\n-- top kernels --')
+    print('%-64s %10s %6s %6s' % ('op', 'ms', 'count', '%'))
+    for name, us in sorted(kernels.items(), key=lambda kv: -kv[1])[:30]:
+        print('%-64s %10.2f %6d %5.1f%%'
+              % (name[:64], us / 1e3, count[name],
+                 100.0 * us / max(total, 1)))
+
+
+if __name__ == '__main__':
+    layout = sys.argv[1] if len(sys.argv) > 1 else 'NHWC'
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    logdir, steps = run_profiled(layout, batch)
+    analyze(logdir, steps)
