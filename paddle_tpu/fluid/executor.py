@@ -355,6 +355,29 @@ def _make_segment_fn(segment, prefer_test=False):
     return fn
 
 
+class CompiledStep(object):
+    """A program compiled to one jittable callable — the public
+    'compile program -> function' surface (the reference's
+    Executor::Prepare returning an ExecutorPrepareContext,
+    framework/executor.h:81, re-imagined for whole-graph XLA).
+
+    fn(step, state, data) -> {output_name: array}; `state` holds the
+    in-place-updated names (parameters, optimizer slots), `data` the
+    pure inputs.  The function is pure and jit/grad/shard-compatible.
+    """
+
+    __slots__ = ('fn', 'input_names', 'state_names', 'output_names')
+
+    def __init__(self, fn, input_names, state_names, output_names):
+        self.fn = fn
+        self.input_names = list(input_names)
+        self.state_names = list(state_names)
+        self.output_names = list(output_names)
+
+    def __call__(self, step, state, data):
+        return self.fn(step, state, data)
+
+
 class Executor(object):
     """Reference: python/paddle/fluid/executor.py:680."""
 
@@ -364,6 +387,47 @@ class Executor(object):
 
     def close(self):
         pass
+
+    def compile(self, program, feed_names=(), fetch_names=(),
+                prefer_test=False):
+        """Compile `program` into ONE pure jittable function
+        (CompiledStep).  The program must lower to a single device
+        segment — host ops (save/load/print/PS pulls) cut segments and
+        cannot live inside a jitted step."""
+        from . import framework as _fw
+
+        def _norm(names):
+            return [v.name if isinstance(v, _fw.Variable) else v
+                    for v in names]
+
+        feed_names = _norm(feed_names)
+        fetch_names = _norm(fetch_names)
+        plan = self._build_plan(program, tuple(sorted(feed_names)),
+                                tuple(fetch_names))
+        segs = [it for it in plan if isinstance(it, _Segment)]
+        if len(segs) != 1 or len(plan) != 1:
+            host = [it[1].type for it in plan
+                    if not isinstance(it, _Segment)]
+            raise ValueError(
+                'Executor.compile needs a single-segment program; this '
+                'one splits into %d segments around host ops %r — run '
+                'it with Executor.run instead' % (len(segs), host))
+        seg = segs[0]
+        missing = [n for n in fetch_names if n not in seg.output_names]
+        if missing:
+            raise ValueError(
+                'fetch vars %r are not produced by the compiled step '
+                '(a fetch must be written by the program; pure inputs '
+                'are available to the caller already)' % (missing,))
+        known = set(seg.input_names) | set(seg.state_names)
+        bogus = [n for n in feed_names if n not in known]
+        if bogus:
+            raise ValueError(
+                'feed names %r are not read by the program (inputs: '
+                '%r)' % (bogus, sorted(known)))
+        return CompiledStep(_make_segment_fn(seg, prefer_test),
+                            seg.input_names, seg.state_names,
+                            seg.output_names)
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,

@@ -99,3 +99,63 @@ def test_calc_gradient_multi_target():
     gv, = _run(main, startup, {'x': xv}, [g.name])
     np.testing.assert_allclose(np.asarray(gv), 2.0 + 6.0 * xv,
                                rtol=1e-5)
+
+
+def test_executor_public_compile_api():
+    """Executor.compile: program -> one pure jittable CompiledStep."""
+    import jax
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        h = layers.fc(x, 4)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        step = exe.compile(main, feed_names=('x',),
+                           fetch_names=(h.name,))
+        scope = fluid.core.global_scope()
+        state = {n: fluid.core.as_array(scope.find_var(n))
+                 for n in step.state_names}
+        data = {n: fluid.core.as_array(scope.find_var(n))
+                for n in step.input_names if n != 'x'}
+        xv = np.random.RandomState(0).randn(2, 8).astype('float32')
+        data['x'] = xv
+        out = jax.jit(step.fn)(0, state, data)
+        assert np.asarray(out[h.name]).shape == (2, 4)
+        # parity with exe.run
+        ref, = exe.run(main, feed={'x': xv}, fetch_list=[h])
+        np.testing.assert_allclose(np.asarray(out[h.name]), ref,
+                                   rtol=1e-6)
+
+    # host ops split the program -> compile must refuse with guidance
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = layers.data('x', shape=[4], dtype='float32')
+        y2 = layers.scale(x2, scale=2.0)
+        layers.Print(y2)
+        z2 = layers.scale(y2, scale=3.0)
+    exe2 = fluid.Executor(fluid.XLAPlace(0))
+    with pytest.raises(ValueError, match='single-segment'):
+        exe2.compile(main2, feed_names=('x',), fetch_names=(z2.name,))
+
+
+def test_executor_compile_validates_names():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        h = layers.fc(x, 4)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    # Variable objects accepted in both slots
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor(fluid.XLAPlace(0))
+        exe2.run(startup)
+        step = exe2.compile(main, feed_names=(x,), fetch_names=(h,))
+        assert 'x' in step.input_names
+    with pytest.raises(ValueError, match='not produced'):
+        exe.compile(main, feed_names=('x',), fetch_names=('x',))
+    with pytest.raises(ValueError, match='not read'):
+        exe.compile(main, feed_names=('tpyo',),
+                    fetch_names=(h.name,))
