@@ -172,11 +172,15 @@ def _sparse_ps_main(rank, world):
 
 
 def main():
-    # one CPU device per process: strip any forced host-device count
-    # inherited from the pytest parent before jax initializes
+    # one CPU device per process by default: strip any forced
+    # host-device count inherited from the pytest parent before jax
+    # initializes; gspmd_mp mode instead gives every process TWO
+    # devices so a multi-process dp x mp mesh exists
     flags = os.environ.get('XLA_FLAGS', '').split()
     flags = [f for f in flags
              if 'xla_force_host_platform_device_count' not in f]
+    if len(sys.argv) > 2 and sys.argv[2] == 'gspmd_mp':
+        flags.append('--xla_force_host_platform_device_count=2')
     os.environ['XLA_FLAGS'] = ' '.join(flags)
     os.environ['JAX_PLATFORMS'] = 'cpu'
     import jax
@@ -211,6 +215,26 @@ def main():
             opt = fleet.distributed_optimizer(
                 fluid.optimizer.SGD(0.1), strategy)
             opt.minimize(loss)
+    elif mode == 'gspmd_mp':
+        # multi-process AND multi-axis: every process holds 2 devices;
+        # the mesh is (dp=world, mp=2) spanning all processes — batch
+        # sharded on dp, fc weight matrices column-sharded on mp
+        import numpy as _np
+        from jax.sharding import Mesh, PartitionSpec as P
+        with fluid.program_guard(main_prog, startup):
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index,
+                                                    d.id))
+        mesh = Mesh(_np.array(devs).reshape(world, 2), ('dp', 'mp'))
+
+        def shard_rule(name, shape):
+            if len(shape) == 2 and min(shape) >= 4:
+                return P(None, 'mp')
+            return None
+
+        compiled = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=loss.name).with_mesh(mesh).with_param_shardings(
+            shard_rule)
     else:  # gspmd: CompiledProgram DP + ZeRO-sharded optimizer state
         with fluid.program_guard(main_prog, startup):
             fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
