@@ -152,8 +152,17 @@ def pool2d(ctx, ins, attrs):
     if ptype == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
-        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride4,
-                                    pad4)
+        if all(stride4[d] >= window[d] for d in hw):
+            # NON-overlapping windows: shifted-slice rendering reads
+            # every element exactly once and its vjp is fused
+            # compare-masks instead of select_and_scatter (slow to
+            # compile and run); overlapping pools stay on
+            # reduce_window — the k^2-slice rendering re-reads the
+            # input k^2 times and measured 20% slower on ResNet-50
+            out = _max_pool_slices(x, window, stride4, pad4, hw, init)
+        else:
+            out = jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                        stride4, pad4)
     else:
         s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride4, pad4)
         if attrs.get('exclusive', True) and pad4 != 'SAME' and \
@@ -165,6 +174,49 @@ def pool2d(ctx, ins, attrs):
         else:
             out = s / float(np.prod([window[d] for d in hw]))
     return {'Out': [out]}
+
+
+def _max_pool_slices(x, window, stride4, pad4, hw, init):
+    """Max pooling as an elementwise max over kh*kw strided slices of
+    the (init-)padded input.  Identical values to reduce_window(max);
+    the backward pass is where(x == out) mask-routing that XLA fuses
+    (reduce_window's vjp is select_and_scatter).  Tie-routing differs
+    from select_and_scatter (ties all receive gradient) — the same
+    freedom the reference's cudnn pooling modes have."""
+    d0, d1 = hw
+    kh, kw = window[d0], window[d1]
+    sh, sw = stride4[d0], stride4[d1]
+    h_in, w_in = x.shape[d0], x.shape[d1]
+    if pad4 == 'SAME':
+        oh = -(-h_in // sh)
+        ow = -(-w_in // sw)
+        ph_t = max((oh - 1) * sh + kh - h_in, 0)
+        pw_t = max((ow - 1) * sw + kw - w_in, 0)
+        ph = (ph_t // 2, ph_t - ph_t // 2)
+        pw = (pw_t // 2, pw_t - pw_t // 2)
+    else:
+        ph, pw = pad4[d0], pad4[d1]
+        oh = (h_in + ph[0] + ph[1] - kh) // sh + 1
+        ow = (w_in + pw[0] + pw[1] - kw) // sw + 1
+    pads = [(0, 0)] * x.ndim
+    pads[d0], pads[d1] = ph, pw
+    xp = jnp.pad(x, pads, constant_values=init)
+    out = None
+    for i in range(kh):
+        for j in range(kw):
+            lim = [None] * x.ndim
+            start = [0] * x.ndim
+            stride = [1] * x.ndim
+            start[d0], start[d1] = i, j
+            lim[d0] = i + (oh - 1) * sh + 1
+            lim[d1] = j + (ow - 1) * sw + 1
+            stride[d0], stride[d1] = sh, sw
+            sl = jax.lax.slice(
+                xp, start,
+                [xp.shape[a] if lim[a] is None else lim[a]
+                 for a in range(x.ndim)], stride)
+            out = sl if out is None else jnp.maximum(out, sl)
+    return out
 
 
 @register('batch_norm', no_grad_out_slots=('MeanOut', 'VarianceOut',

@@ -95,18 +95,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                         block_k, has_bias):
-    if has_bias:
-        bias_ref, do_ref, lse_ref, delta_ref, dq_ref = rest
-    else:
-        bias_ref = None
-        do_ref, lse_ref, delta_ref, dq_ref = rest
+                         block_k, has_bias, has_glse):
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    do_ref, lse_ref, delta_ref = rest[0], rest[1], rest[2]
+    glse_ref = rest[3] if has_glse else None
+    dq_ref = rest[-1]
     """Grid (BH, T/bq): recompute p row-blocks from q and lse, then
     dq = sum_k (p * (dO V^T - delta)) K * scale."""
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0].astype(jnp.float32)
     delta = delta_ref[0, 0].astype(jnp.float32)
+    # lse cotangent (ring-merge path): dS_ij += p_ij * g_lse_i, so it
+    # rides the same (dp - delta) rail; absent for plain attention
+    glse = glse_ref[0, 0].astype(jnp.float32) if has_glse else None
     bq, d = q.shape
     t = k_ref.shape[1]
     q_off = pl.program_id(1) * bq
@@ -134,7 +137,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
                       jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        dd = dp - delta[:, None]
+        if has_glse:
+            dd = dd + glse[:, None]
+        ds = p * dd * scale
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -150,13 +156,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                          block_q, has_bias):
-    if has_bias:
-        (bias_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dbias_ref) = rest
-    else:
-        bias_ref = dbias_ref = None
-        do_ref, lse_ref, delta_ref, dk_ref, dv_ref = rest
+                          block_q, has_bias, has_glse):
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    do_ref, lse_ref, delta_ref = rest[0], rest[1], rest[2]
+    glse_ref = rest[3] if has_glse else None
+    dk_ref, dv_ref = rest[-3:-1] if has_bias else rest[-2:]
+    dbias_ref = rest[-1] if has_bias else None
     """Grid (BH, T/bk): for one K/V block, stream Q row-blocks:
     dv = sum_q p^T dO;  ds_raw = p * (dO V^T - delta);
     dk = sum_q ds_raw^T Q * scale;  dbias = sum_q ds_raw (per key)."""
@@ -178,6 +184,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             jnp.float32)
         delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
             jnp.float32)
+        glse = glse_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
+            jnp.float32) if has_glse else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * scale
@@ -196,7 +204,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds_raw = p * (dp - delta[:, None])
+        dd = dp - delta[:, None]
+        if has_glse:
+            dd = dd + glse[:, None]
+        ds_raw = p * dd
         dk = dk + jax.lax.dot_general(
             ds_raw, q, (((0,), (0,)), ((), ()))) * scale
         if has_bias:
@@ -274,8 +285,8 @@ def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
     return o, lse3[:, 0, :]
 
 
-def _flash_bwd(q, k, v, bias, o, lse, do, h, causal, block_q, block_k,
-               interpret):
+def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
+               block_k, interpret):
     bh, t, d = q.shape
     block_q, block_k = _block_sizes(t, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
@@ -283,12 +294,14 @@ def _flash_bwd(q, k, v, bias, o, lse, do, h, causal, block_q, block_k,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)
     has_bias = bias is not None
+    has_glse = g_lse is not None
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
+    glse3 = g_lse.astype(jnp.float32)[:, None, :] if has_glse else None
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
                                   causal=causal, block_k=block_k,
-                                  has_bias=has_bias)
+                                  has_bias=has_bias, has_glse=has_glse)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
@@ -305,6 +318,10 @@ def _flash_bwd(q, k, v, bias, o, lse, do, h, causal, block_q, block_k,
         pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
     ]
     dq_operands += [do, lse3, delta3]
+    if has_glse:
+        dq_specs.append(pl.BlockSpec((1, 1, block_q),
+                                     lambda i, j: (i, 0, j)))
+        dq_operands.append(glse3)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, t // block_q),
@@ -316,7 +333,8 @@ def _flash_bwd(q, k, v, bias, o, lse, do, h, causal, block_q, block_k,
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                                    causal=causal, block_q=block_q,
-                                   has_bias=has_bias)
+                                   has_bias=has_bias,
+                                   has_glse=has_glse)
     dkv_specs = [
         pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -333,6 +351,10 @@ def _flash_bwd(q, k, v, bias, o, lse, do, h, causal, block_q, block_k,
         pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
     ]
     dkv_operands += [do, lse3, delta3]
+    if has_glse:
+        dkv_specs.append(pl.BlockSpec((1, 1, t),
+                                      lambda i, j: (i, 0, 0)))
+        dkv_operands.append(glse3)
     out_specs = [
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -378,7 +400,40 @@ def _dense_reference(q, k, v, causal):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_lse(q, k, v, bias, h, causal):
+    """(o, lse): lse is a first-class differentiable output so ring
+    attention can merge per-block flash results (parallel/
+    ring_attention.py ring_flash_attention)."""
+    interpret = not _on_tpu()
+    return _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
+                      DEFAULT_BLOCK_K, interpret)
+
+
+def _flash_lse_fwd_rule(q, k, v, bias, h, causal):
+    interpret = not _on_tpu()
+    o, lse = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
+                        DEFAULT_BLOCK_K, interpret)
+    return (o, lse), (q, k, v, bias, o, lse)
+
+
+def _flash_lse_bwd_rule(h, causal, res, gs):
+    q, k, v, bias, o, lse = res
+    g, g_lse = gs
+    interpret = not _on_tpu()
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g, g_lse, h,
+                                   causal, DEFAULT_BLOCK_Q,
+                                   DEFAULT_BLOCK_K, interpret)
+    return dq, dk, dv, (None if bias is None
+                        else dbias.astype(bias.dtype))
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash(q, k, v, bias, h, causal):
+    # o-only primitive with its OWN vjp so the common (non-ring) path
+    # never ships a zeros g_lse operand into the backward kernels
     interpret = not _on_tpu()
     o, _ = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
                       DEFAULT_BLOCK_K, interpret)
@@ -395,9 +450,9 @@ def _flash_fwd_rule(q, k, v, bias, h, causal):
 def _flash_bwd_rule(h, causal, res, g):
     q, k, v, bias, o, lse = res
     interpret = not _on_tpu()
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g, h, causal,
-                                   DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-                                   interpret)
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g, None, h,
+                                   causal, DEFAULT_BLOCK_Q,
+                                   DEFAULT_BLOCK_K, interpret)
     return dq, dk, dv, (None if bias is None
                         else dbias.astype(bias.dtype))
 
@@ -417,3 +472,21 @@ def flash_attention(q, k, v, causal=False, key_bias=None):
         key_bias = key_bias.astype(jnp.float32)
     out = _flash(to_bh(q), to_bh(k), to_bh(v), key_bias, h, causal)
     return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
+def flash_attention_with_lse(q, k, v, causal=False, key_bias=None):
+    """Like flash_attention but also returns the per-row log-sum-exp
+    [B, H, T] — the merge state for blockwise/ring composition.  Both
+    outputs are differentiable (the lse cotangent folds into dS inside
+    the backward kernels)."""
+    b, t, h, d = q.shape
+
+    def to_bh(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    if key_bias is not None:
+        key_bias = key_bias.astype(jnp.float32)
+    o, lse = _flash_lse(to_bh(q), to_bh(k), to_bh(v), key_bias, h,
+                        causal)
+    o = jnp.transpose(o.reshape(b, h, t, d), (0, 2, 1, 3))
+    return o, lse.reshape(b, h, t)

@@ -81,6 +81,82 @@ def ring_attention(q, k, v, mesh, axis='sp', causal=False):
     return f(q, k, v)
 
 
+def ring_flash_attention_inner(q, k, v, axis_name, causal=False):
+    """Ring attention with the Pallas FLASH kernel as the per-block
+    engine: each hop runs blockwise flash attention over the resident
+    K/V shard (no [T_loc, T_loc] scores in HBM — the long-context
+    configuration this exists for), and partial results merge in
+    log-sum-exp space:
+
+        L' = logaddexp(L, lse_blk)
+        o' = o * exp(L - L') + o_blk * exp(lse_blk - L')
+
+    Differentiable end-to-end: the flash kernel exposes lse as a real
+    output (ops/pallas/flash_attention.py _flash_lse) whose cotangent
+    folds into dS inside the backward kernels, and jax.vjp reverses the
+    ppermute ring.  Call INSIDE shard_map with q,k,v sequence-sharded
+    [B, T_loc, H, D]."""
+    from ..ops.pallas.flash_attention import flash_attention_with_lse
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    l0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+
+    def full_block(kk, vv):
+        return flash_attention_with_lse(q, kk, vv, causal=False)
+
+    def diag_block(kk, vv):
+        return flash_attention_with_lse(q, kk, vv, causal=True)
+
+    def skip_block(kk, vv):
+        return (jnp.zeros((b, tq, h, d), q.dtype),
+                jnp.full((b, h, tq), -jnp.inf, jnp.float32))
+
+    def body(i, carry):
+        o, lse, kk, vv = carry
+        kv_idx = (idx - i) % n
+        if causal:
+            # kv block ahead of the diagonal contributes nothing;
+            # on the diagonal the block is internally causal
+            case = jnp.where(kv_idx > idx, 2,
+                             jnp.where(kv_idx == idx, 1, 0))
+            o_blk, lse_blk = jax.lax.switch(
+                case, [full_block, diag_block, skip_block], kk, vv)
+        else:
+            o_blk, lse_blk = full_block(kk, vv)
+        o_blk = o_blk.astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        # guard rows no block has touched yet (-inf - -inf = nan)
+        w_old = jnp.where(jnp.isfinite(lse),
+                          jnp.exp(lse - lse_new), 0.0)
+        w_blk = jnp.where(jnp.isfinite(lse_blk),
+                          jnp.exp(lse_blk - lse_new), 0.0)
+        # [B,H,T] weights -> [B,T,H,1] to scale outputs
+        wo = jnp.transpose(w_old, (0, 2, 1))[..., None]
+        wb = jnp.transpose(w_blk, (0, 2, 1))[..., None]
+        o = o * wo + o_blk * wb
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        return o, lse_new, kk, vv
+
+    o, lse, _, _ = jax.lax.fori_loop(0, n, body, (o0, l0, k, v))
+    return o.astype(q.dtype)
+
+
+def ring_flash_attention(q, k, v, mesh, axis='sp', causal=False):
+    """Global-array wrapper for ring_flash_attention_inner."""
+    spec = P(None, axis, None, None)
+    f = jax.shard_map(
+        functools.partial(ring_flash_attention_inner, axis_name=axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)
+
+
 def reference_attention(q, k, v, causal=False):
     """Dense reference for testing: [B,T,H,D]."""
     d = q.shape[-1]
