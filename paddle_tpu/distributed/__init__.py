@@ -6,3 +6,5 @@ operators/distributed/ (communicator.h, heart_beat_monitor.h)."""
 from .communicator import (  # noqa: F401
     AsyncCommunicator, GeoSgdCommunicator, ParameterServerStore)
 from .heartbeat import HeartBeatMonitor  # noqa: F401
+from .rpc_ps import (  # noqa: F401
+    PsServer, PsClient, RpcParameterServerStore)

@@ -1,0 +1,193 @@
+"""RPC parameter-server transport: native TCP service + Python client.
+
+Reference: the gRPC/bRPC parameter plane —
+operators/distributed_ops/listen_and_serv_op.cc:110 (server loop),
+operators/distributed/grpc/grpc_client.h (async client),
+send_recv.proto.in:19 (SendVariable/GetVariable), and
+framework/fleet/fleet_wrapper.h:77-145 (PullSparse/PushSparse).
+
+TPU-native split: dense TRAINING sync rides XLA collectives, so what
+keeps an RPC plane on TPU is the CTR parameter-server shape — a
+long-lived service process holding dense slots (server-side SGD, the
+reference's optimize sub-blocks) and big sparse row tables (per-row
+adagrad/sgd).  The service itself is native C++
+(runtime/ps_service.cc, threaded TCP, binary frames); this module is
+the ctypes server handle + the client.
+
+RpcParameterServerStore is interface-compatible with
+distributed.ParameterServerStore, so the AsyncCommunicator
+(merge-before-send, bounded staleness) works unchanged against a
+REMOTE server process.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+OP_INIT_DENSE = 1
+OP_PUSH_DENSE = 2
+OP_PULL_DENSE = 3
+OP_INIT_SPARSE = 4
+OP_PULL_ROWS = 5
+OP_PUSH_ROWS = 6
+OP_SET_ROWS = 7
+OP_BARRIER = 8
+OP_LIST = 9
+
+
+class PsServer(object):
+    """In-process handle on the native service (the listen_and_serv
+    analog).  Run one of these in the pserver process; trainers connect
+    with PsClient."""
+
+    def __init__(self, port=0, lr=0.01):
+        from ..runtime import _load
+        lib = _load()
+        import ctypes
+        lib.ps_serve_start.restype = ctypes.c_void_p
+        lib.ps_serve_start.argtypes = [ctypes.c_int, ctypes.c_float]
+        lib.ps_serve_port.argtypes = [ctypes.c_void_p]
+        lib.ps_serve_stop.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._handle = lib.ps_serve_start(port, lr)
+        if not self._handle:
+            raise RuntimeError('ps_serve_start failed (port %d)' % port)
+        self.port = lib.ps_serve_port(self._handle)
+        self.endpoint = '127.0.0.1:%d' % self.port
+
+    def stop(self):
+        if self._handle:
+            self._lib.ps_serve_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best effort
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient(object):
+    """Blocking client (reference RPCClient / grpc_client.h: the async
+    completion-queue machinery collapses to one in-flight request per
+    connection; open several clients for parallelism)."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(':', 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # one in-flight request per connection: the lock makes a shared
+        # client safe under AsyncCommunicator's per-variable send
+        # threads (request/response stay paired)
+        self._lock = threading.Lock()
+
+    def close(self):
+        self._sock.close()
+
+    # -- framing ----------------------------------------------------------
+    def _call(self, op, name, payload=b''):
+        nb = name.encode()
+        frame = struct.pack('<BI', op, len(nb)) + nb + payload
+        with self._lock:
+            self._sock.sendall(struct.pack('<I', len(frame)) + frame)
+            (rlen,) = struct.unpack('<I', self._recv(4))
+            return self._recv(rlen) if rlen else b''
+
+    def _recv(self, n):
+        out = b''
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError('ps server closed the connection')
+            out += chunk
+        return out
+
+    # -- dense slots ------------------------------------------------------
+    def init_dense(self, name, value):
+        v = np.ascontiguousarray(value, np.float32).reshape(-1)
+        self._call(OP_INIT_DENSE, name,
+                   struct.pack('<Q', v.size) + v.tobytes())
+
+    def push_dense_grad(self, name, grad):
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        self._call(OP_PUSH_DENSE, name,
+                   struct.pack('<Q', g.size) + g.tobytes())
+
+    def pull_dense(self, name):
+        out = self._call(OP_PULL_DENSE, name)
+        (n,) = struct.unpack('<Q', out[:8])
+        return np.frombuffer(out[8:], np.float32, n).copy()
+
+    # -- sparse tables ----------------------------------------------------
+    def init_sparse(self, name, rows, dim, optimizer='sgd', lr=0.01):
+        opt = 1 if optimizer == 'adagrad' else 0
+        self._call(OP_INIT_SPARSE, name,
+                   struct.pack('<QQBf', rows, dim, opt, lr))
+
+    def set_rows(self, name, ids, values):
+        self._rows_op(OP_SET_ROWS, name, ids, values)
+
+    def push_rows(self, name, ids, grads):
+        self._rows_op(OP_PUSH_ROWS, name, ids, grads)
+
+    def _rows_op(self, op, name, ids, values):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        v = np.ascontiguousarray(values, np.float32).reshape(ids.size, -1)
+        self._call(op, name, struct.pack('<Q', ids.size) + ids.tobytes() +
+                   v.tobytes())
+
+    def pull_rows(self, name, ids, dim):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = self._call(OP_PULL_ROWS, name,
+                         struct.pack('<Q', ids.size) + ids.tobytes())
+        return np.frombuffer(out, np.float32).reshape(ids.size,
+                                                      dim).copy()
+
+    # -- control ----------------------------------------------------------
+    def barrier(self, n_trainers):
+        """send_barrier/fetch_barrier analog: blocks until n_trainers
+        processes reach the barrier."""
+        self._call(OP_BARRIER, '', struct.pack('<Q', n_trainers))
+
+    def list_vars(self):
+        out = self._call(OP_LIST, '')
+        (count,) = struct.unpack('<I', out[:4])
+        names, off = [], 4
+        for _ in range(count):
+            (ln,) = struct.unpack('<I', out[off:off + 4])
+            off += 4
+            names.append(out[off:off + ln].decode())
+            off += ln
+        return names
+
+
+class RpcParameterServerStore(object):
+    """distributed.ParameterServerStore over the RPC transport: the
+    AsyncCommunicator (merge-before-send) talks to a REMOTE native
+    server process through this without changes."""
+
+    def __init__(self, endpoint):
+        self._client = PsClient(endpoint)
+
+    def init_var(self, name, value):
+        self._client.init_dense(name, value)
+        self._shapes = getattr(self, '_shapes', {})
+        self._shapes[name] = np.asarray(value).shape
+
+    def apply_grad(self, name, grad):
+        self._client.push_dense_grad(name, grad)
+
+    def apply_delta(self, name, delta):
+        # GeoSGD delta = add: server-side p -= lr * (-delta/lr)
+        raise NotImplementedError(
+            'GeoSGD deltas over RPC: use the in-process store')
+
+    def get(self, name):
+        flat = self._client.pull_dense(name)
+        shape = getattr(self, '_shapes', {}).get(name)
+        return flat.reshape(shape) if shape else flat
+
+    def names(self):
+        return [n for n in self._client.list_vars()]

@@ -21,8 +21,9 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO):
-        subprocess.check_call(['make', '-s', '-C', _DIR])
+    # always invoke make (no-op when up to date): a stale .so built
+    # before new native components lacks their symbols
+    subprocess.check_call(['make', '-s', '-C', _DIR])
     lib = ctypes.CDLL(_SO)
     lib.ptfeed_create.restype = ctypes.c_void_p
     lib.ptfeed_create.argtypes = [

@@ -1,0 +1,381 @@
+// Native parameter-server service: the listen_and_serv / gRPC layer of
+// the reference (operators/distributed_ops/listen_and_serv_op.cc:110,
+// operators/distributed/grpc/grpc_server.cc, send_recv.proto.in)
+// re-designed as a small threaded TCP service.
+//
+// Scope: the host-side control/parameter plane only — dense training
+// synchronization rides XLA collectives (ICI/DCN), so what needs RPC on
+// TPU is the CTR-style parameter server: dense slots with server-side
+// SGD (the optimize sub-blocks the reference runs inside
+// listen_and_serv) and sparse row tables with per-row adagrad/sgd
+// (FleetWrapper::PullSparse/PushSparse, fleet_wrapper.h:77-145).
+//
+// Wire protocol (little-endian, one request per frame):
+//   [u32 frame_len][u8 op][u32 name_len][name bytes][payload]
+// ops:
+//   1 INIT_DENSE   payload: u64 n, f32[n]          -> u8 ok
+//   2 PUSH_DENSE   payload: u64 n, f32[n] grad     -> u8 ok   (p -= lr*g)
+//   3 PULL_DENSE   payload: -                      -> u64 n, f32[n]
+//   4 INIT_SPARSE  payload: u64 rows, u64 dim, u8 optimizer(0=sgd,
+//                  1=adagrad), f32 lr              -> u8 ok
+//   5 PULL_ROWS    payload: u64 k, i64[k] ids      -> f32[k*dim]
+//   6 PUSH_ROWS    payload: u64 k, i64[k] ids, f32[k*dim] grads -> u8 ok
+//   7 SET_ROWS     payload: u64 k, i64[k] ids, f32[k*dim] vals  -> u8 ok
+//   8 BARRIER      payload: u64 n_trainers -> blocks until n arrive -> u8
+//   9 LIST         payload: -  -> u32 count, {u32 len, name}*
+// Exported C API (ctypes): ps_serve_start(port, lr) / ps_serve_port /
+// ps_serve_stop.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Dense {
+  std::vector<float> value;
+  std::mutex mu;
+};
+
+struct Sparse {
+  uint64_t rows = 0, dim = 0;
+  uint8_t optimizer = 0;  // 0 sgd, 1 adagrad
+  float lr = 0.01f;
+  std::vector<float> table;
+  std::vector<float> acc;  // adagrad accumulator, one per row
+  std::mutex mu;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  float lr = 0.01f;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex tables_mu;
+  std::map<std::string, Dense *> dense;
+  std::map<std::string, Sparse *> sparse;
+  std::mutex conns_mu;
+  std::vector<int> conns;  // open connection fds, for stop()
+  // barrier state (reference: send_barrier / fetch_barrier ops)
+  std::mutex bar_mu;
+  std::condition_variable bar_cv;
+  uint64_t bar_count = 0, bar_gen = 0;
+};
+
+bool read_all(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void *buf, size_t n) {
+  const char *p = static_cast<const char *>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool reply(int fd, const void *payload, uint32_t n) {
+  uint32_t len = n;
+  if (!write_all(fd, &len, 4)) return false;
+  return n == 0 || write_all(fd, payload, n);
+}
+
+bool reply_ok(int fd) {
+  uint8_t ok = 1;
+  return reply(fd, &ok, 1);
+}
+
+template <typename T>
+T take(const char *&p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  p += sizeof(T);
+  return v;
+}
+
+// bytes left in the request buffer from p
+inline size_t avail(const std::vector<char> &buf, const char *p) {
+  return static_cast<size_t>(buf.data() + buf.size() - p);
+}
+
+void handle_conn(Server *s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> buf;
+  while (!s->stop.load()) {
+    uint32_t frame;
+    if (!read_all(fd, &frame, 4)) break;
+    buf.resize(frame);
+    if (frame && !read_all(fd, buf.data(), frame)) break;
+    const char *p = buf.data();
+    if (avail(buf, p) < 5) break;
+    uint8_t op = take<uint8_t>(p);
+    uint32_t nlen = take<uint32_t>(p);
+    if (avail(buf, p) < nlen) break;  // malformed frame
+    std::string name(p, p + nlen);
+    p += nlen;
+
+    if (op == 1 || op == 2) {  // INIT_DENSE / PUSH_DENSE
+      if (avail(buf, p) < 8) break;
+      uint64_t n = take<uint64_t>(p);
+      if (avail(buf, p) < n * 4) break;  // malformed frame
+      Dense *d = nullptr;
+      {
+        std::lock_guard<std::mutex> g(s->tables_mu);
+        auto it = s->dense.find(name);
+        if (it == s->dense.end()) {
+          if (op == 2) break;  // push before init: protocol error
+          d = new Dense();
+          d->value.assign(n, 0.f);
+          s->dense[name] = d;
+        } else {
+          d = it->second;
+        }
+      }
+      std::lock_guard<std::mutex> g(d->mu);
+      const float *vals = reinterpret_cast<const float *>(p);
+      if (op == 1) {
+        d->value.assign(vals, vals + n);
+      } else {
+        if (d->value.size() != n) break;  // size-mismatched grad
+        for (uint64_t i = 0; i < n; ++i) d->value[i] -= s->lr * vals[i];
+      }
+      if (!reply_ok(fd)) break;
+    } else if (op == 3) {  // PULL_DENSE
+      Dense *d = nullptr;
+      {
+        std::lock_guard<std::mutex> g(s->tables_mu);
+        auto it = s->dense.find(name);
+        if (it != s->dense.end()) d = it->second;
+      }
+      if (!d) {
+        uint64_t n = 0;
+        if (!reply(fd, &n, 8)) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(d->mu);
+      uint64_t n = d->value.size();
+      std::vector<char> out(8 + n * 4);
+      std::memcpy(out.data(), &n, 8);
+      std::memcpy(out.data() + 8, d->value.data(), n * 4);
+      if (!reply(fd, out.data(), static_cast<uint32_t>(out.size()))) break;
+    } else if (op == 4) {  // INIT_SPARSE
+      if (avail(buf, p) < 21) break;
+      uint64_t rows = take<uint64_t>(p);
+      uint64_t dim = take<uint64_t>(p);
+      uint8_t opt = take<uint8_t>(p);
+      float lr = take<float>(p);
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      if (!s->sparse.count(name)) {
+        Sparse *t = new Sparse();
+        t->rows = rows;
+        t->dim = dim;
+        t->optimizer = opt;
+        t->lr = lr;
+        t->table.assign(rows * dim, 0.f);
+        if (opt == 1) t->acc.assign(rows, 0.f);
+        s->sparse[name] = t;
+      }
+      if (!reply_ok(fd)) break;
+    } else if (op == 5 || op == 6 || op == 7) {  // ROWS ops
+      Sparse *t = nullptr;
+      {
+        std::lock_guard<std::mutex> g(s->tables_mu);
+        auto it = s->sparse.find(name);
+        if (it != s->sparse.end()) t = it->second;
+      }
+      if (!t) break;  // protocol error: table must exist
+      if (avail(buf, p) < 8) break;
+      uint64_t k = take<uint64_t>(p);
+      if (avail(buf, p) < k * 8) break;  // malformed frame
+      const int64_t *ids = reinterpret_cast<const int64_t *>(p);
+      p += k * 8;
+      std::lock_guard<std::mutex> g(t->mu);
+      if (op == 5) {  // PULL_ROWS
+        std::vector<char> out(k * t->dim * 4, 0);
+        float *dst = reinterpret_cast<float *>(out.data());
+        for (uint64_t i = 0; i < k; ++i) {
+          if (ids[i] < 0 ||
+              static_cast<uint64_t>(ids[i]) >= t->rows)
+            continue;  // out-of-range id: row reads as zeros
+          const float *src = &t->table[static_cast<uint64_t>(ids[i]) *
+                                       t->dim];
+          std::memcpy(dst + i * t->dim, src, t->dim * 4);
+        }
+        if (!reply(fd, out.data(), static_cast<uint32_t>(out.size())))
+          break;
+      } else {
+        if (avail(buf, p) < k * t->dim * 4) break;  // malformed
+        const float *vals = reinterpret_cast<const float *>(p);
+        for (uint64_t i = 0; i < k; ++i) {
+          if (ids[i] < 0 ||
+              static_cast<uint64_t>(ids[i]) >= t->rows)
+            continue;  // out-of-range id: drop the update
+          float *row = &t->table[static_cast<uint64_t>(ids[i]) * t->dim];
+          const float *v = vals + i * t->dim;
+          if (op == 7) {  // SET_ROWS
+            std::memcpy(row, v, t->dim * 4);
+          } else if (t->optimizer == 1) {  // adagrad push
+            float sq = 0.f;
+            for (uint64_t j = 0; j < t->dim; ++j) sq += v[j] * v[j];
+            t->acc[static_cast<uint64_t>(ids[i])] += sq / t->dim;
+            float scale =
+                t->lr /
+                (std::sqrt(t->acc[static_cast<uint64_t>(ids[i])]) + 1e-6f);
+            for (uint64_t j = 0; j < t->dim; ++j) row[j] -= scale * v[j];
+          } else {  // sgd push
+            for (uint64_t j = 0; j < t->dim; ++j)
+              row[j] -= t->lr * v[j];
+          }
+        }
+        if (!reply_ok(fd)) break;
+      }
+    } else if (op == 8) {  // BARRIER
+      if (avail(buf, p) < 8) break;
+      uint64_t want = take<uint64_t>(p);
+      std::unique_lock<std::mutex> g(s->bar_mu);
+      uint64_t gen = s->bar_gen;
+      if (++s->bar_count >= want) {
+        s->bar_count = 0;
+        ++s->bar_gen;
+        s->bar_cv.notify_all();
+      } else {
+        s->bar_cv.wait(g, [&] {
+          return s->bar_gen != gen || s->stop.load();
+        });
+      }
+      g.unlock();
+      if (!reply_ok(fd)) break;
+    } else if (op == 9) {  // LIST
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      std::vector<char> out;
+      uint32_t count =
+          static_cast<uint32_t>(s->dense.size() + s->sparse.size());
+      out.insert(out.end(), reinterpret_cast<char *>(&count),
+                 reinterpret_cast<char *>(&count) + 4);
+      auto add = [&out](const std::string &n) {
+        uint32_t l = static_cast<uint32_t>(n.size());
+        out.insert(out.end(), reinterpret_cast<char *>(&l),
+                   reinterpret_cast<char *>(&l) + 4);
+        out.insert(out.end(), n.begin(), n.end());
+      };
+      for (auto &kv : s->dense) add(kv.first);
+      for (auto &kv : s->sparse) add(kv.first);
+      if (!reply(fd, out.data(), static_cast<uint32_t>(out.size())))
+        break;
+    } else {
+      break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (auto it = s->conns.begin(); it != s->conns.end(); ++it) {
+      if (*it == fd) {
+        s->conns.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server *s) {
+  while (!s->stop.load()) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(s->listen_fd, reinterpret_cast<sockaddr *>(&peer),
+                      &plen);
+    if (fd < 0) {
+      if (s->stop.load()) break;
+      continue;
+    }
+    {
+      // register BEFORE the worker exists so stop() can always
+      // unblock it; handle_conn removes it on close
+      std::lock_guard<std::mutex> g(s->conns_mu);
+      s->conns.push_back(fd);
+    }
+    s->workers.emplace_back(handle_conn, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (pointer) or 0 on failure.  port==0 picks a
+// free port; read it back with ps_serve_port.
+void *ps_serve_start(int port, float lr) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  Server *s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->lr = lr;
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int ps_serve_port(void *handle) {
+  return handle ? static_cast<Server *>(handle)->port : -1;
+}
+
+void ps_serve_stop(void *handle) {
+  if (!handle) return;
+  Server *s = static_cast<Server *>(handle);
+  s->stop.store(true);
+  s->bar_cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    // unblock worker threads parked in recv() on live connections
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (int fd : s->conns) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto &t : s->workers)
+    if (t.joinable()) t.join();
+  for (auto &kv : s->dense) delete kv.second;
+  for (auto &kv : s->sparse) delete kv.second;
+  delete s;
+}
+
+}  // extern "C"
