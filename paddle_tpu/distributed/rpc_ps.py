@@ -35,6 +35,7 @@ OP_PUSH_ROWS = 6
 OP_SET_ROWS = 7
 OP_BARRIER = 8
 OP_LIST = 9
+OP_ADD_DENSE = 10
 
 
 class PsServer(object):
@@ -115,6 +116,13 @@ class PsClient(object):
         self._call(OP_PUSH_DENSE, name,
                    struct.pack('<Q', g.size) + g.tobytes())
 
+    def add_dense(self, name, delta):
+        """p += delta: the GeoSGD delta-shipping leg
+        (operators/distributed/communicator.h:343)."""
+        d = np.ascontiguousarray(delta, np.float32).reshape(-1)
+        self._call(OP_ADD_DENSE, name,
+                   struct.pack('<Q', d.size) + d.tobytes())
+
     def pull_dense(self, name):
         out = self._call(OP_PULL_DENSE, name)
         (n,) = struct.unpack('<Q', out[:8])
@@ -180,9 +188,7 @@ class RpcParameterServerStore(object):
         self._client.push_dense_grad(name, grad)
 
     def apply_delta(self, name, delta):
-        # GeoSGD delta = add: server-side p -= lr * (-delta/lr)
-        raise NotImplementedError(
-            'GeoSGD deltas over RPC: use the in-process store')
+        self._client.add_dense(name, delta)
 
     def get(self, name):
         flat = self._client.pull_dense(name)

@@ -23,6 +23,8 @@
 //   7 SET_ROWS     payload: u64 k, i64[k] ids, f32[k*dim] vals  -> u8 ok
 //   8 BARRIER      payload: u64 n_trainers -> blocks until n arrive -> u8
 //   9 LIST         payload: -  -> u32 count, {u32 len, name}*
+//  10 ADD_DENSE    payload: u64 n, f32[n] delta   -> u8 ok   (p += d,
+//                  the GeoSGD delta-shipping leg, communicator.h:343)
 // Exported C API (ctypes): ps_serve_start(port, lr) / ps_serve_port /
 // ps_serve_stop.
 
@@ -140,7 +142,7 @@ void handle_conn(Server *s, int fd) {
     std::string name(p, p + nlen);
     p += nlen;
 
-    if (op == 1 || op == 2) {  // INIT_DENSE / PUSH_DENSE
+    if (op == 1 || op == 2 || op == 10) {  // INIT/PUSH/ADD dense
       if (avail(buf, p) < 8) break;
       uint64_t n = take<uint64_t>(p);
       if (avail(buf, p) < n * 4) break;  // malformed frame
@@ -149,7 +151,7 @@ void handle_conn(Server *s, int fd) {
         std::lock_guard<std::mutex> g(s->tables_mu);
         auto it = s->dense.find(name);
         if (it == s->dense.end()) {
-          if (op == 2) break;  // push before init: protocol error
+          if (op != 1) break;  // push/add before init: protocol error
           d = new Dense();
           d->value.assign(n, 0.f);
           s->dense[name] = d;
@@ -162,8 +164,13 @@ void handle_conn(Server *s, int fd) {
       if (op == 1) {
         d->value.assign(vals, vals + n);
       } else {
-        if (d->value.size() != n) break;  // size-mismatched grad
-        for (uint64_t i = 0; i < n; ++i) d->value[i] -= s->lr * vals[i];
+        if (d->value.size() != n) break;  // size-mismatched payload
+        if (op == 2) {
+          for (uint64_t i = 0; i < n; ++i)
+            d->value[i] -= s->lr * vals[i];
+        } else {  // ADD_DENSE: GeoSGD delta
+          for (uint64_t i = 0; i < n; ++i) d->value[i] += vals[i];
+        }
       }
       if (!reply_ok(fd)) break;
     } else if (op == 3) {  // PULL_DENSE

@@ -152,3 +152,15 @@ def test_out_of_range_ids_are_safe():
         c.close()
     finally:
         srv.stop()
+
+
+def test_geo_sgd_delta_over_rpc():
+    srv = PsServer(lr=0.1)
+    try:
+        store = RpcParameterServerStore(srv.endpoint)
+        store.init_var('p', np.zeros((2, 2), 'float32'))
+        store.apply_delta('p', np.full((2, 2), 0.25, 'float32'))
+        np.testing.assert_allclose(store.get('p'),
+                                   np.full((2, 2), 0.25))
+    finally:
+        srv.stop()
