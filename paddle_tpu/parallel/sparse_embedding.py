@@ -43,6 +43,11 @@ def _next_pow2(n):
     return p
 
 
+def _init_table(vocab_size, dim, scale, seed, dtype):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(vocab_size, dim) * scale).astype(dtype)
+
+
 class HostShardedEmbedding(object):
     _REGISTRY = {}
 
@@ -65,9 +70,8 @@ class HostShardedEmbedding(object):
         else:
             self.world, self.rank = 1, 0
         if initializer_scale:
-            rng = np.random.RandomState(seed)
-            full = (rng.randn(vocab_size, dim) *
-                    initializer_scale).astype(dtype)
+            full = _init_table(vocab_size, dim, initializer_scale,
+                               seed, dtype)
         else:  # caller fills the rows itself (lazy_from_scope path)
             full = np.zeros((vocab_size, dim), dtype)
         # owner(id) = id % world; local row index = id // world.  The
@@ -93,7 +97,8 @@ class HostShardedEmbedding(object):
         rows = block.create_var(
             name=unique_name.generate(self.name + '_rows'),
             shape=tuple(list(ids.shape) + [self.dim]),
-            dtype=str(self.table.dtype))
+            dtype=str(self.table.dtype) if self.table is not None
+            else 'float32')
         rows.stop_gradient = False
         block.append_op('host_emb_lookup',
                         inputs={'Ids': ids}, outputs={'Out': rows},
@@ -295,3 +300,111 @@ def push_box_sparse(executor, scope, op):
         ids = np.asarray(core.as_array(scope.find_var(ids_name)))
         grad = np.asarray(core.as_array(scope.find_var(g_name)))
         table._push(ids, grad)
+
+
+class RpcShardedEmbedding(HostShardedEmbedding):
+    """The same pull/push-sparse program surface, but the table lives in
+    REMOTE native parameter-server processes (runtime/ps_service.cc),
+    sharded by id across endpoints — owner = id % n_servers, the
+    reference's RoundRobin block dispatch over pservers
+    (transpiler/ps_dispatcher.py) with FleetWrapper pull/push semantics
+    (framework/fleet/fleet_wrapper.h:77-145).  Use when trainers span
+    hosts without a shared jax.distributed runtime, or when the table
+    must outlive trainer processes."""
+
+    def __init__(self, name, vocab_size, dim, endpoints,
+                 optimizer='adagrad', learning_rate=0.05,
+                 initializer_scale=0.01, seed=0, dtype='float32'):
+        from ..distributed.rpc_ps import PsClient
+        self.name = name or unique_name.generate('rpc_embedding')
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = learning_rate
+        self.world, self.rank = 1, 0  # no process-collective path
+        self._clients = [PsClient(ep) for ep in endpoints]
+        n = len(self._clients)
+        # attach-vs-create: a table already living on the servers keeps
+        # its trained rows AND optimizer state — a (re)starting trainer
+        # must never wipe it (the reference pserver likewise owns table
+        # lifetime across trainer restarts)
+        exists = self.name in self._clients[0].list_vars()
+        for e, cl in enumerate(self._clients):
+            rows_e = (vocab_size - e + n - 1) // n
+            cl.init_sparse(self.name, rows_e, dim, optimizer=optimizer,
+                           lr=learning_rate)
+        if initializer_scale and not exists:
+            full = _init_table(vocab_size, dim, initializer_scale,
+                               seed, dtype)
+            all_ids = np.arange(vocab_size, dtype=np.int64)
+            for e, cl in enumerate(self._clients):
+                own = all_ids[all_ids % n == e]
+                cl.set_rows(self.name, own // n, full[own])
+        self.acc = None
+        self.table = None  # lives on the servers
+        HostShardedEmbedding._REGISTRY[self.name] = self
+
+    # -- host kernels over RPC -------------------------------------------
+    def _per_shard(self, fn_of_shard):
+        """Run one independent request per server CONCURRENTLY (each
+        endpoint has its own client/connection): step latency ~ 1 RTT,
+        not n_servers x RTT."""
+        import threading
+        threads = []
+        errs = []
+
+        def run(e, cl):
+            try:
+                fn_of_shard(e, cl)
+            except Exception as exc:  # surface in the caller
+                errs.append(exc)
+
+        for e, cl in enumerate(self._clients):
+            t = threading.Thread(target=run, args=(e, cl))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def _pull(self, ids):
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        n = len(self._clients)
+        rows = np.zeros((uniq.size, self.dim), np.float32)
+
+        def pull_shard(e, cl):
+            m = np.where(uniq % n == e)[0]
+            if m.size:
+                rows[m] = cl.pull_rows(self.name, uniq[m] // n,
+                                       self.dim)
+
+        self._per_shard(pull_shard)
+        return rows[inv].reshape(list(np.asarray(ids).shape) +
+                                 [self.dim])
+
+    def _push(self, ids, grad):
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        g = np.asarray(grad).reshape(-1, self.dim).astype(np.float32)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, g)  # SelectedRows merge-add
+        n = len(self._clients)
+
+        def push_shard(e, cl):
+            m = np.where(uniq % n == e)[0]
+            if m.size:
+                cl.push_rows(self.name, uniq[m] // n, merged[m])
+
+        self._per_shard(push_shard)
+
+    def state_dict(self):
+        raise NotImplementedError(
+            'RpcShardedEmbedding state lives on the servers: checkpoint '
+            'from the pserver process')
+
+    def load_state_dict(self, d):
+        raise NotImplementedError(
+            'RpcShardedEmbedding state lives on the servers: restore '
+            'from the pserver process')

@@ -164,3 +164,63 @@ def test_geo_sgd_delta_over_rpc():
                                    np.full((2, 2), 0.25))
     finally:
         srv.stop()
+
+
+def test_rpc_sharded_embedding_trains():
+    """End-to-end: the embedding table lives on TWO native pserver
+    shards; a fluid model trains against them through the same
+    lookup/apply_gradients program surface."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.sparse_embedding import RpcShardedEmbedding
+
+    srv1, srv2 = PsServer(), PsServer()
+    try:
+        emb = RpcShardedEmbedding(
+            'rpc_emb_t', 300, 8, [srv1.endpoint, srv2.endpoint],
+            optimizer='adagrad', learning_rate=0.1, seed=3)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data('ids', shape=[5], dtype='int64')
+            label = fluid.layers.data('label', shape=[1],
+                                      dtype='float32')
+            rows = emb.lookup(ids)
+            feat = fluid.layers.reshape(rows, [0, 5 * 8])
+            pred = fluid.layers.fc(feat, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+            emb.apply_gradients(main)
+        rng = np.random.RandomState(0)
+        ids_np = rng.randint(0, 300, (16, 5)).astype('int64')
+        y_np = rng.rand(16, 1).astype('float32')
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            for _ in range(30):
+                l, = exe.run(main, feed={'ids': ids_np,
+                                         'label': y_np},
+                             fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # both shards hold touched rows
+        c1, c2 = PsClient(srv1.endpoint), PsClient(srv2.endpoint)
+        assert 'rpc_emb_t' in c1.list_vars()
+        assert 'rpc_emb_t' in c2.list_vars()
+        # a RE-ATTACHING trainer must not wipe the trained rows
+        before = c1.pull_rows('rpc_emb_t',
+                              np.arange(5, dtype='int64'), 8)
+        emb2 = RpcShardedEmbedding(
+            'rpc_emb_t', 300, 8, [srv1.endpoint, srv2.endpoint],
+            optimizer='adagrad', learning_rate=0.1, seed=99)
+        after = c1.pull_rows('rpc_emb_t',
+                             np.arange(5, dtype='int64'), 8)
+        np.testing.assert_allclose(after, before)
+        del emb2
+    finally:
+        from paddle_tpu.parallel.sparse_embedding import \
+            HostShardedEmbedding
+        HostShardedEmbedding._REGISTRY.pop('rpc_emb_t', None)
+        srv1.stop()
+        srv2.stop()
