@@ -180,6 +180,47 @@ def bench_host_sparse_push(batch=4096, vocab=10_000_000, dim=16,
             'ms_per_step': round(dt * 1000, 3)}
 
 
+def bench_rpc_sparse_push(batch=4096, vocab=10_000_000, dim=16,
+                          slots=20, steps=50, n_servers=2):
+    """The REMOTE sparse pull/push path: same workload as
+    bench_host_sparse_push but the table lives in native pserver
+    processes behind the framed-TCP protocol (runtime/ps_service.cc) —
+    the listen_and_serv / parameter_prefetch leg the reference built
+    gRPC zero-copy serde for (operators/distributed/grpc/
+    grpc_serde.cc).  Measures the RPC overhead over the in-process
+    number."""
+    import time as _t
+    from paddle_tpu.distributed import PsServer
+    from paddle_tpu.parallel.sparse_embedding import (
+        HostShardedEmbedding, RpcShardedEmbedding)
+    servers = [PsServer() for _ in range(n_servers)]
+    try:
+        emb = RpcShardedEmbedding('bench_rpc_emb', vocab, dim,
+                                  [s.endpoint for s in servers],
+                                  optimizer='adagrad',
+                                  learning_rate=0.05,
+                                  initializer_scale=0)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, vocab, (batch, slots)).astype('int64')
+        grad = rng.randn(batch, slots, dim).astype('float32')
+        emb._pull(ids)
+        emb._push(ids, grad)
+        t0 = _t.time()
+        for _ in range(steps):
+            emb._pull(ids)
+            emb._push(ids, grad)
+        dt = (_t.time() - t0) / steps
+        return {'metric':
+                'rpc_sparse_pull_push_examples_per_sec_b%d_v%dM_s%d'
+                % (batch, vocab // 1_000_000, n_servers),
+                'value': round(batch / dt, 1), 'unit': 'examples/sec',
+                'ms_per_step': round(dt * 1000, 3)}
+    finally:
+        HostShardedEmbedding._REGISTRY.pop('bench_rpc_emb', None)
+        for s in servers:
+            s.stop()
+
+
 def bench_transformer(batch=32, src_len=64, tgt_len=64, steps=20):
     """BASELINE.json config 4: Transformer NMT step time."""
     import paddle_tpu.fluid as fluid
@@ -234,7 +275,7 @@ def main():
         # stays the default single-line ResNet metric
         for fn in (bench_lenet, bench_bert, bench_wide_deep,
                    bench_wide_deep_sparse, bench_host_sparse_push,
-                   bench_transformer):
+                   bench_rpc_sparse_push, bench_transformer):
             try:
                 print(json.dumps(fn()))
             except Exception as e:

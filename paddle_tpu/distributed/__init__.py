@@ -7,4 +7,5 @@ from .communicator import (  # noqa: F401
     AsyncCommunicator, GeoSgdCommunicator, ParameterServerStore)
 from .heartbeat import HeartBeatMonitor  # noqa: F401
 from .rpc_ps import (  # noqa: F401
-    PsServer, PsClient, RpcParameterServerStore)
+    PsServer, PsClient, RpcParameterServerStore, PsServerError,
+    RpcDeadlineError, TrainerHeartbeat)

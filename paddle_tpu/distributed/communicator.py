@@ -27,11 +27,20 @@ import numpy as np
 class ParameterServerStore(object):
     """In-process stand-in for the pserver side: name -> np.ndarray with
     an optimizer applied under a lock (the reference runs per-param
-    optimize sub-blocks inside listen_and_serv)."""
+    optimize sub-blocks inside listen_and_serv — sgd, momentum, and
+    adam rules alike, listen_and_serv_op.cc:110 /
+    distribute_transpiler.py:1110).  Per-var rules are set with
+    conf_var(); unconfigured vars fall back to global-lr sgd.  The
+    update rules match the native RPC server (runtime/ps_service.cc
+    dense_apply) and the in-program optimizer ops
+    (ops/optimizer_ops.py), so async-PS training is step-for-step
+    comparable with a locally-optimized program."""
 
     def __init__(self, lr=1.0):
         self._params = {}
         self._locks = {}
+        self._rules = {}   # name -> dict(kind, lr, b1, b2, eps)
+        self._state = {}   # name -> dict(m, v, t)
         self._global_lock = threading.Lock()
         self.lr = lr
 
@@ -40,9 +49,45 @@ class ParameterServerStore(object):
             self._params[name] = np.array(value, copy=True)
             self._locks[name] = threading.Lock()
 
+    def conf_var(self, name, optimizer='sgd', lr=0.01, momentum=0.9,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8):
+        """Per-var server-side update rule (the pserver optimize
+        sub-block analog)."""
+        b1 = momentum if optimizer == 'momentum' else beta1
+        with self._global_lock:
+            self._rules[name] = dict(kind=optimizer, lr=lr, b1=b1,
+                                     b2=beta2, eps=epsilon)
+            self._state[name] = {}
+
     def apply_grad(self, name, grad):
         with self._locks[name]:
-            self._params[name] -= self.lr * grad
+            rule = self._rules.get(name)
+            if rule is None:  # default: global-lr sgd
+                self._params[name] -= self.lr * grad
+                return
+            g = np.asarray(grad, dtype=self._params[name].dtype)
+            st = self._state[name]
+            if rule['kind'] == 'sgd':
+                self._params[name] -= rule['lr'] * g
+            elif rule['kind'] == 'momentum':
+                # velocity = mu*velocity + g; p -= lr*velocity
+                v = st.setdefault('m', np.zeros_like(self._params[name]))
+                v *= rule['b1']
+                v += g
+                self._params[name] -= rule['lr'] * v
+            else:  # adam, matching ops/optimizer_ops.py adam()
+                m = st.setdefault('m', np.zeros_like(self._params[name]))
+                v = st.setdefault('v', np.zeros_like(self._params[name]))
+                st['t'] = st.get('t', 0) + 1
+                b1, b2 = rule['b1'], rule['b2']
+                m *= b1
+                m += (1 - b1) * g
+                v *= b2
+                v += (1 - b2) * g * g
+                lr_t = rule['lr'] * np.sqrt(1 - b2 ** st['t']) / \
+                    (1 - b1 ** st['t'])
+                self._params[name] -= lr_t * m / (np.sqrt(v) +
+                                                  rule['eps'])
 
     def apply_delta(self, name, delta):
         with self._locks[name]:

@@ -3,16 +3,20 @@
 Reference: the gRPC/bRPC parameter plane —
 operators/distributed_ops/listen_and_serv_op.cc:110 (server loop),
 operators/distributed/grpc/grpc_client.h (async client),
-send_recv.proto.in:19 (SendVariable/GetVariable), and
-framework/fleet/fleet_wrapper.h:77-145 (PullSparse/PushSparse).
+send_recv.proto.in:19 (SendVariable/GetVariable),
+framework/fleet/fleet_wrapper.h:77-145 (PullSparse/PushSparse),
+operators/distributed_ops/checkpoint_notify_op.cc:28 (trainer-triggered
+pserver checkpoint), and the rpc_deadline / rpc_retry_times flags
+(python/paddle/fluid/__init__.py:190-198).
 
 TPU-native split: dense TRAINING sync rides XLA collectives, so what
 keeps an RPC plane on TPU is the CTR parameter-server shape — a
-long-lived service process holding dense slots (server-side SGD, the
-reference's optimize sub-blocks) and big sparse row tables (per-row
-adagrad/sgd).  The service itself is native C++
-(runtime/ps_service.cc, threaded TCP, binary frames); this module is
-the ctypes server handle + the client.
+long-lived service process holding dense slots (server-side optimizer
+rules, the reference's optimize sub-blocks) and big sparse row tables
+(per-row sgd/adagrad/adam).  The service itself is native C++
+(runtime/ps_service.cc, threaded TCP, binary frames, protocol v2 with
+status-coded replies); this module is the ctypes server handle + the
+client with deadlines and bounded retries.
 
 RpcParameterServerStore is interface-compatible with
 distributed.ParameterServerStore, so the AsyncCommunicator
@@ -23,6 +27,7 @@ REMOTE server process.
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -36,6 +41,45 @@ OP_SET_ROWS = 7
 OP_BARRIER = 8
 OP_LIST = 9
 OP_ADD_DENSE = 10
+OP_SAVE = 11
+OP_LOAD = 12
+OP_META = 13
+OP_PULL_SHARD = 14
+OP_SET_SHARD = 15
+OP_CONF_DENSE = 16
+OP_REGISTER_TRAINER = 17
+OP_HEARTBEAT = 18
+OP_QUERY_TRAINERS = 19
+
+_DENSE_OPT = {'sgd': 0, 'momentum': 1, 'adam': 2}
+_SPARSE_OPT = {'sgd': 0, 'adagrad': 1, 'adam': 2}
+_SPARSE_OPT_NAMES = {v: k for k, v in _SPARSE_OPT.items()}
+
+HB_RUNNING = 1
+HB_COMPLETED = 2
+_HB_STATUS_NAMES = {0: 'UNINITED', 1: 'RUNNING', 2: 'COMPLETED',
+                    3: 'LOST'}
+
+
+class PsServerError(RuntimeError):
+    """The server replied with an error frame (protocol v2 status=1):
+    the wire-level PADDLE_ENFORCE analog — a buggy request gets a
+    message, not a silent connection drop."""
+
+
+class RpcDeadlineError(ConnectionError):
+    """No reply within FLAGS_rpc_deadline after FLAGS_rpc_retry_times
+    reconnect attempts (reference flags
+    python/paddle/fluid/__init__.py:190-198)."""
+
+
+def _rpc_flags():
+    try:
+        from ..fluid import flags
+        return (flags.get_flag('FLAGS_rpc_deadline', 180000),
+                flags.get_flag('FLAGS_rpc_retry_times', 3))
+    except Exception:
+        return 180000, 3
 
 
 class PsServer(object):
@@ -73,28 +117,88 @@ class PsServer(object):
 class PsClient(object):
     """Blocking client (reference RPCClient / grpc_client.h: the async
     completion-queue machinery collapses to one in-flight request per
-    connection; open several clients for parallelism)."""
+    connection; open several clients for parallelism).
 
-    def __init__(self, endpoint):
+    Every call observes FLAGS_rpc_deadline (milliseconds) and retries a
+    timed-out / broken transport up to FLAGS_rpc_retry_times with a
+    fresh connection; exhaustion raises RpcDeadlineError.  Retries give
+    at-least-once semantics, same as the reference's retry loop."""
+
+    def __init__(self, endpoint, deadline_ms=None, retry_times=None):
         host, port = endpoint.rsplit(':', 1)
-        self._sock = socket.create_connection((host, int(port)))
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        fd, fr = _rpc_flags()
+        self.deadline = (deadline_ms if deadline_ms is not None
+                         else fd) / 1000.0
+        self.retry_times = fr if retry_times is None else retry_times
+        self._sock = None
         # one in-flight request per connection: the lock makes a shared
         # client safe under AsyncCommunicator's per-variable send
         # threads (request/response stay paired)
         self._lock = threading.Lock()
+        try:
+            self._connect()
+        except OSError:
+            # server may not be up yet; _call retries the connection
+            # under the deadline/retry policy and raises
+            # RpcDeadlineError with full context if it stays dead
+            self._sock = None
+
+    def _connect(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self.deadline)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def close(self):
-        self._sock.close()
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
 
     # -- framing ----------------------------------------------------------
-    def _call(self, op, name, payload=b''):
+    def _call(self, op, name, payload=b'', blocking=False):
+        """blocking=True: a call that legitimately parks server-side
+        (BARRIER) — no recv deadline and NO retry, because resending
+        would double-count this caller at the server (the abandoned
+        handler thread is already parked in the barrier)."""
         nb = name.encode()
         frame = struct.pack('<BI', op, len(nb)) + nb + payload
+        msg = struct.pack('<I', len(frame)) + frame
+        retries = 0 if blocking else self.retry_times
         with self._lock:
-            self._sock.sendall(struct.pack('<I', len(frame)) + frame)
-            (rlen,) = struct.unpack('<I', self._recv(4))
-            return self._recv(rlen) if rlen else b''
+            last = None
+            for attempt in range(retries + 1):
+                try:
+                    if self._sock is None or attempt > 0:
+                        self._connect()
+                    if blocking:
+                        self._sock.settimeout(None)
+                    try:
+                        self._sock.sendall(msg)
+                        (rlen,) = struct.unpack('<I', self._recv(4))
+                        body = self._recv(rlen)
+                    finally:
+                        if blocking:
+                            self._sock.settimeout(self.deadline)
+                    break
+                except (socket.timeout, ConnectionError, OSError) as e:
+                    last = e
+            else:
+                raise RpcDeadlineError(
+                    'ps rpc to %s:%d failed after %d attempts with '
+                    '%.1fs deadline each: %s'
+                    % (self._addr[0], self._addr[1], retries + 1,
+                       self.deadline, last))
+        if not body:
+            raise PsServerError('empty reply frame')
+        status, payload = body[0], body[1:]
+        if status != 0:
+            raise PsServerError(payload.decode('utf-8', 'replace'))
+        return payload
 
     def _recv(self, n):
         out = b''
@@ -111,6 +215,16 @@ class PsClient(object):
         self._call(OP_INIT_DENSE, name,
                    struct.pack('<Q', v.size) + v.tobytes())
 
+    def conf_dense(self, name, optimizer='sgd', lr=0.01, momentum=0.9,
+                   beta1=0.9, beta2=0.999, epsilon=1e-8):
+        """Set the per-var server-side update rule (the reference
+        pserver's per-param optimize sub-block,
+        listen_and_serv_op.cc:110 / distribute_transpiler.py:1110)."""
+        kind = _DENSE_OPT[optimizer]
+        b1 = momentum if optimizer == 'momentum' else beta1
+        self._call(OP_CONF_DENSE, name,
+                   struct.pack('<Bffff', kind, lr, b1, beta2, epsilon))
+
     def push_dense_grad(self, name, grad):
         g = np.ascontiguousarray(grad, np.float32).reshape(-1)
         self._call(OP_PUSH_DENSE, name,
@@ -124,15 +238,22 @@ class PsClient(object):
                    struct.pack('<Q', d.size) + d.tobytes())
 
     def pull_dense(self, name):
-        out = self._call(OP_PULL_DENSE, name)
+        try:
+            out = self._call(OP_PULL_DENSE, name)
+        except PsServerError as e:
+            if 'unknown dense var' in str(e):
+                raise KeyError(name)
+            raise
         (n,) = struct.unpack('<Q', out[:8])
         return np.frombuffer(out[8:], np.float32, n).copy()
 
     # -- sparse tables ----------------------------------------------------
-    def init_sparse(self, name, rows, dim, optimizer='sgd', lr=0.01):
-        opt = 1 if optimizer == 'adagrad' else 0
+    def init_sparse(self, name, rows, dim, optimizer='sgd', lr=0.01,
+                    beta1=0.9, beta2=0.999, epsilon=1e-8):
+        opt = _SPARSE_OPT[optimizer]
         self._call(OP_INIT_SPARSE, name,
-                   struct.pack('<QQBf', rows, dim, opt, lr))
+                   struct.pack('<QQBf', rows, dim, opt, lr) +
+                   struct.pack('<fff', beta1, beta2, epsilon))
 
     def set_rows(self, name, ids, values):
         self._rows_op(OP_SET_ROWS, name, ids, values)
@@ -142,22 +263,109 @@ class PsClient(object):
 
     def _rows_op(self, op, name, ids, values):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            return  # zero-row shard (vocab < n_servers): nothing to do
         v = np.ascontiguousarray(values, np.float32).reshape(ids.size, -1)
         self._call(op, name, struct.pack('<Q', ids.size) + ids.tobytes() +
                    v.tobytes())
 
     def pull_rows(self, name, ids, dim):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            return np.zeros((0, dim), np.float32)
         out = self._call(OP_PULL_ROWS, name,
                          struct.pack('<Q', ids.size) + ids.tobytes())
         return np.frombuffer(out, np.float32).reshape(ids.size,
                                                       dim).copy()
 
+    def meta(self, name):
+        """Table metadata, or None if absent: {'kind': 'dense'|'sparse',
+        'n'|('rows','dim'), 'optimizer', 'lr'}."""
+        out = self._call(OP_META, name)
+        kind = out[0]
+        if kind == 0:
+            return None
+        if kind == 1:
+            n, opt, lr = struct.unpack('<QBf', out[1:14])
+            return {'kind': 'dense', 'n': n, 'optimizer': opt, 'lr': lr}
+        rows, dim, opt, lr = struct.unpack('<QQBf', out[1:22])
+        return {'kind': 'sparse', 'rows': rows, 'dim': dim,
+                'optimizer': _SPARSE_OPT_NAMES.get(opt, opt), 'lr': lr}
+
+    def pull_shard(self, name, start, cnt, dim=None):
+        """Raw chunked read of a sparse table [start, start+cnt):
+        returns (rows [k,dim] f32, state dict with optimizer state) —
+        the pull-all leg of checkpointing (reference recv_save_op.cc).
+        Pass `dim` when known to skip the META round-trip per chunk."""
+        if dim is None:
+            m = self.meta(name)
+            if m is None or m['kind'] != 'sparse':
+                raise KeyError(name)
+            dim = m['dim']
+        out = self._call(OP_PULL_SHARD, name,
+                         struct.pack('<QQ', start, cnt))
+        (k,) = struct.unpack('<Q', out[:8])
+        off = 8
+        rows = np.frombuffer(out, np.float32, k * dim, off).reshape(
+            k, dim).copy()
+        off += k * dim * 4
+        skind = out[off]
+        off += 1
+        state = {}
+        if skind == 1:
+            state['acc'] = np.frombuffer(out, np.float32, k, off).copy()
+        elif skind == 2:
+            state['m'] = np.frombuffer(out, np.float32, k * dim,
+                                       off).reshape(k, dim).copy()
+            off += k * dim * 4
+            state['v'] = np.frombuffer(out, np.float32, k * dim,
+                                       off).reshape(k, dim).copy()
+            off += k * dim * 4
+            state['t'] = np.frombuffer(out, np.float32, k, off).copy()
+        return rows, state
+
+    def set_shard(self, name, start, rows, state=None):
+        """Raw chunked write of table rows (and optimizer state) — the
+        restore leg; no optimizer rule is applied."""
+        rows = np.ascontiguousarray(rows, np.float32)
+        k = rows.shape[0]
+        payload = struct.pack('<QQ', start, k) + rows.tobytes()
+        if state:
+            if 'acc' in state:
+                payload += struct.pack('<B', 1) + np.ascontiguousarray(
+                    state['acc'], np.float32).tobytes()
+            elif 'm' in state:
+                payload += (struct.pack('<B', 2) +
+                            np.ascontiguousarray(state['m'],
+                                                 np.float32).tobytes() +
+                            np.ascontiguousarray(state['v'],
+                                                 np.float32).tobytes() +
+                            np.ascontiguousarray(state['t'],
+                                                 np.float32).tobytes())
+        self._call(OP_SET_SHARD, name, payload)
+
+    # -- durability -------------------------------------------------------
+    def save(self, path):
+        """Server-side snapshot of ALL tables + optimizer state to
+        `path`, atomically (tmp+rename).  The checkpoint_notify analog:
+        the trainer triggers, the server persists its own blocks
+        (checkpoint_notify_op.cc:28, recv_save_op.cc)."""
+        self._call(OP_SAVE, path)
+
+    def load(self, path):
+        """Replace all server state from a snapshot (crash recovery in
+        a fresh pserver process)."""
+        self._call(OP_LOAD, path)
+
     # -- control ----------------------------------------------------------
-    def barrier(self, n_trainers):
+    def barrier(self, n_trainers, group=''):
         """send_barrier/fetch_barrier analog: blocks until n_trainers
-        processes reach the barrier."""
-        self._call(OP_BARRIER, '', struct.pack('<Q', n_trainers))
+        processes reach the barrier (indefinitely — a barrier that
+        retried on deadline would double-count this trainer at the
+        server).  Independent `group` names get independent
+        counters."""
+        self._call(OP_BARRIER, group, struct.pack('<Q', n_trainers),
+                   blocking=True)
 
     def list_vars(self):
         out = self._call(OP_LIST, '')
@@ -170,19 +378,106 @@ class PsClient(object):
             off += ln
         return names
 
+    # -- worker liveness (heart_beat_monitor.h analog) --------------------
+    def register_trainer(self, trainer_id, timeout=60.0):
+        self._call(OP_REGISTER_TRAINER, '',
+                   struct.pack('<Qf', trainer_id, timeout))
+
+    def heartbeat(self, trainer_id, status=HB_RUNNING):
+        self._call(OP_HEARTBEAT, '',
+                   struct.pack('<QB', trainer_id, status))
+
+    def query_trainers(self):
+        """{trainer_id: {'status': 'RUNNING'|'COMPLETED'|'LOST'|...,
+        'age': seconds_since_last_heartbeat}}"""
+        out = self._call(OP_QUERY_TRAINERS, '')
+        (count,) = struct.unpack('<I', out[:4])
+        off = 4
+        res = {}
+        for _ in range(count):
+            tid, st, age = struct.unpack('<QBf', out[off:off + 13])
+            off += 13
+            res[tid] = {'status': _HB_STATUS_NAMES.get(st, st),
+                        'age': age}
+        return res
+
+
+class TrainerHeartbeat(object):
+    """Background heartbeat sender: registers this trainer with the
+    pserver and pings on an interval so the server-side monitor can log
+    lost workers (the worker leg of heart_beat_monitor.h — the
+    reference updates liveness on every received grad; a dedicated
+    ping keeps detection alive between pushes too)."""
+
+    def __init__(self, endpoint, trainer_id, timeout=60.0,
+                 interval=None):
+        self.trainer_id = trainer_id
+        self.interval = interval if interval is not None \
+            else max(timeout / 4.0, 0.05)
+        self._client = PsClient(endpoint)
+        self._client.register_trainer(trainer_id, timeout)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._client.heartbeat(self.trainer_id, HB_RUNNING)
+            except (PsServerError, ConnectionError, OSError):
+                pass  # server gone: nothing useful to do from here
+
+    def complete(self):
+        """Mark this trainer COMPLETED and stop pinging.  A dead
+        server must not crash trainer teardown (same policy as the
+        ping loop)."""
+        self._stop.set()
+        self._thread.join()
+        try:
+            self._client.heartbeat(self.trainer_id, HB_COMPLETED)
+        except (PsServerError, ConnectionError, OSError):
+            pass
+        finally:
+            self._client.close()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()
+        self._client.close()
+
 
 class RpcParameterServerStore(object):
     """distributed.ParameterServerStore over the RPC transport: the
     AsyncCommunicator (merge-before-send) talks to a REMOTE native
-    server process through this without changes."""
+    server process through this without changes.
 
-    def __init__(self, endpoint):
+    optimizer/lr (and the momentum/adam hyperparams) configure the
+    SERVER-side update rule per variable at init_var time — the
+    per-param optimize sub-block the reference transpiler installs on
+    the pserver (distribute_transpiler.py:1110)."""
+
+    def __init__(self, endpoint, optimizer=None, lr=None, momentum=0.9,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8):
         self._client = PsClient(endpoint)
+        self._opt = optimizer
+        self._opt_kw = dict(lr=lr, momentum=momentum, beta1=beta1,
+                            beta2=beta2, epsilon=epsilon)
 
     def init_var(self, name, value):
         self._client.init_dense(name, value)
+        if self._opt is not None:
+            kw = dict(self._opt_kw)
+            if kw['lr'] is None:
+                kw['lr'] = 0.01
+            self._client.conf_dense(name, optimizer=self._opt, **kw)
         self._shapes = getattr(self, '_shapes', {})
         self._shapes[name] = np.asarray(value).shape
+
+    def conf_var(self, name, optimizer='sgd', lr=0.01, momentum=0.9,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self._client.conf_dense(name, optimizer=optimizer, lr=lr,
+                                momentum=momentum, beta1=beta1,
+                                beta2=beta2, epsilon=epsilon)
 
     def apply_grad(self, name, grad):
         self._client.push_dense_grad(name, grad)
@@ -197,3 +492,9 @@ class RpcParameterServerStore(object):
 
     def names(self):
         return [n for n in self._client.list_vars()]
+
+    def save(self, path):
+        self._client.save(path)
+
+    def load(self, path):
+        self._client.load(path)

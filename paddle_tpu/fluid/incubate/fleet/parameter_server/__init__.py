@@ -102,22 +102,10 @@ class ParameterServerOptimizer(DistributedOptimizer):
                                                        strategy)
         self._fleet = fleet_ref
         self._server_lr = None
+        self._server_rule = None
         if not getattr(strategy, 'sync_mode', True):
-            from ....optimizer import SGDOptimizer
-            if not isinstance(optimizer, SGDOptimizer):
-                raise ValueError(
-                    'async PS mode applies updates on the embedded '
-                    'server with the SGD rule (the DownpourSGD analog); '
-                    'got %s — use SGD, or sync_mode=True for arbitrary '
-                    'optimizers' % type(optimizer).__name__)
-            lr = getattr(optimizer, '_learning_rate', 1.0)
-            try:
-                self._server_lr = float(lr)
-            except (TypeError, ValueError):
-                raise ValueError(
-                    'async PS mode needs a constant float learning '
-                    'rate (the embedded server applies it per merged '
-                    'update); got %r' % (lr,))
+            self._server_rule = _server_rule_of(optimizer)
+            self._server_lr = self._server_rule['lr']
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -130,7 +118,9 @@ class ParameterServerOptimizer(DistributedOptimizer):
                                      parameter_list, no_grad_set)
         pairs = [(p.name, g.name) for p, g in params_grads
                  if g is not None]
-        program._ps_async = {'pairs': pairs, 'fleet': self._fleet}
+        program._ps_async = {'pairs': pairs, 'fleet': self._fleet,
+                             'rules': {p: self._server_rule
+                                       for p, _ in pairs}}
         # grads have no in-program consumers (no optimizer ops); exempt
         # them from the executor's dead-code elimination
         program._extra_output_names = set(
@@ -148,13 +138,57 @@ def ps_async_step(executor, scope, program):
     fleet_ref._last_scope = scope  # final pull target for stop_worker
     comm = fleet_ref._communicator
     server = fleet_ref._server
+    rules = program._ps_async.get('rules') or {}
+    # conf ONCE PER TRAINER RUN, not once per server lifetime: a
+    # trainer reattaching to a long-lived server must install ITS
+    # optimizer rule, not silently inherit the previous run's
+    conf_done = program._ps_async.setdefault('_conf_done', set())
     for pname, gname in program._ps_async['pairs']:
         if pname not in server.names():
             server.init_var(pname, core.as_array(scope.find_var(pname)))
+        if pname not in conf_done:
+            rule = rules.get(pname)
+            if rule is not None and hasattr(server, 'conf_var'):
+                server.conf_var(pname, **rule)
+            conf_done.add(pname)
         g = scope.find_var(gname)
         if g is not None:
             comm.send(pname, np.asarray(core.as_array(g)))
         scope.set_var(pname, comm.recv(pname))
+
+
+def _server_rule_of(optimizer):
+    """Map a trainer-side Optimizer instance to the server-side update
+    rule the pserver applies (the reference moves the very same
+    optimize ops into listen_and_serv sub-blocks,
+    distribute_transpiler.py:1110 — sgd/momentum/adam supported
+    there and here)."""
+    from ....optimizer import (SGDOptimizer, MomentumOptimizer,
+                               AdamOptimizer)
+    lr = getattr(optimizer, '_learning_rate', 1.0)
+    try:
+        lr = float(lr)
+    except (TypeError, ValueError):
+        raise ValueError(
+            'async PS mode needs a constant float learning rate (the '
+            'server applies it per merged update); got %r' % (lr,))
+    if isinstance(optimizer, AdamOptimizer):
+        return dict(optimizer='adam', lr=lr,
+                    beta1=optimizer._beta1, beta2=optimizer._beta2,
+                    epsilon=optimizer._epsilon)
+    if isinstance(optimizer, MomentumOptimizer):
+        if getattr(optimizer, '_use_nesterov', False):
+            raise ValueError('async PS momentum does not support '
+                             'use_nesterov=True')
+        return dict(optimizer='momentum', lr=lr,
+                    momentum=optimizer._momentum)
+    if isinstance(optimizer, SGDOptimizer):
+        return dict(optimizer='sgd', lr=lr)
+    raise ValueError(
+        'async PS mode applies updates on the server with '
+        'sgd/momentum/adam rules; got %s — use one of those, or '
+        'sync_mode=True for arbitrary optimizers'
+        % type(optimizer).__name__)
 
 
 fleet = ParameterServerFleet()

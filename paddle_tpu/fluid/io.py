@@ -74,16 +74,44 @@ def load_params(executor, dirname, main_program=None, filename=None):
               vars=main_program.all_parameters(), filename=filename)
 
 
+def _program_ps_tables(program):
+    """Parameter-server-resident embedding tables referenced by the
+    program's host ops (host_emb_lookup / distributed_lookup_table /
+    pull_box_sparse): these live OUTSIDE the scope, so a plain var dump
+    misses them — the reference's distributed-aware save exists for
+    exactly this (python/paddle/fluid/io.py:393 splits PS-resident
+    blocks)."""
+    from ..parallel.sparse_embedding import HostShardedEmbedding
+    names = []
+    for op in program.global_block().ops:
+        t = op.attrs.get('table') if hasattr(op, 'attrs') else None
+        if t and t in HostShardedEmbedding._REGISTRY and \
+                t not in names:
+            names.append(t)
+    return [HostShardedEmbedding._REGISTRY[n] for n in names]
+
+
 def save_persistables(executor, dirname, main_program=None, filename=None):
     main_program = main_program or framework.default_main_program()
     save_vars(executor, dirname, main_program,
               vars=_persistable_vars(main_program), filename=filename)
+    tables = _program_ps_tables(main_program)
+    if tables:
+        arrs = {}
+        for t in tables:
+            arrs.update(t.state_dict())
+        np.savez(os.path.join(dirname, '__dist_tables__.npz'), **arrs)
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):
     main_program = main_program or framework.default_main_program()
     load_vars(executor, dirname, main_program,
               vars=_persistable_vars(main_program), filename=filename)
+    path = os.path.join(dirname, '__dist_tables__.npz')
+    if os.path.exists(path):
+        data = dict(np.load(path).items())
+        for t in _program_ps_tables(main_program):
+            t.load_state_dict(data)
 
 
 def _prune_for_inference(program, feeded_var_names, target_vars):

@@ -670,14 +670,14 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
 
 
 def dice_loss(input, label, epsilon=1e-5):
-    from . import tensor as _t
-    label = one_hot(label, depth=input.shape[-1])
-    reduce_dim = list(range(1, len(input.shape)))
-    inse = reduce_sum(input * label, dim=reduce_dim)
-    dice_denominator = reduce_sum(input, dim=reduce_dim) + reduce_sum(
-        label, dim=reduce_dim)
-    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
-    return reduce_mean(dice_score)
+    """Dice-coefficient loss: 1 - 2|A∩B| / (|A|+|B|), averaged over the
+    batch (reference python/paddle/fluid/layers/nn.py dice_loss)."""
+    onehot = one_hot(label, depth=input.shape[-1])
+    axes = list(range(1, len(input.shape)))
+    overlap = reduce_sum(input * onehot, dim=axes)
+    mass = reduce_sum(input, dim=axes) + reduce_sum(onehot, dim=axes)
+    per_example = 1 - 2 * overlap / (mass + epsilon)
+    return reduce_mean(per_example)
 
 
 def relu(x, name=None):

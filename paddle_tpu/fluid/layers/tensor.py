@@ -178,7 +178,14 @@ def linspace(start, stop, num, dtype='float32'):
 
 
 def diag(diagonal):
-    raise NotImplementedError
+    """Square matrix with `diagonal` (1-D) on the main diagonal.
+    Reference python/paddle/fluid/layers/tensor.py diag /
+    operators/diag_op.cc."""
+    helper = LayerHelper('diag')
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op('diag', inputs={'Diagonal': diagonal},
+                     outputs={'Out': out})
+    return out
 
 
 def reverse(x, axis):

@@ -151,18 +151,39 @@ class DistributeTranspiler(object):
         (reference async trainer: grads sent to pservers, params
         recv'd — operators/distributed/communicator.h:175)."""
         pairs = []
+        rules = {}
         lr = None
         keep = []
         for op in block.ops:
             if op.attrs.get('__op_role__') == 'optimize' and \
                     op.input('Param'):
-                if op.type != 'sgd':
+                if op.type not in ('sgd', 'momentum', 'adam'):
                     raise NotImplementedError(
-                        'embedded async PS applies updates with the SGD '
-                        'rule (DownpourSGD analog); transpile a program '
-                        'minimized with SGD, or use sync_mode=True')
-                pairs.append((op.input('Param')[0], op.input('Grad')[0]))
+                        'embedded async PS applies server-side '
+                        'sgd/momentum/adam rules (the optimize '
+                        'sub-blocks of listen_and_serv, '
+                        'distribute_transpiler.py:1110); got %s — '
+                        'use one of those, or sync_mode=True'
+                        % op.type)
+                pname = op.input('Param')[0]
+                pairs.append((pname, op.input('Grad')[0]))
                 lr = self._read_lr(program, op)
+                op_lr = 0.01 if lr is None else lr
+                if op.type == 'momentum':
+                    if op.attrs.get('use_nesterov'):
+                        raise NotImplementedError(
+                            'async PS momentum: use_nesterov=True is '
+                            'not a server-side rule')
+                    rules[pname] = dict(optimizer='momentum', lr=op_lr,
+                                        momentum=op.attrs.get('mu', 0.9))
+                elif op.type == 'adam':
+                    rules[pname] = dict(
+                        optimizer='adam', lr=op_lr,
+                        beta1=op.attrs.get('beta1', 0.9),
+                        beta2=op.attrs.get('beta2', 0.999),
+                        epsilon=op.attrs.get('epsilon', 1e-8))
+                else:
+                    rules[pname] = dict(optimizer='sgd', lr=op_lr)
                 continue
             keep.append(op)
         block.ops[:] = keep
@@ -171,7 +192,8 @@ class DistributeTranspiler(object):
         from ..incubate.fleet.parameter_server import fleet as ps_fleet
         ps_fleet._optimizer = _TranspiledHolder(lr if lr is not None
                                                 else 0.01)
-        program._ps_async = {'pairs': pairs, 'fleet': ps_fleet}
+        program._ps_async = {'pairs': pairs, 'fleet': ps_fleet,
+                             'rules': rules}
         program._extra_output_names = set(
             getattr(program, '_extra_output_names', ())) | set(
             g for _, g in pairs)

@@ -32,11 +32,12 @@ class BertConfig(object):
             else attn_dropout
         self.use_flash = use_flash
         # measured on one v5e-class chip (BENCHMARKS.md): the batched
-        # XLA chain wins at seq<=512 (d=64 per-head blocks underfill
-        # the MXU in the blockwise kernel) and ties at 2048 — where
-        # flash's value is MEMORY: no [T,T] probs in HBM, so long
-        # contexts fit (and compose with ring attention)
-        self.flash_min_len = 1024
+        # round-3 tuned kernels (bf16 MXU dots, 512/1024 blocks —
+        # tools/bench_flash.py): flash beats the naive XLA chain from
+        # seq 512 up (512: 6.3 vs 8.2 ms; 1024: 11.3 vs 21.5;
+        # 2048: 36.7 vs 73.8 fwd+bwd) and only loses in the 256
+        # pocket where XLA's fused chain fits VMEM outright
+        self.flash_min_len = 512
 
 
 BASE = BertConfig()

@@ -26,8 +26,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# Round-3 sweep on the v5-lite chip (tools/bench_flash.py): large
+# blocks dominate for d=64 — underfilled MXU passes cost more than the
+# extra VMEM residency.  512/1024 is the best compiling config at seq
+# 2048 (39.1 ms vs 69.1 ms at 256/256 and 77.7 ms naive XLA) and
+# clamps to 512/512 at seq 512 (5.6 ms vs 7.2 ms naive); 2048-wide
+# blocks exceed VMEM and fail to compile.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
@@ -39,7 +45,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     # q_ref: [1, bq, d]; k/v_ref: [1, T, d]; bias_ref: [1, 1, T];
     # o_ref: [1, bq, d]; lse_ref: [1, 1, bq]  (the singleton middle dim
     # satisfies the TPU block-shape rule for 1-D-per-row operands)
-    q = q_ref[0].astype(jnp.float32)
+    # dots consume the native (usually bf16) dtype and accumulate in
+    # f32 (preferred_element_type): the MXU runs bf16 at 2x f32
+    # throughput and VMEM traffic halves — the pre-cast-to-f32 variant
+    # measured ~25% slower at seq 512
+    q = q_ref[0]
     bq, d = q.shape
     t = k_ref.shape[1]
     q_off = pl.program_id(1) * bq
@@ -48,10 +58,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(
-            jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(
-            jnp.float32)
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * scale
@@ -73,7 +81,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, axis=1)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -103,8 +111,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     dq_ref = rest[-1]
     """Grid (BH, T/bq): recompute p row-blocks from q and lse, then
     dq = sum_k (p * (dO V^T - delta)) K * scale."""
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0].astype(jnp.float32)
     delta = delta_ref[0, 0].astype(jnp.float32)
     # lse cotangent (ring-merge path): dS_ij += p_ij * g_lse_i, so it
@@ -116,10 +124,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     nk = t // block_k
 
     def body(i, dq):
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(
-            jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(
-            jnp.float32)
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * scale
@@ -142,7 +148,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             dd = dd + glse[:, None]
         ds = p * dd * scale
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -166,8 +172,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     """Grid (BH, T/bk): for one K/V block, stream Q row-blocks:
     dv = sum_q p^T dO;  ds_raw = p * (dO V^T - delta);
     dk = sum_q ds_raw^T Q * scale;  dbias = sum_q ds_raw (per key)."""
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     bias = bias_ref[0, 0].astype(jnp.float32) if has_bias else None
     bk, d = k.shape
     t = q_ref.shape[1]
@@ -176,10 +182,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
     def body(j, carry):
         dk, dv, dbias = carry
-        q = q_ref[0, pl.dslice(j * block_q, block_q), :].astype(
-            jnp.float32)
-        do = do_ref[0, pl.dslice(j * block_q, block_q), :].astype(
-            jnp.float32)
+        q = q_ref[0, pl.dslice(j * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(j * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
             jnp.float32)
         delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
@@ -200,7 +204,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         p = jnp.where(jnp.isfinite(s),
                       jnp.exp(s - lse[:, None]), 0.0)
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -209,7 +213,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             dd = dd + glse[:, None]
         ds_raw = p * dd
         dk = dk + jax.lax.dot_general(
-            ds_raw, q, (((0,), (0,)), ((), ()))) * scale
+            ds_raw.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         if has_bias:
             dbias = dbias + jnp.sum(ds_raw, axis=0)
         return dk, dv, dbias

@@ -424,8 +424,29 @@ def where(ctx, ins, attrs):
 
 @register('where_index', no_grad_out_slots=('Out',))
 def where_index(ctx, ins, attrs):
-    raise NotImplementedError(
-        'where_index has data-dependent output shape; use masking on TPU')
+    """Reference operators/where_index_op.cc: indices of nonzero
+    elements, [k, rank] int64.  The true op has a data-dependent output
+    shape, which XLA cannot compile; the TPU-native variant is
+    CAPACITY-PADDED: attrs['capacity'] bounds k, rows beyond the real
+    count are filled with -1 (callers mask on `out[:, 0] >= 0`).
+    Without a capacity the op raises with guidance instead of silently
+    shipping a wrong shape."""
+    cap = attrs.get('capacity')
+    if cap is None:
+        raise NotImplementedError(
+            'where_index has a data-dependent output shape; on TPU '
+            "pass attrs={'capacity': K} for a [K, rank] result padded "
+            'with -1 rows (mask on out[:, 0] >= 0), or use masking')
+    cond = ins['Condition'][0]
+    idx = jnp.nonzero(cond != 0, size=int(cap), fill_value=-1)
+    return {'Out': [jnp.stack([i.astype(jnp.int64) for i in idx],
+                              axis=1)]}
+
+
+@register('diag', no_grad_out_slots=('Out',))
+def diag_op(ctx, ins, attrs):
+    """Reference operators/diag_op.cc: 1-D diagonal -> square matrix."""
+    return {'Out': [jnp.diag(ins['Diagonal'][0])]}
 
 
 @register('flip')

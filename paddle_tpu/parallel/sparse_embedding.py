@@ -314,7 +314,8 @@ class RpcShardedEmbedding(HostShardedEmbedding):
 
     def __init__(self, name, vocab_size, dim, endpoints,
                  optimizer='adagrad', learning_rate=0.05,
-                 initializer_scale=0.01, seed=0, dtype='float32'):
+                 initializer_scale=0.01, seed=0, dtype='float32',
+                 beta1=0.9, beta2=0.999, epsilon=1e-8):
         from ..distributed.rpc_ps import PsClient
         self.name = name or unique_name.generate('rpc_embedding')
         self.vocab_size = vocab_size
@@ -327,12 +328,30 @@ class RpcShardedEmbedding(HostShardedEmbedding):
         # attach-vs-create: a table already living on the servers keeps
         # its trained rows AND optimizer state — a (re)starting trainer
         # must never wipe it (the reference pserver likewise owns table
-        # lifetime across trainer restarts)
-        exists = self.name in self._clients[0].list_vars()
+        # lifetime across trainer restarts) — but a SILENT config
+        # mismatch would corrupt training, so attach verifies shape and
+        # rule against the server's metadata first
+        for e, cl in enumerate(self._clients):
+            rows_e = (vocab_size - e + n - 1) // n
+            m = cl.meta(self.name)
+            if m is not None:
+                if (m['kind'] != 'sparse' or m['rows'] != rows_e or
+                        m['dim'] != dim or
+                        m['optimizer'] != optimizer or
+                        abs(m['lr'] - np.float32(learning_rate)) >
+                        1e-7):
+                    raise ValueError(
+                        'RpcShardedEmbedding %r: server shard %d '
+                        'already holds an incompatible table %r vs '
+                        'requested rows=%d dim=%d optimizer=%s lr=%g'
+                        % (self.name, e, m, rows_e, dim, optimizer,
+                           learning_rate))
+        exists = self._clients[0].meta(self.name) is not None
         for e, cl in enumerate(self._clients):
             rows_e = (vocab_size - e + n - 1) // n
             cl.init_sparse(self.name, rows_e, dim, optimizer=optimizer,
-                           lr=learning_rate)
+                           lr=learning_rate, beta1=beta1, beta2=beta2,
+                           epsilon=epsilon)
         if initializer_scale and not exists:
             full = _init_table(vocab_size, dim, initializer_scale,
                                seed, dtype)
@@ -399,12 +418,102 @@ class RpcShardedEmbedding(HostShardedEmbedding):
 
         self._per_shard(push_shard)
 
+    # -- durability -------------------------------------------------------
+    _SHARD_CHUNK = 65536  # rows per PULL_SHARD/SET_SHARD frame
+
+    def checkpoint(self, dir_path, tag='ps'):
+        """Server-side snapshot: each pserver atomically persists its
+        OWN shard (table + optimizer state) to
+        `dir_path/{tag}.shard{e}.ptps` — the checkpoint_notify analog
+        (checkpoint_notify_op.cc:28: the trainer triggers, the server
+        saves its blocks).  Paths are interpreted by the SERVER
+        process; with servers on other hosts, point dir_path at
+        storage they can reach."""
+        import os
+        paths = [os.path.join(dir_path, '%s.shard%d.ptps' % (tag, e))
+                 for e in range(len(self._clients))]
+        self._per_shard(lambda e, cl: cl.save(paths[e]))
+        return paths
+
+    def restore(self, dir_path, tag='ps'):
+        """Load each shard's snapshot into the (possibly restarted)
+        pserver processes: crash recovery at exact optimizer-state
+        parity."""
+        import os
+        self._per_shard(lambda e, cl: cl.load(
+            os.path.join(dir_path, '%s.shard%d.ptps' % (tag, e))))
+
     def state_dict(self):
-        raise NotImplementedError(
-            'RpcShardedEmbedding state lives on the servers: checkpoint '
-            'from the pserver process')
+        """Pull-all fallback: reassemble the FULL table (and optimizer
+        state) on the trainer, chunked so frames stay bounded —
+        io.py:393-style distributed-aware save where the trainer
+        gathers remote blocks (reference recv_save_op.cc)."""
+        n = len(self._clients)
+        full = np.zeros((self.vocab_size, self.dim), np.float32)
+        states = [None] * n
+
+        def pull_all(e, cl):
+            rows_e = (self.vocab_size - e + n - 1) // n
+            parts, accs, ms, vs, ts = [], [], [], [], []
+            start = 0
+            while start < rows_e:
+                rows, st = cl.pull_shard(self.name, start,
+                                         self._SHARD_CHUNK,
+                                         dim=self.dim)
+                parts.append(rows)
+                for lst, key in ((accs, 'acc'), (ms, 'm'), (vs, 'v'),
+                                 (ts, 't')):
+                    if key in st:
+                        lst.append(st[key])
+                start += rows.shape[0]
+            shard = np.concatenate(parts) if parts else \
+                np.zeros((0, self.dim), np.float32)
+            full[e::n] = shard[:rows_e]
+            states[e] = {k: np.concatenate(v) for k, v in
+                         (('acc', accs), ('m', ms), ('v', vs),
+                          ('t', ts)) if v}
+
+        self._per_shard(pull_all)
+        out = {self.name + '.table': full}
+        # key presence is read from any NON-empty shard: a zero-row
+        # shard (vocab < n_servers) legitimately has no state chunks
+        keys = set()
+        for st in states:
+            keys.update(st or ())
+        for key in ('acc', 'm', 'v', 't'):
+            if key not in keys:
+                continue
+            sample = next(st[key] for st in states if st and key in st)
+            shape = (self.vocab_size,) if sample.ndim == 1 else \
+                (self.vocab_size, self.dim)
+            merged = np.zeros(shape, np.float32)
+            for e in range(n):
+                if states[e] and key in states[e]:
+                    merged[e::n] = states[e][key]
+            out[self.name + '.' + key] = merged
+        return out
 
     def load_state_dict(self, d):
-        raise NotImplementedError(
-            'RpcShardedEmbedding state lives on the servers: restore '
-            'from the pserver process')
+        """Push a full-table state dict back onto the server shards
+        (raw writes; no optimizer rule applied)."""
+        full = np.asarray(d[self.name + '.table'], np.float32)
+        n = len(self._clients)
+
+        def push_all(e, cl):
+            shard = np.ascontiguousarray(full[e::n])
+            state = {}
+            for key in ('acc', 'm', 'v', 't'):
+                if self.name + '.' + key in d:
+                    state[key] = np.ascontiguousarray(
+                        np.asarray(d[self.name + '.' + key],
+                                   np.float32)[e::n])
+            start = 0
+            while start < shard.shape[0]:
+                stop = min(start + self._SHARD_CHUNK, shard.shape[0])
+                chunk_state = {k: v[start:stop]
+                               for k, v in state.items()} or None
+                cl.set_shard(self.name, start, shard[start:stop],
+                             chunk_state)
+                start = stop
+
+        self._per_shard(push_all)

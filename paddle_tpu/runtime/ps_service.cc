@@ -6,25 +6,60 @@
 // Scope: the host-side control/parameter plane only — dense training
 // synchronization rides XLA collectives (ICI/DCN), so what needs RPC on
 // TPU is the CTR-style parameter server: dense slots with server-side
-// SGD (the optimize sub-blocks the reference runs inside
-// listen_and_serv) and sparse row tables with per-row adagrad/sgd
-// (FleetWrapper::PullSparse/PushSparse, fleet_wrapper.h:77-145).
+// optimizer rules (the optimize sub-blocks the reference runs inside
+// listen_and_serv — listen_and_serv_op.cc:110 runs per-param optimize
+// blocks, sgd/momentum/adam alike) and sparse row tables with per-row
+// sgd/adagrad/adam (FleetWrapper::PullSparse/PushSparse,
+// fleet_wrapper.h:77-145).  Durability is first-class, like the
+// reference's checkpoint_notify/recv_save path
+// (operators/distributed_ops/checkpoint_notify_op.cc:28,
+// operators/distributed/request_handler.h:40-47 kRequestCheckpoint):
+// SAVE snapshots every table *and its optimizer state* atomically,
+// LOAD restores it in a fresh process.
 //
-// Wire protocol (little-endian, one request per frame):
-//   [u32 frame_len][u8 op][u32 name_len][name bytes][payload]
+// Wire protocol v2 (little-endian, one request per frame):
+//   request: [u32 frame_len][u8 op][u32 name_len][name bytes][payload]
+//   reply:   [u32 reply_len][u8 status][payload]
+//            status 0 = OK; status 1 = error, payload is a UTF-8
+//            message (the enforce-with-message discipline extended to
+//            the wire — a buggy client gets a diagnosis, not a hang).
 // ops:
-//   1 INIT_DENSE   payload: u64 n, f32[n]          -> u8 ok
-//   2 PUSH_DENSE   payload: u64 n, f32[n] grad     -> u8 ok   (p -= lr*g)
-//   3 PULL_DENSE   payload: -                      -> u64 n, f32[n]
-//   4 INIT_SPARSE  payload: u64 rows, u64 dim, u8 optimizer(0=sgd,
-//                  1=adagrad), f32 lr              -> u8 ok
-//   5 PULL_ROWS    payload: u64 k, i64[k] ids      -> f32[k*dim]
-//   6 PUSH_ROWS    payload: u64 k, i64[k] ids, f32[k*dim] grads -> u8 ok
-//   7 SET_ROWS     payload: u64 k, i64[k] ids, f32[k*dim] vals  -> u8 ok
-//   8 BARRIER      payload: u64 n_trainers -> blocks until n arrive -> u8
-//   9 LIST         payload: -  -> u32 count, {u32 len, name}*
-//  10 ADD_DENSE    payload: u64 n, f32[n] delta   -> u8 ok   (p += d,
-//                  the GeoSGD delta-shipping leg, communicator.h:343)
+//   1 INIT_DENSE   u64 n, f32[n]                 -> ok
+//   2 PUSH_DENSE   u64 n, f32[n] grad            -> ok (per-var rule,
+//                  default sgd at the server's global lr)
+//   3 PULL_DENSE   -                             -> u64 n, f32[n]
+//                  (unknown var is an ERROR, not an empty reply)
+//   4 INIT_SPARSE  u64 rows, u64 dim, u8 opt(0 sgd, 1 adagrad, 2 adam),
+//                  f32 lr [, f32 beta1, f32 beta2, f32 eps]  -> ok
+//   5 PULL_ROWS    u64 k, i64[k] ids             -> f32[k*dim]
+//   6 PUSH_ROWS    u64 k, i64[k] ids, f32[k*dim] grads -> ok
+//   7 SET_ROWS     u64 k, i64[k] ids, f32[k*dim] vals  -> ok
+//   8 BARRIER      u64 n_trainers; name = barrier group (independent
+//                  groups don't share a counter)        -> ok
+//   9 LIST         -                             -> u32 count,
+//                  {u32 len, name}*
+//  10 ADD_DENSE    u64 n, f32[n] delta           -> ok (p += d, GeoSGD)
+//  11 SAVE         name = filesystem path        -> ok (atomic tmp+
+//                  rename snapshot of ALL tables + optimizer state)
+//  12 LOAD         name = filesystem path        -> ok (replaces all)
+//  13 META         name = table                  -> u8 kind(0 absent,
+//                  1 dense: u64 n, u8 opt, f32 lr;
+//                  2 sparse: u64 rows, u64 dim, u8 opt, f32 lr)
+//  14 PULL_SHARD   u64 start, u64 cnt (sparse)   -> u64 k,
+//                  f32 rows[k*dim], u8 skind, state bytes
+//                  (adagrad: f32 acc[k]; adam: f32 m[k*dim],
+//                  f32 v[k*dim], f32 t[k])
+//  15 SET_SHARD    u64 start, u64 k, f32 rows[k*dim], u8 skind,
+//                  state bytes                   -> ok (raw restore,
+//                  no optimizer applied)
+//  16 CONF_DENSE   u8 opt(0 sgd, 1 momentum, 2 adam), f32 lr,
+//                  f32 mu_or_beta1, f32 beta2, f32 eps  -> ok
+//  17 REGISTER_TRAINER u64 id, f32 timeout_sec   -> ok (starts the
+//                  HeartBeatMonitor analog, heart_beat_monitor.h:38)
+//  18 HEARTBEAT   u64 id, u8 status(1 running, 2 completed) -> ok
+//  19 QUERY_TRAINERS -                           -> u32 cnt,
+//                  {u64 id, u8 status(0 uninited, 1 running,
+//                  2 completed, 3 lost), f32 age_sec}*
 // Exported C API (ctypes): ps_serve_start(port, lr) / ps_serve_port /
 // ps_serve_stop.
 
@@ -35,9 +70,12 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
+#include <new>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -47,18 +85,44 @@
 
 namespace {
 
+// optimizer kinds: dense 0 sgd / 1 momentum / 2 adam;
+//                  sparse 0 sgd / 1 adagrad / 2 adam
+struct OptConf {
+  uint8_t kind = 0;
+  float lr = 0.01f;
+  float b1 = 0.9f;   // momentum mu, or adam beta1
+  float b2 = 0.999f;
+  float eps = 1e-8f;
+};
+
 struct Dense {
   std::vector<float> value;
+  OptConf opt;
+  bool has_conf = false;    // false -> global-lr sgd (v1 behavior)
+  std::vector<float> m, v;  // momentum velocity / adam moments
+  uint64_t t = 0;           // adam step count
   std::mutex mu;
 };
 
 struct Sparse {
   uint64_t rows = 0, dim = 0;
-  uint8_t optimizer = 0;  // 0 sgd, 1 adagrad
-  float lr = 0.01f;
+  OptConf opt;
   std::vector<float> table;
-  std::vector<float> acc;  // adagrad accumulator, one per row
+  std::vector<float> acc;    // adagrad: one accumulator per row
+  std::vector<float> m, v;   // adam: per-element moments
+  std::vector<float> t;      // adam: per-row step count
   std::mutex mu;
+};
+
+struct BarState {
+  uint64_t count = 0, gen = 0;
+};
+
+struct Trainer {
+  uint8_t status = 0;  // 0 uninited, 1 running, 2 completed
+  bool lost = false;
+  float timeout = 60.f;
+  std::chrono::steady_clock::time_point stamp;
 };
 
 struct Server {
@@ -73,10 +137,21 @@ struct Server {
   std::map<std::string, Sparse *> sparse;
   std::mutex conns_mu;
   std::vector<int> conns;  // open connection fds, for stop()
-  // barrier state (reference: send_barrier / fetch_barrier ops)
+  // barrier state keyed by group name (reference: send_barrier /
+  // fetch_barrier ops; independent groups must not share a counter)
   std::mutex bar_mu;
   std::condition_variable bar_cv;
-  uint64_t bar_count = 0, bar_gen = 0;
+  std::map<std::string, BarState> barriers;
+  // worker-liveness monitor (heart_beat_monitor.h:38-104 analog)
+  std::mutex hb_mu;
+  std::map<uint64_t, Trainer> trainers;
+  std::thread hb_thread;
+  bool hb_started = false;
+  // tables replaced by LOAD are retired here, not deleted: worker
+  // threads may still hold pointers fetched before the LOAD (they
+  // lock the per-table mutex, which stays valid); freed at stop()
+  std::vector<Dense *> retired_dense;
+  std::vector<Sparse *> retired_sparse;
 };
 
 bool read_all(int fd, void *buf, size_t n) {
@@ -101,15 +176,30 @@ bool write_all(int fd, const void *buf, size_t n) {
   return true;
 }
 
-bool reply(int fd, const void *payload, uint32_t n) {
-  uint32_t len = n;
-  if (!write_all(fd, &len, 4)) return false;
+// reply = [u32 len][u8 status][payload]; len counts status + payload.
+// Header and payload are written separately — no second copy of
+// multi-MB pull replies (TCP_NODELAY is on, but the 5-byte header
+// coalesces with the payload in the send buffer anyway).
+bool reply(int fd, uint8_t status, const void *payload, uint32_t n) {
+  char hdr[5];
+  uint32_t len = n + 1;
+  std::memcpy(hdr, &len, 4);
+  hdr[4] = static_cast<char>(status);
+  if (!write_all(fd, hdr, 5)) return false;
   return n == 0 || write_all(fd, payload, n);
 }
 
-bool reply_ok(int fd) {
-  uint8_t ok = 1;
-  return reply(fd, &ok, 1);
+bool reply_ok(int fd) { return reply(fd, 0, nullptr, 0); }
+
+bool reply_ok(int fd, const std::vector<char> &payload) {
+  return reply(fd, 0, payload.data(),
+               static_cast<uint32_t>(payload.size()));
+}
+
+// error frame: the connection SURVIVES — the client gets a message
+// instead of a hang/EOF (reference enforce discipline on the wire)
+bool reply_err(int fd, const std::string &msg) {
+  return reply(fd, 1, msg.data(), static_cast<uint32_t>(msg.size()));
 }
 
 template <typename T>
@@ -120,9 +210,725 @@ T take(const char *&p) {
   return v;
 }
 
+template <typename T>
+void put(std::vector<char> &out, const T &v) {
+  const char *p = reinterpret_cast<const char *>(&v);
+  out.insert(out.end(), p, p + sizeof(T));
+}
+
+void put_bytes(std::vector<char> &out, const void *data, size_t n) {
+  const char *p = static_cast<const char *>(data);
+  out.insert(out.end(), p, p + n);
+}
+
 // bytes left in the request buffer from p
 inline size_t avail(const std::vector<char> &buf, const char *p) {
   return static_cast<size_t>(buf.data() + buf.size() - p);
+}
+
+// overflow-safe "payload holds count elements of width bytes" check:
+// count comes from the wire, so count * width may wrap; divide instead
+inline bool fits(const std::vector<char> &buf, const char *p,
+                 uint64_t count, uint64_t width) {
+  return width == 0 || count <= avail(buf, p) / width;
+}
+
+// ---- optimizer rules (the reference's optimize sub-blocks) -----------
+
+void dense_apply(Server *s, Dense *d, const float *g, uint64_t n) {
+  if (!d->has_conf) {  // v1 behavior: global-lr sgd
+    for (uint64_t i = 0; i < n; ++i) d->value[i] -= s->lr * g[i];
+    return;
+  }
+  const OptConf &c = d->opt;
+  if (c.kind == 0) {  // sgd
+    for (uint64_t i = 0; i < n; ++i) d->value[i] -= c.lr * g[i];
+  } else if (c.kind == 1) {  // momentum: v = mu*v + g; p -= lr*v
+    if (d->m.size() != n) d->m.assign(n, 0.f);
+    for (uint64_t i = 0; i < n; ++i) {
+      d->m[i] = c.b1 * d->m[i] + g[i];
+      d->value[i] -= c.lr * d->m[i];
+    }
+  } else {  // adam, matching ops/optimizer_ops.py adam():
+    // lr_t = lr*sqrt(1-b2^t)/(1-b1^t); p -= lr_t*m/(sqrt(v)+eps)
+    // (both moments checked independently: a momentum->adam
+    // reconfigure leaves m sized but v empty)
+    if (d->m.size() != n) d->m.assign(n, 0.f);
+    if (d->v.size() != n) d->v.assign(n, 0.f);
+    d->t += 1;
+    float b1t = std::pow(c.b1, static_cast<float>(d->t));
+    float b2t = std::pow(c.b2, static_cast<float>(d->t));
+    float lr_t = c.lr * std::sqrt(1.f - b2t) / (1.f - b1t);
+    for (uint64_t i = 0; i < n; ++i) {
+      d->m[i] = c.b1 * d->m[i] + (1.f - c.b1) * g[i];
+      d->v[i] = c.b2 * d->v[i] + (1.f - c.b2) * g[i] * g[i];
+      d->value[i] -= lr_t * d->m[i] / (std::sqrt(d->v[i]) + c.eps);
+    }
+  }
+}
+
+void sparse_row_apply(Sparse *t, uint64_t r, const float *g) {
+  float *row = &t->table[r * t->dim];
+  const OptConf &c = t->opt;
+  if (c.kind == 1) {  // adagrad: per-row mean-square accumulator
+    float sq = 0.f;
+    for (uint64_t j = 0; j < t->dim; ++j) sq += g[j] * g[j];
+    t->acc[r] += sq / t->dim;
+    float scale = c.lr / (std::sqrt(t->acc[r]) + 1e-6f);
+    for (uint64_t j = 0; j < t->dim; ++j) row[j] -= scale * g[j];
+  } else if (c.kind == 2) {  // per-row adam with per-row step count
+    t->t[r] += 1.f;
+    float b1t = std::pow(c.b1, t->t[r]);
+    float b2t = std::pow(c.b2, t->t[r]);
+    float lr_t = c.lr * std::sqrt(1.f - b2t) / (1.f - b1t);
+    float *m = &t->m[r * t->dim], *v = &t->v[r * t->dim];
+    for (uint64_t j = 0; j < t->dim; ++j) {
+      m[j] = c.b1 * m[j] + (1.f - c.b1) * g[j];
+      v[j] = c.b2 * v[j] + (1.f - c.b2) * g[j] * g[j];
+      row[j] -= lr_t * m[j] / (std::sqrt(v[j]) + c.eps);
+    }
+  } else {  // sgd
+    for (uint64_t j = 0; j < t->dim; ++j) row[j] -= c.lr * g[j];
+  }
+}
+
+// ---- checkpoint file (SAVE/LOAD) -------------------------------------
+// format: "PTPS" u32 version=2, u32 n_dense, u32 n_sparse, then
+// dense: u32 nlen, name, u8 has_conf, OptConf, u64 t, u64 n, f32[n]
+//        value, u64 mlen, f32[mlen] m, u64 vlen, f32[vlen] v
+// sparse: u32 nlen, name, OptConf, u64 rows, u64 dim,
+//        f32[rows*dim] table, u64 acclen, f32 acc, u64 mlen, f32 m,
+//        u64 vlen, f32 v, u64 tlen, f32 t
+
+const uint32_t kMagic = 0x53505450;  // "PTPS"
+
+void write_vec(FILE *f, const std::vector<float> &v) {
+  uint64_t n = v.size();
+  std::fwrite(&n, 8, 1, f);
+  if (n) std::fwrite(v.data(), 4, n, f);
+}
+
+bool read_vec(FILE *f, std::vector<float> *v, uint64_t max_elems) {
+  uint64_t n = 0;
+  if (std::fread(&n, 8, 1, f) != 1 || n > max_elems) return false;
+  v->resize(n);
+  return n == 0 || std::fread(v->data(), 4, n, f) == n;
+}
+
+void write_str(FILE *f, const std::string &s2) {
+  uint32_t l = static_cast<uint32_t>(s2.size());
+  std::fwrite(&l, 4, 1, f);
+  std::fwrite(s2.data(), 1, l, f);
+}
+
+bool read_str(FILE *f, std::string *s2) {
+  uint32_t l = 0;
+  if (std::fread(&l, 4, 1, f) != 1 || l > (1u << 20)) return false;
+  s2->resize(l);
+  return l == 0 || std::fread(&(*s2)[0], 1, l, f) == l;
+}
+
+bool save_snapshot(Server *s, const std::string &path,
+                   std::string *err) {
+  std::string tmp = path + ".tmp";
+  FILE *f = std::fopen(tmp.c_str(), "wb");
+  if (!f) {
+    *err = "cannot open " + tmp + " for writing";
+    return false;
+  }
+  std::lock_guard<std::mutex> g(s->tables_mu);
+  std::fwrite(&kMagic, 4, 1, f);
+  uint32_t ver = 2;
+  std::fwrite(&ver, 4, 1, f);
+  uint32_t nd = static_cast<uint32_t>(s->dense.size());
+  uint32_t ns = static_cast<uint32_t>(s->sparse.size());
+  std::fwrite(&nd, 4, 1, f);
+  std::fwrite(&ns, 4, 1, f);
+  for (auto &kv : s->dense) {
+    Dense *d = kv.second;
+    std::lock_guard<std::mutex> gd(d->mu);
+    write_str(f, kv.first);
+    uint8_t hc = d->has_conf ? 1 : 0;
+    std::fwrite(&hc, 1, 1, f);
+    std::fwrite(&d->opt, sizeof(OptConf), 1, f);
+    std::fwrite(&d->t, 8, 1, f);
+    write_vec(f, d->value);
+    write_vec(f, d->m);
+    write_vec(f, d->v);
+  }
+  for (auto &kv : s->sparse) {
+    Sparse *t = kv.second;
+    std::lock_guard<std::mutex> gt(t->mu);
+    write_str(f, kv.first);
+    std::fwrite(&t->opt, sizeof(OptConf), 1, f);
+    std::fwrite(&t->rows, 8, 1, f);
+    std::fwrite(&t->dim, 8, 1, f);
+    write_vec(f, t->table);
+    write_vec(f, t->acc);
+    write_vec(f, t->m);
+    write_vec(f, t->v);
+    write_vec(f, t->t);
+  }
+  bool ok = std::fflush(f) == 0;
+  ok = (std::fclose(f) == 0) && ok;
+  if (!ok || std::rename(tmp.c_str(), path.c_str()) != 0) {
+    std::remove(tmp.c_str());
+    *err = "write/rename failed for " + path;
+    return false;
+  }
+  return true;
+}
+
+// validation: every vector's size must be consistent with the table
+// geometry and optimizer kind — a file that merely PARSES must not be
+// able to plant out-of-bounds row pointers behind PULL/PUSH_ROWS
+bool dense_consistent(const Dense *d) {
+  size_t n = d->value.size();
+  if (d->opt.kind > 2) return false;
+  if (!d->m.empty() && d->m.size() != n) return false;
+  if (!d->v.empty() && d->v.size() != n) return false;
+  return true;
+}
+
+bool sparse_consistent(const Sparse *t) {
+  if (t->opt.kind > 2 || t->dim == 0) return false;
+  if (t->rows > (1ull << 40) / t->dim) return false;
+  if (t->table.size() != t->rows * t->dim) return false;
+  if (t->opt.kind == 1 && t->acc.size() != t->rows) return false;
+  if (t->opt.kind == 2 &&
+      (t->m.size() != t->rows * t->dim ||
+       t->v.size() != t->rows * t->dim || t->t.size() != t->rows))
+    return false;
+  return true;
+}
+
+bool load_snapshot(Server *s, const std::string &path,
+                   std::string *err) try {
+  FILE *f = std::fopen(path.c_str(), "rb");
+  if (!f) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  // cap every vector read by the file's own size: a bit-flipped count
+  // cannot trigger a multi-GB resize (bad_alloc) or a huge fread
+  std::fseek(f, 0, SEEK_END);
+  long fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  uint64_t max_elems = fsize > 0 ? static_cast<uint64_t>(fsize) / 4 : 0;
+  uint32_t magic = 0, ver = 0, nd = 0, ns = 0;
+  if (std::fread(&magic, 4, 1, f) != 1 || magic != kMagic ||
+      std::fread(&ver, 4, 1, f) != 1 || ver != 2 ||
+      std::fread(&nd, 4, 1, f) != 1 || std::fread(&ns, 4, 1, f) != 1) {
+    std::fclose(f);
+    *err = "bad snapshot header in " + path;
+    return false;
+  }
+  std::map<std::string, Dense *> dense;
+  std::map<std::string, Sparse *> sparse;
+  bool ok = true;
+  for (uint32_t i = 0; ok && i < nd; ++i) {
+    std::string name;
+    Dense *d = new Dense();
+    uint8_t hc = 0;
+    ok = read_str(f, &name) && std::fread(&hc, 1, 1, f) == 1 &&
+         std::fread(&d->opt, sizeof(OptConf), 1, f) == 1 &&
+         std::fread(&d->t, 8, 1, f) == 1 &&
+         read_vec(f, &d->value, max_elems) &&
+         read_vec(f, &d->m, max_elems) &&
+         read_vec(f, &d->v, max_elems) && dense_consistent(d);
+    d->has_conf = hc != 0;
+    if (ok) dense[name] = d; else delete d;
+  }
+  for (uint32_t i = 0; ok && i < ns; ++i) {
+    std::string name;
+    Sparse *t = new Sparse();
+    ok = read_str(f, &name) &&
+         std::fread(&t->opt, sizeof(OptConf), 1, f) == 1 &&
+         std::fread(&t->rows, 8, 1, f) == 1 &&
+         std::fread(&t->dim, 8, 1, f) == 1 &&
+         read_vec(f, &t->table, max_elems) &&
+         read_vec(f, &t->acc, max_elems) &&
+         read_vec(f, &t->m, max_elems) &&
+         read_vec(f, &t->v, max_elems) &&
+         read_vec(f, &t->t, max_elems) && sparse_consistent(t);
+    if (ok) sparse[name] = t; else delete t;
+  }
+  std::fclose(f);
+  if (!ok) {
+    for (auto &kv : dense) delete kv.second;
+    for (auto &kv : sparse) delete kv.second;
+    *err = "truncated/corrupt snapshot " + path;
+    return false;
+  }
+  // install WITHOUT freeing live objects: worker threads may hold
+  // pointers fetched before this LOAD.  Existing tables get their
+  // CONTENTS swapped under their own mutex (in-flight ops see either
+  // old or new state, never freed memory); replaced/new objects are
+  // retired/inserted under tables_mu.
+  std::lock_guard<std::mutex> g(s->tables_mu);
+  for (auto &kv : dense) {
+    auto it = s->dense.find(kv.first);
+    if (it != s->dense.end()) {
+      Dense *live = it->second, *in = kv.second;
+      std::lock_guard<std::mutex> gd(live->mu);
+      live->value.swap(in->value);
+      live->m.swap(in->m);
+      live->v.swap(in->v);
+      live->t = in->t;
+      live->opt = in->opt;
+      live->has_conf = in->has_conf;
+      delete in;
+    } else {
+      s->dense[kv.first] = kv.second;
+    }
+  }
+  for (auto &kv : sparse) {
+    auto it = s->sparse.find(kv.first);
+    if (it != s->sparse.end()) {
+      Sparse *live = it->second, *in = kv.second;
+      std::lock_guard<std::mutex> gt(live->mu);
+      live->table.swap(in->table);
+      live->acc.swap(in->acc);
+      live->m.swap(in->m);
+      live->v.swap(in->v);
+      live->t.swap(in->t);
+      live->rows = in->rows;
+      live->dim = in->dim;
+      live->opt = in->opt;
+      delete in;
+    } else {
+      s->sparse[kv.first] = kv.second;
+    }
+  }
+  // tables absent from the snapshot: unlink (retire, don't free)
+  for (auto it = s->dense.begin(); it != s->dense.end();) {
+    if (!dense.count(it->first)) {
+      s->retired_dense.push_back(it->second);
+      it = s->dense.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (auto it = s->sparse.begin(); it != s->sparse.end();) {
+    if (!sparse.count(it->first)) {
+      s->retired_sparse.push_back(it->second);
+      it = s->sparse.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return true;
+} catch (const std::bad_alloc &) {
+  *err = "snapshot too large to load: " + path;
+  return false;
+}
+
+// ---- heartbeat monitor (heart_beat_monitor.h:38-104 analog) ----------
+
+void hb_loop(Server *s) {
+  while (!s->stop.load()) {
+    {
+      std::lock_guard<std::mutex> g(s->hb_mu);
+      auto now = std::chrono::steady_clock::now();
+      for (auto &kv : s->trainers) {
+        Trainer &t = kv.second;
+        if (t.status != 1 || t.lost) continue;
+        float age = std::chrono::duration<float>(now - t.stamp).count();
+        if (age > t.timeout) {
+          t.lost = true;
+          std::fprintf(stderr,
+                       "[ps_service] trainer %llu lost: no heartbeat "
+                       "for %.1fs (timeout %.1fs)\n",
+                       static_cast<unsigned long long>(kv.first), age,
+                       t.timeout);
+        }
+      }
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+}
+
+// ---- request dispatch ------------------------------------------------
+
+// returns false when the connection should close (socket error); all
+// in-protocol failures send an error frame and keep the connection
+bool process_frame(Server *s, int fd, const std::vector<char> &buf) {
+  const char *p = buf.data();
+  if (avail(buf, p) < 5) return reply_err(fd, "frame shorter than header");
+  uint8_t op = take<uint8_t>(p);
+  uint32_t nlen = take<uint32_t>(p);
+  if (avail(buf, p) < nlen)
+    return reply_err(fd, "name extends past frame");
+  std::string name(p, p + nlen);
+  p += nlen;
+
+  if (op == 1 || op == 2 || op == 10) {  // INIT/PUSH/ADD dense
+    if (avail(buf, p) < 8) return reply_err(fd, "missing dense count");
+    uint64_t n = take<uint64_t>(p);
+    if (!fits(buf, p, n, 4))
+      return reply_err(fd, "dense payload shorter than count");
+    Dense *d = nullptr;
+    {
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      auto it = s->dense.find(name);
+      if (it == s->dense.end()) {
+        if (op != 1)
+          return reply_err(fd, "dense var '" + name +
+                                   "' not initialized (INIT_DENSE first)");
+        d = new Dense();
+        d->value.assign(n, 0.f);
+        s->dense[name] = d;
+      } else {
+        d = it->second;
+      }
+    }
+    std::lock_guard<std::mutex> g(d->mu);
+    const float *vals = reinterpret_cast<const float *>(p);
+    if (op == 1) {
+      d->value.assign(vals, vals + n);
+    } else {
+      if (d->value.size() != n)
+        return reply_err(fd, "dense var '" + name + "' has " +
+                                 std::to_string(d->value.size()) +
+                                 " elements, payload has " +
+                                 std::to_string(n));
+      if (op == 2) {
+        dense_apply(s, d, vals, n);
+      } else {  // ADD_DENSE: GeoSGD delta
+        for (uint64_t i = 0; i < n; ++i) d->value[i] += vals[i];
+      }
+    }
+    return reply_ok(fd);
+  }
+
+  if (op == 3) {  // PULL_DENSE
+    Dense *d = nullptr;
+    {
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      auto it = s->dense.find(name);
+      if (it != s->dense.end()) d = it->second;
+    }
+    if (!d)
+      return reply_err(fd, "unknown dense var '" + name + "'");
+    std::lock_guard<std::mutex> g(d->mu);
+    uint64_t n = d->value.size();
+    std::vector<char> out;
+    out.reserve(8 + n * 4);
+    put(out, n);
+    put_bytes(out, d->value.data(), n * 4);
+    return reply_ok(fd, out);
+  }
+
+  if (op == 16) {  // CONF_DENSE
+    if (avail(buf, p) < 1 + 4 * 4)
+      return reply_err(fd, "CONF_DENSE payload too short");
+    OptConf c;
+    c.kind = take<uint8_t>(p);
+    c.lr = take<float>(p);
+    c.b1 = take<float>(p);
+    c.b2 = take<float>(p);
+    c.eps = take<float>(p);
+    if (c.kind > 2)
+      return reply_err(fd, "dense optimizer kind must be 0/1/2");
+    Dense *d = nullptr;
+    {
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      auto it = s->dense.find(name);
+      if (it == s->dense.end()) {
+        d = new Dense();
+        s->dense[name] = d;  // conf-before-init is fine
+      } else {
+        d = it->second;
+      }
+    }
+    std::lock_guard<std::mutex> g(d->mu);
+    if (d->has_conf && d->opt.kind != c.kind) {
+      // rule change invalidates the old optimizer state
+      d->m.clear();
+      d->v.clear();
+      d->t = 0;
+    }
+    d->opt = c;
+    d->has_conf = true;
+    return reply_ok(fd);
+  }
+
+  if (op == 4) {  // INIT_SPARSE
+    if (avail(buf, p) < 21)
+      return reply_err(fd, "INIT_SPARSE payload too short");
+    uint64_t rows = take<uint64_t>(p);
+    uint64_t dim = take<uint64_t>(p);
+    uint8_t opt = take<uint8_t>(p);
+    float lr = take<float>(p);
+    OptConf c;
+    c.kind = opt;
+    c.lr = lr;
+    if (avail(buf, p) >= 12) {  // optional adam hyperparams
+      c.b1 = take<float>(p);
+      c.b2 = take<float>(p);
+      c.eps = take<float>(p);
+    }
+    if (opt > 2)
+      return reply_err(fd, "sparse optimizer kind must be 0/1/2");
+    if (dim == 0 || rows > (1ull << 40) / (dim ? dim : 1))
+      return reply_err(fd, "sparse table too large or dim==0");
+    std::lock_guard<std::mutex> g(s->tables_mu);
+    if (!s->sparse.count(name)) {
+      Sparse *t = new Sparse();
+      t->rows = rows;
+      t->dim = dim;
+      t->opt = c;
+      t->table.assign(rows * dim, 0.f);
+      if (opt == 1) t->acc.assign(rows, 0.f);
+      if (opt == 2) {
+        t->m.assign(rows * dim, 0.f);
+        t->v.assign(rows * dim, 0.f);
+        t->t.assign(rows, 0.f);
+      }
+      s->sparse[name] = t;
+    }
+    return reply_ok(fd);
+  }
+
+  if (op == 5 || op == 6 || op == 7) {  // ROWS ops
+    Sparse *t = nullptr;
+    {
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      auto it = s->sparse.find(name);
+      if (it != s->sparse.end()) t = it->second;
+    }
+    if (!t)
+      return reply_err(fd, "unknown sparse table '" + name + "'");
+    if (avail(buf, p) < 8) return reply_err(fd, "missing row count");
+    uint64_t k = take<uint64_t>(p);
+    if (!fits(buf, p, k, 8))
+      return reply_err(fd, "ids payload shorter than count");
+    const int64_t *ids = reinterpret_cast<const int64_t *>(p);
+    p += k * 8;
+    std::lock_guard<std::mutex> g(t->mu);
+    if (op == 5) {  // PULL_ROWS
+      std::vector<char> out(k * t->dim * 4, 0);
+      float *dst = reinterpret_cast<float *>(out.data());
+      for (uint64_t i = 0; i < k; ++i) {
+        if (ids[i] < 0 || static_cast<uint64_t>(ids[i]) >= t->rows)
+          continue;  // out-of-range id: row reads as zeros
+        const float *src =
+            &t->table[static_cast<uint64_t>(ids[i]) * t->dim];
+        std::memcpy(dst + i * t->dim, src, t->dim * 4);
+      }
+      return reply_ok(fd, out);
+    }
+    if (!fits(buf, p, k, t->dim * 4))
+      return reply_err(fd, "row payload shorter than k*dim");
+    const float *vals = reinterpret_cast<const float *>(p);
+    for (uint64_t i = 0; i < k; ++i) {
+      if (ids[i] < 0 || static_cast<uint64_t>(ids[i]) >= t->rows)
+        continue;  // out-of-range id: drop the update
+      uint64_t r = static_cast<uint64_t>(ids[i]);
+      const float *v = vals + i * t->dim;
+      if (op == 7) {  // SET_ROWS
+        std::memcpy(&t->table[r * t->dim], v, t->dim * 4);
+      } else {
+        sparse_row_apply(t, r, v);
+      }
+    }
+    return reply_ok(fd);
+  }
+
+  if (op == 14) {  // PULL_SHARD
+    Sparse *t = nullptr;
+    {
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      auto it = s->sparse.find(name);
+      if (it != s->sparse.end()) t = it->second;
+    }
+    if (!t)
+      return reply_err(fd, "unknown sparse table '" + name + "'");
+    if (avail(buf, p) < 16)
+      return reply_err(fd, "PULL_SHARD needs start,cnt");
+    uint64_t start = take<uint64_t>(p);
+    uint64_t cnt = take<uint64_t>(p);
+    std::lock_guard<std::mutex> g(t->mu);
+    if (start > t->rows) start = t->rows;
+    uint64_t k = std::min(cnt, t->rows - start);
+    std::vector<char> out;
+    out.reserve(8 + k * t->dim * 4 + 1);
+    put(out, k);
+    uint8_t skind = t->opt.kind;
+    if (k == 0) {  // zero-row shard: no element addresses to take
+      put(out, skind);
+      return reply_ok(fd, out);
+    }
+    put_bytes(out, &t->table[start * t->dim], k * t->dim * 4);
+    put(out, skind);
+    if (skind == 1) {
+      put_bytes(out, &t->acc[start], k * 4);
+    } else if (skind == 2) {
+      put_bytes(out, &t->m[start * t->dim], k * t->dim * 4);
+      put_bytes(out, &t->v[start * t->dim], k * t->dim * 4);
+      put_bytes(out, &t->t[start], k * 4);
+    }
+    return reply_ok(fd, out);
+  }
+
+  if (op == 15) {  // SET_SHARD (raw restore incl. optimizer state)
+    Sparse *t = nullptr;
+    {
+      std::lock_guard<std::mutex> g(s->tables_mu);
+      auto it = s->sparse.find(name);
+      if (it != s->sparse.end()) t = it->second;
+    }
+    if (!t)
+      return reply_err(fd, "unknown sparse table '" + name + "'");
+    if (avail(buf, p) < 16)
+      return reply_err(fd, "SET_SHARD needs start,k");
+    uint64_t start = take<uint64_t>(p);
+    uint64_t k = take<uint64_t>(p);
+    std::lock_guard<std::mutex> g(t->mu);
+    if (start > t->rows || k > t->rows - start)
+      return reply_err(fd, "SET_SHARD range out of bounds");
+    if (k == 0) return reply_ok(fd);  // empty shard: no addresses
+    if (!fits(buf, p, k, t->dim * 4))
+      return reply_err(fd, "row payload shorter than k*dim");
+    std::memcpy(&t->table[start * t->dim], p, k * t->dim * 4);
+    p += k * t->dim * 4;
+    if (avail(buf, p) >= 1) {
+      uint8_t skind = take<uint8_t>(p);
+      if (skind != t->opt.kind)
+        return reply_err(fd, "optimizer state kind mismatch");
+      if (skind == 1) {
+        if (!fits(buf, p, k, 4))
+          return reply_err(fd, "acc payload too short");
+        std::memcpy(&t->acc[start], p, k * 4);
+      } else if (skind == 2) {
+        if (!fits(buf, p, k, t->dim * 8 + 4))
+          return reply_err(fd, "adam state payload too short");
+        std::memcpy(&t->m[start * t->dim], p, k * t->dim * 4);
+        p += k * t->dim * 4;
+        std::memcpy(&t->v[start * t->dim], p, k * t->dim * 4);
+        p += k * t->dim * 4;
+        std::memcpy(&t->t[start], p, k * 4);
+      }
+    }
+    return reply_ok(fd);
+  }
+
+  if (op == 13) {  // META
+    std::lock_guard<std::mutex> g(s->tables_mu);
+    std::vector<char> out;
+    auto itd = s->dense.find(name);
+    auto its = s->sparse.find(name);
+    if (itd != s->dense.end()) {
+      put<uint8_t>(out, 1);
+      put<uint64_t>(out, itd->second->value.size());
+      put<uint8_t>(out, itd->second->opt.kind);
+      put<float>(out, itd->second->has_conf ? itd->second->opt.lr
+                                            : s->lr);
+    } else if (its != s->sparse.end()) {
+      put<uint8_t>(out, 2);
+      put<uint64_t>(out, its->second->rows);
+      put<uint64_t>(out, its->second->dim);
+      put<uint8_t>(out, its->second->opt.kind);
+      put<float>(out, its->second->opt.lr);
+    } else {
+      put<uint8_t>(out, 0);
+    }
+    return reply_ok(fd, out);
+  }
+
+  if (op == 8) {  // BARRIER (keyed by name)
+    if (avail(buf, p) < 8)
+      return reply_err(fd, "BARRIER needs n_trainers");
+    uint64_t want = take<uint64_t>(p);
+    if (want == 0) return reply_err(fd, "n_trainers must be >= 1");
+    std::unique_lock<std::mutex> g(s->bar_mu);
+    BarState &b = s->barriers[name];
+    uint64_t gen = b.gen;
+    if (++b.count >= want) {
+      b.count = 0;
+      ++b.gen;
+      s->bar_cv.notify_all();
+    } else {
+      s->bar_cv.wait(g, [&] {
+        return b.gen != gen || s->stop.load();
+      });
+    }
+    g.unlock();
+    return reply_ok(fd);
+  }
+
+  if (op == 9) {  // LIST
+    std::lock_guard<std::mutex> g(s->tables_mu);
+    std::vector<char> out;
+    uint32_t count =
+        static_cast<uint32_t>(s->dense.size() + s->sparse.size());
+    put(out, count);
+    auto add = [&out](const std::string &n) {
+      put(out, static_cast<uint32_t>(n.size()));
+      put_bytes(out, n.data(), n.size());
+    };
+    for (auto &kv : s->dense) add(kv.first);
+    for (auto &kv : s->sparse) add(kv.first);
+    return reply_ok(fd, out);
+  }
+
+  if (op == 11 || op == 12) {  // SAVE / LOAD (name = path)
+    if (name.empty()) return reply_err(fd, "empty snapshot path");
+    std::string err;
+    bool ok = (op == 11) ? save_snapshot(s, name, &err)
+                         : load_snapshot(s, name, &err);
+    return ok ? reply_ok(fd) : reply_err(fd, err);
+  }
+
+  if (op == 17) {  // REGISTER_TRAINER
+    if (avail(buf, p) < 12)
+      return reply_err(fd, "REGISTER_TRAINER needs id,timeout");
+    uint64_t id = take<uint64_t>(p);
+    float timeout = take<float>(p);
+    std::lock_guard<std::mutex> g(s->hb_mu);
+    Trainer &t = s->trainers[id];
+    t.status = 1;
+    t.lost = false;
+    t.timeout = timeout > 0 ? timeout : 60.f;
+    t.stamp = std::chrono::steady_clock::now();
+    if (!s->hb_started) {
+      s->hb_started = true;
+      s->hb_thread = std::thread(hb_loop, s);
+    }
+    return reply_ok(fd);
+  }
+
+  if (op == 18) {  // HEARTBEAT
+    if (avail(buf, p) < 9)
+      return reply_err(fd, "HEARTBEAT needs id,status");
+    uint64_t id = take<uint64_t>(p);
+    uint8_t st = take<uint8_t>(p);
+    std::lock_guard<std::mutex> g(s->hb_mu);
+    auto it = s->trainers.find(id);
+    if (it == s->trainers.end())
+      return reply_err(fd, "trainer not registered");
+    it->second.status = st;
+    it->second.lost = false;
+    it->second.stamp = std::chrono::steady_clock::now();
+    return reply_ok(fd);
+  }
+
+  if (op == 19) {  // QUERY_TRAINERS
+    std::lock_guard<std::mutex> g(s->hb_mu);
+    std::vector<char> out;
+    put(out, static_cast<uint32_t>(s->trainers.size()));
+    auto now = std::chrono::steady_clock::now();
+    for (auto &kv : s->trainers) {
+      put(out, kv.first);
+      uint8_t st = kv.second.lost ? 3 : kv.second.status;
+      put(out, st);
+      put(out, std::chrono::duration<float>(
+                   now - kv.second.stamp).count());
+    }
+    return reply_ok(fd, out);
+  }
+
+  return reply_err(fd, "unknown op " + std::to_string(op));
 }
 
 void handle_conn(Server *s, int fd) {
@@ -134,170 +940,16 @@ void handle_conn(Server *s, int fd) {
     if (!read_all(fd, &frame, 4)) break;
     buf.resize(frame);
     if (frame && !read_all(fd, buf.data(), frame)) break;
-    const char *p = buf.data();
-    if (avail(buf, p) < 5) break;
-    uint8_t op = take<uint8_t>(p);
-    uint32_t nlen = take<uint32_t>(p);
-    if (avail(buf, p) < nlen) break;  // malformed frame
-    std::string name(p, p + nlen);
-    p += nlen;
-
-    if (op == 1 || op == 2 || op == 10) {  // INIT/PUSH/ADD dense
-      if (avail(buf, p) < 8) break;
-      uint64_t n = take<uint64_t>(p);
-      if (avail(buf, p) < n * 4) break;  // malformed frame
-      Dense *d = nullptr;
-      {
-        std::lock_guard<std::mutex> g(s->tables_mu);
-        auto it = s->dense.find(name);
-        if (it == s->dense.end()) {
-          if (op != 1) break;  // push/add before init: protocol error
-          d = new Dense();
-          d->value.assign(n, 0.f);
-          s->dense[name] = d;
-        } else {
-          d = it->second;
-        }
-      }
-      std::lock_guard<std::mutex> g(d->mu);
-      const float *vals = reinterpret_cast<const float *>(p);
-      if (op == 1) {
-        d->value.assign(vals, vals + n);
-      } else {
-        if (d->value.size() != n) break;  // size-mismatched payload
-        if (op == 2) {
-          for (uint64_t i = 0; i < n; ++i)
-            d->value[i] -= s->lr * vals[i];
-        } else {  // ADD_DENSE: GeoSGD delta
-          for (uint64_t i = 0; i < n; ++i) d->value[i] += vals[i];
-        }
-      }
-      if (!reply_ok(fd)) break;
-    } else if (op == 3) {  // PULL_DENSE
-      Dense *d = nullptr;
-      {
-        std::lock_guard<std::mutex> g(s->tables_mu);
-        auto it = s->dense.find(name);
-        if (it != s->dense.end()) d = it->second;
-      }
-      if (!d) {
-        uint64_t n = 0;
-        if (!reply(fd, &n, 8)) break;
-        continue;
-      }
-      std::lock_guard<std::mutex> g(d->mu);
-      uint64_t n = d->value.size();
-      std::vector<char> out(8 + n * 4);
-      std::memcpy(out.data(), &n, 8);
-      std::memcpy(out.data() + 8, d->value.data(), n * 4);
-      if (!reply(fd, out.data(), static_cast<uint32_t>(out.size()))) break;
-    } else if (op == 4) {  // INIT_SPARSE
-      if (avail(buf, p) < 21) break;
-      uint64_t rows = take<uint64_t>(p);
-      uint64_t dim = take<uint64_t>(p);
-      uint8_t opt = take<uint8_t>(p);
-      float lr = take<float>(p);
-      std::lock_guard<std::mutex> g(s->tables_mu);
-      if (!s->sparse.count(name)) {
-        Sparse *t = new Sparse();
-        t->rows = rows;
-        t->dim = dim;
-        t->optimizer = opt;
-        t->lr = lr;
-        t->table.assign(rows * dim, 0.f);
-        if (opt == 1) t->acc.assign(rows, 0.f);
-        s->sparse[name] = t;
-      }
-      if (!reply_ok(fd)) break;
-    } else if (op == 5 || op == 6 || op == 7) {  // ROWS ops
-      Sparse *t = nullptr;
-      {
-        std::lock_guard<std::mutex> g(s->tables_mu);
-        auto it = s->sparse.find(name);
-        if (it != s->sparse.end()) t = it->second;
-      }
-      if (!t) break;  // protocol error: table must exist
-      if (avail(buf, p) < 8) break;
-      uint64_t k = take<uint64_t>(p);
-      if (avail(buf, p) < k * 8) break;  // malformed frame
-      const int64_t *ids = reinterpret_cast<const int64_t *>(p);
-      p += k * 8;
-      std::lock_guard<std::mutex> g(t->mu);
-      if (op == 5) {  // PULL_ROWS
-        std::vector<char> out(k * t->dim * 4, 0);
-        float *dst = reinterpret_cast<float *>(out.data());
-        for (uint64_t i = 0; i < k; ++i) {
-          if (ids[i] < 0 ||
-              static_cast<uint64_t>(ids[i]) >= t->rows)
-            continue;  // out-of-range id: row reads as zeros
-          const float *src = &t->table[static_cast<uint64_t>(ids[i]) *
-                                       t->dim];
-          std::memcpy(dst + i * t->dim, src, t->dim * 4);
-        }
-        if (!reply(fd, out.data(), static_cast<uint32_t>(out.size())))
-          break;
-      } else {
-        if (avail(buf, p) < k * t->dim * 4) break;  // malformed
-        const float *vals = reinterpret_cast<const float *>(p);
-        for (uint64_t i = 0; i < k; ++i) {
-          if (ids[i] < 0 ||
-              static_cast<uint64_t>(ids[i]) >= t->rows)
-            continue;  // out-of-range id: drop the update
-          float *row = &t->table[static_cast<uint64_t>(ids[i]) * t->dim];
-          const float *v = vals + i * t->dim;
-          if (op == 7) {  // SET_ROWS
-            std::memcpy(row, v, t->dim * 4);
-          } else if (t->optimizer == 1) {  // adagrad push
-            float sq = 0.f;
-            for (uint64_t j = 0; j < t->dim; ++j) sq += v[j] * v[j];
-            t->acc[static_cast<uint64_t>(ids[i])] += sq / t->dim;
-            float scale =
-                t->lr /
-                (std::sqrt(t->acc[static_cast<uint64_t>(ids[i])]) + 1e-6f);
-            for (uint64_t j = 0; j < t->dim; ++j) row[j] -= scale * v[j];
-          } else {  // sgd push
-            for (uint64_t j = 0; j < t->dim; ++j)
-              row[j] -= t->lr * v[j];
-          }
-        }
-        if (!reply_ok(fd)) break;
-      }
-    } else if (op == 8) {  // BARRIER
-      if (avail(buf, p) < 8) break;
-      uint64_t want = take<uint64_t>(p);
-      std::unique_lock<std::mutex> g(s->bar_mu);
-      uint64_t gen = s->bar_gen;
-      if (++s->bar_count >= want) {
-        s->bar_count = 0;
-        ++s->bar_gen;
-        s->bar_cv.notify_all();
-      } else {
-        s->bar_cv.wait(g, [&] {
-          return s->bar_gen != gen || s->stop.load();
-        });
-      }
-      g.unlock();
-      if (!reply_ok(fd)) break;
-    } else if (op == 9) {  // LIST
-      std::lock_guard<std::mutex> g(s->tables_mu);
-      std::vector<char> out;
-      uint32_t count =
-          static_cast<uint32_t>(s->dense.size() + s->sparse.size());
-      out.insert(out.end(), reinterpret_cast<char *>(&count),
-                 reinterpret_cast<char *>(&count) + 4);
-      auto add = [&out](const std::string &n) {
-        uint32_t l = static_cast<uint32_t>(n.size());
-        out.insert(out.end(), reinterpret_cast<char *>(&l),
-                   reinterpret_cast<char *>(&l) + 4);
-        out.insert(out.end(), n.begin(), n.end());
-      };
-      for (auto &kv : s->dense) add(kv.first);
-      for (auto &kv : s->sparse) add(kv.first);
-      if (!reply(fd, out.data(), static_cast<uint32_t>(out.size())))
-        break;
-    } else {
-      break;
+    bool keep;
+    try {
+      keep = process_frame(s, fd, buf);
+    } catch (const std::bad_alloc &) {
+      // an oversized-but-in-cap allocation (huge INIT_SPARSE, big
+      // pull reply) must cost THIS request an error frame, not the
+      // whole server a std::terminate
+      keep = reply_err(fd, "server out of memory for this request");
     }
+    if (!keep) break;
   }
   {
     std::lock_guard<std::mutex> g(s->conns_mu);
@@ -380,8 +1032,11 @@ void ps_serve_stop(void *handle) {
   if (s->accept_thread.joinable()) s->accept_thread.join();
   for (auto &t : s->workers)
     if (t.joinable()) t.join();
+  if (s->hb_thread.joinable()) s->hb_thread.join();
   for (auto &kv : s->dense) delete kv.second;
   for (auto &kv : s->sparse) delete kv.second;
+  for (Dense *d : s->retired_dense) delete d;
+  for (Sparse *t : s->retired_sparse) delete t;
   delete s;
 }
 

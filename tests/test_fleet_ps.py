@@ -54,15 +54,56 @@ def test_async_ps_fleet_trains():
         losses[:5], losses[-5:])
 
 
-def test_async_ps_rejects_stateful_optimizer():
-    """The embedded server applies the SGD rule (DownpourSGD analog);
-    silently degrading Adam to SGD must be rejected."""
+def test_async_ps_fleet_trains_with_adam():
+    """Server-side adam: the reference pserver runs arbitrary optimize
+    sub-blocks (listen_and_serv_op.cc:110); async PS must not be
+    SGD-only."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(fluid.layers.fc(x, 16, act='relu'), 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    config = DistributeTranspilerConfig()
+    config.sync_mode = False
+    with fluid.program_guard(main, startup):
+        opt = fleet.distributed_optimizer(fluid.optimizer.Adam(5e-3),
+                                          config)
+        opt.minimize(loss)
+    assert not any(op.type == 'adam' for op in main.global_block().ops)
+
+    fleet.run_server()
+    fleet.init_worker()
+    rng = np.random.RandomState(4)
+    w = rng.randn(8, 1).astype('float32')
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for i in range(80):
+            xb = rng.randn(32, 8).astype('float32')
+            l, = exe.run(main, feed={'x': xb, 'y': xb @ w},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    fleet.stop_worker()
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, (
+        losses[:5], losses[-5:])
+
+
+def test_async_ps_rejects_unsupported_optimizer():
+    """Rules the server can't apply (e.g. Ftrl) are rejected loudly —
+    silent degradation to SGD would corrupt training."""
     import pytest
     config = DistributeTranspilerConfig()
     config.sync_mode = False
     fleet.init(role_maker.PaddleCloudRoleMaker())
-    with pytest.raises(ValueError, match='SGD rule'):
-        fleet.distributed_optimizer(fluid.optimizer.Adam(1e-3), config)
+    with pytest.raises(ValueError, match='sgd/momentum/adam'):
+        fleet.distributed_optimizer(
+            fluid.optimizer.Ftrl(1e-3), config)
 
 
 def test_local_fs_ops(tmp_path):

@@ -110,6 +110,30 @@ class TestBatchNorm(OpTest):
         np.testing.assert_allclose(got['Y'], y, atol=1e-4, rtol=1e-4)
         np.testing.assert_allclose(got['MeanOut'], 0.1 * m, atol=1e-5)
 
+    def test_train_forward_large_mean_no_cancellation(self):
+        """f32 one-pass stats about the running-mean shift: variance
+        must survive |mean| >> std (the naive E[x^2]-E[x]^2 form
+        collapses to 0 -> inv=1/sqrt(eps) and blows up Y)."""
+        x = (1e4 + rng.randn(8, 4, 5, 5) * 0.01).astype('float32')
+        # COLD START: running mean still 0 — the shift must come from
+        # the batch itself, not the (useless) running stats
+        ins = {'X': x,
+               'Scale': np.ones(4, 'float32'),
+               'Bias': np.zeros(4, 'float32'),
+               'Mean': np.zeros(4, 'float32'),
+               'Variance': np.ones(4, 'float32')}
+        got = self.run_op('batch_norm', ins,
+                          attrs={'is_test': False, 'epsilon': 1e-5,
+                                 'momentum': 0.9},
+                          out_slots=('Y', 'SavedMean'))
+        y = np.asarray(got['Y'])
+        # normalized output has ~unit std; the cancellation bug gives
+        # std ~ x.std/sqrt(eps) ~ 3
+        assert abs(float(y.std()) - 1.0) < 0.2, y.std()
+        np.testing.assert_allclose(got['SavedMean'],
+                                   x.transpose(1, 0, 2, 3).reshape(
+                                       4, -1).mean(1), rtol=1e-6)
+
     def test_eval_forward(self):
         ins = self._inputs()
         ins['Mean'] = rng.randn(4).astype('float32') * 0.1

@@ -1,0 +1,659 @@
+"""Parameter-server durability + robustness (protocol v2).
+
+Reference behaviors reproduced:
+- checkpoint_notify_op.cc:28 / recv_save_op.cc — trainer-triggered
+  pserver snapshot incl. optimizer state, restore in a FRESH process;
+- rpc_deadline / rpc_retry_times flags
+  (python/paddle/fluid/__init__.py:190-198) — dead/hung server raises
+  within the deadline instead of hanging forever;
+- enforce-with-message discipline on the wire — protocol errors get an
+  error frame, not a silent connection drop;
+- heart_beat_monitor.h:38-104 — the pserver detects and reports lost
+  trainers;
+- listen_and_serv optimize sub-blocks (listen_and_serv_op.cc:110) —
+  server-side momentum/adam, dense and per-row.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import (PsServer, PsClient,
+                                    RpcParameterServerStore,
+                                    PsServerError, RpcDeadlineError,
+                                    TrainerHeartbeat)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# error frames / protocol robustness
+
+def test_error_frames_keep_connection_alive():
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        # push before init: an error MESSAGE, and the connection
+        # survives for the next (valid) request
+        with pytest.raises(PsServerError, match='not initialized'):
+            c.push_dense_grad('ghost', np.ones(3, 'float32'))
+        c.init_dense('w', np.zeros(3, 'float32'))
+        np.testing.assert_allclose(c.pull_dense('w'), np.zeros(3))
+        # size mismatch: diagnosed, connection still alive
+        with pytest.raises(PsServerError, match='elements'):
+            c.push_dense_grad('w', np.ones(5, 'float32'))
+        # unknown pull -> KeyError (not a silent empty array)
+        with pytest.raises(KeyError):
+            c.pull_dense('never_created')
+        # unknown sparse table
+        with pytest.raises(PsServerError, match='unknown sparse'):
+            c.pull_rows('ghost_table', np.array([0], 'int64'), 4)
+        # unknown op code
+        with pytest.raises(PsServerError, match='unknown op'):
+            c._call(77, 'x')
+        assert 'w' in c.list_vars()  # connection still works
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_overflow_sized_count_is_rejected():
+    """A huge element count whose byte-size wraps u64 must be rejected
+    by the division-based bounds check, not read out of bounds."""
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        c.init_dense('w', np.zeros(4, 'float32'))
+        # n chosen so n * 4 wraps to a tiny number in u64
+        wrap_n = (1 << 62) + 1
+        with pytest.raises(PsServerError, match='shorter than count'):
+            c._call(2, 'w', struct.pack('<Q', wrap_n) + b'\0' * 4)
+        # sparse ids leg too
+        c.init_sparse('t', rows=10, dim=2, optimizer='sgd', lr=1.0)
+        wrap_k = (1 << 61) + 1  # k * 8 wraps
+        with pytest.raises(PsServerError, match='shorter than count'):
+            c._call(5, 't', struct.pack('<Q', wrap_k) + b'\0' * 8)
+        assert 'w' in c.list_vars()  # server alive and sane
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_deadline_on_hung_server():
+    """A server that accepts but never replies: the call returns an
+    error within (retries+1) * deadline instead of hanging forever."""
+    silent = socket.socket()
+    silent.bind(('127.0.0.1', 0))
+    silent.listen(1)
+    port = silent.getsockname()[1]
+    try:
+        c = PsClient('127.0.0.1:%d' % port, deadline_ms=300,
+                     retry_times=1)
+        t0 = time.monotonic()
+        with pytest.raises(RpcDeadlineError, match='after 2 attempts'):
+            c.pull_dense('w')
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        silent.close()
+
+
+def test_deadline_on_dead_server():
+    """Connection-refused endpoint: bounded retries then a clear
+    error."""
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    c = PsClient('127.0.0.1:%d' % port, deadline_ms=200, retry_times=2)
+    t0 = time.monotonic()
+    with pytest.raises(RpcDeadlineError):
+        c.list_vars()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_named_barriers_are_independent():
+    """Two barrier groups must not share a counter: an arrival in group
+    'b' cannot release a waiter in group 'a' (the v1 global-counter
+    bug)."""
+    import threading
+    srv = PsServer()
+    try:
+        released = []
+
+        def waiter():
+            cw = PsClient(srv.endpoint)
+            cw.barrier(2, group='a')
+            released.append(time.monotonic())
+            cw.close()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        c = PsClient(srv.endpoint)
+        c.barrier(1, group='b')   # would wrongly release 'a' pre-fix
+        time.sleep(0.3)
+        assert not released       # 'a' still parked
+        c.barrier(2, group='a')   # second arrival releases both
+        t.join(timeout=10)
+        assert released
+        with pytest.raises(PsServerError, match='>= 1'):
+            c.barrier(0)
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# server-side optimizer rules
+
+def _np_adam_steps(w, grads, lr, b1=0.9, b2=0.999, eps=1e-8):
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    return w
+
+
+def test_dense_momentum_and_adam_rules():
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        rng = np.random.RandomState(0)
+        w0 = rng.randn(6).astype('float32')
+        grads = [rng.randn(6).astype('float32') for _ in range(5)]
+
+        c.init_dense('wm', w0)
+        c.conf_dense('wm', optimizer='momentum', lr=0.1, momentum=0.9)
+        for g in grads:
+            c.push_dense_grad('wm', g)
+        w, vel = w0.copy(), np.zeros_like(w0)
+        for g in grads:
+            vel = 0.9 * vel + g
+            w = w - 0.1 * vel
+        np.testing.assert_allclose(c.pull_dense('wm'), w, rtol=1e-5)
+
+        c.init_dense('wa', w0)
+        c.conf_dense('wa', optimizer='adam', lr=0.05)
+        for g in grads:
+            c.push_dense_grad('wa', g)
+        np.testing.assert_allclose(
+            c.pull_dense('wa'),
+            _np_adam_steps(w0.astype(np.float64), grads, 0.05),
+            rtol=1e-4)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_sparse_adam_rows():
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        c.init_sparse('e', rows=50, dim=3, optimizer='adam', lr=0.05)
+        ids = np.array([7, 20], 'int64')
+        w0 = np.arange(6, dtype='float32').reshape(2, 3)
+        c.set_rows('e', ids, w0)
+        grads = [np.full((2, 3), 0.5, 'float32') * (i + 1)
+                 for i in range(4)]
+        for g in grads:
+            c.push_rows('e', ids, g)
+        np.testing.assert_allclose(
+            c.pull_rows('e', ids, 3),
+            _np_adam_steps(w0.astype(np.float64), grads, 0.05),
+            rtol=1e-4)
+        # untouched rows have untouched (t=0) state
+        np.testing.assert_allclose(
+            c.pull_rows('e', np.array([0], 'int64'), 3),
+            np.zeros((1, 3)))
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_embedded_store_rules_match_rpc_server():
+    """ParameterServerStore (embedded) and the native server apply
+    identical rules — fleet code may swap one for the other."""
+    from paddle_tpu.distributed import ParameterServerStore
+    srv = PsServer()
+    try:
+        remote = RpcParameterServerStore(srv.endpoint, optimizer='adam',
+                                         lr=0.02)
+        local = ParameterServerStore()
+        rng = np.random.RandomState(1)
+        w0 = rng.randn(4, 2).astype('float32')
+        remote.init_var('p', w0)
+        local.init_var('p', w0)
+        local.conf_var('p', optimizer='adam', lr=0.02)
+        for _ in range(6):
+            g = rng.randn(4, 2).astype('float32')
+            remote.apply_grad('p', g)
+            local.apply_grad('p', g)
+        np.testing.assert_allclose(remote.get('p'), local.get('p'),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / restore
+
+def test_save_load_roundtrip_fresh_process(tmp_path):
+    """Snapshot carries values AND optimizer state: a restored server
+    continues the update sequence bit-for-bit like an uninterrupted
+    one."""
+    path = str(tmp_path / 'snap.ptps')
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(8).astype('float32')
+    grads = [rng.randn(8).astype('float32') for _ in range(6)]
+
+    srv = PsServer()
+    c = PsClient(srv.endpoint)
+    c.init_dense('w', w0)
+    c.conf_dense('w', optimizer='adam', lr=0.1)
+    c.init_sparse('e', rows=20, dim=2, optimizer='adagrad', lr=0.5)
+    ids = np.array([3, 11], 'int64')
+    c.set_rows('e', ids, np.ones((2, 2), 'float32'))
+    for g in grads[:3]:
+        c.push_dense_grad('w', g)
+        c.push_rows('e', ids, np.ones((2, 2), 'float32'))
+    c.save(path)
+
+    # uninterrupted continuation
+    for g in grads[3:]:
+        c.push_dense_grad('w', g)
+        c.push_rows('e', ids, np.ones((2, 2), 'float32'))
+    w_ref = c.pull_dense('w')
+    e_ref = c.pull_rows('e', ids, 2)
+    c.close()
+    srv.stop()  # "crash"
+
+    srv2 = PsServer()  # fresh process state
+    try:
+        c2 = PsClient(srv2.endpoint)
+        c2.load(path)
+        assert sorted(c2.list_vars()) == ['e', 'w']
+        for g in grads[3:]:
+            c2.push_dense_grad('w', g)
+            c2.push_rows('e', ids, np.ones((2, 2), 'float32'))
+        np.testing.assert_allclose(c2.pull_dense('w'), w_ref,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(c2.pull_rows('e', ids, 2), e_ref,
+                                   rtol=1e-6)
+        c2.close()
+    finally:
+        srv2.stop()
+
+
+def test_load_while_pushing_is_safe(tmp_path):
+    """LOAD must not free table objects other threads still hold: a
+    concurrent pusher sees either old or new state, and the server
+    survives (the use-after-free regression)."""
+    import threading
+    path = str(tmp_path / 'live.ptps')
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        c.init_dense('w', np.zeros(16, 'float32'))
+        c.init_sparse('e', rows=100, dim=4, optimizer='adagrad', lr=0.1)
+        c.save(path)
+        stop = threading.Event()
+        errs = []
+
+        def pusher():
+            cp = PsClient(srv.endpoint)
+            ids = np.arange(8, dtype='int64')
+            g = np.ones((8, 4), 'float32')
+            try:
+                while not stop.is_set():
+                    cp.push_dense_grad('w', np.ones(16, 'float32'))
+                    cp.push_rows('e', ids, g)
+            except (PsServerError, ConnectionError):
+                pass  # transient shape/kind mismatch mid-swap is fine
+            except Exception as exc:
+                errs.append(exc)
+            finally:
+                cp.close()
+
+        threads = [threading.Thread(target=pusher) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(30):
+            c.load(path)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs
+        # server still sane after 30 live reloads under push load
+        assert sorted(c.list_vars()) == ['e', 'w']
+        assert c.pull_dense('w').shape == (16,)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_conf_dense_rule_change_resets_state():
+    """momentum -> adam reconfigure must not leave a sized m with an
+    empty v (out-of-bounds write regression)."""
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        c.init_dense('w', np.zeros(8, 'float32'))
+        c.conf_dense('w', optimizer='momentum', lr=0.1, momentum=0.9)
+        c.push_dense_grad('w', np.ones(8, 'float32'))
+        c.conf_dense('w', optimizer='adam', lr=0.1)
+        c.push_dense_grad('w', np.ones(8, 'float32'))  # crashed pre-fix
+        got = c.pull_dense('w')
+        assert np.isfinite(got).all()
+        # fresh adam state: first step moves by ~lr exactly
+        np.testing.assert_allclose(got, -0.1 - 0.1 / (1 + 1e-8),
+                                    rtol=1e-4)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_state_dict_with_zero_row_shard():
+    """vocab < n_servers: the empty shard must not break state_dict."""
+    from paddle_tpu.parallel.sparse_embedding import (
+        RpcShardedEmbedding, HostShardedEmbedding)
+    name = 'tiny_emb'
+    servers = [PsServer() for _ in range(4)]
+    try:
+        emb = RpcShardedEmbedding(name, 3, 4,
+                                  [s.endpoint for s in servers],
+                                  optimizer='adagrad',
+                                  learning_rate=0.1, seed=1)
+        emb._push(np.array([0, 2], 'int64'), np.ones((2, 4), 'float32'))
+        sd = emb.state_dict()
+        assert sd[name + '.table'].shape == (3, 4)
+        assert sd[name + '.acc'].shape == (3,)
+    finally:
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        for s in servers:
+            s.stop()
+
+
+def test_save_error_paths(tmp_path):
+    srv = PsServer()
+    try:
+        c = PsClient(srv.endpoint)
+        with pytest.raises(PsServerError, match='cannot open'):
+            c.save('/nonexistent_dir_xyz/snap.ptps')
+        with pytest.raises(PsServerError, match='cannot open'):
+            c.load(str(tmp_path / 'missing.ptps'))
+        bad = tmp_path / 'garbage.ptps'
+        bad.write_bytes(b'not a snapshot')
+        with pytest.raises(PsServerError, match='bad snapshot'):
+            c.load(str(bad))
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_rpc_embedding_kill_restart_loss_parity(tmp_path):
+    """THE durability criterion: train over RPC shards, checkpoint,
+    KILL the server processes, restart fresh ones, restore, continue —
+    loss trajectory matches an uninterrupted run exactly."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.sparse_embedding import (
+        RpcShardedEmbedding, HostShardedEmbedding)
+
+    name = 'dur_emb'
+    rng = np.random.RandomState(0)
+    feeds = [(rng.randint(0, 200, (16, 5)).astype('int64'),
+              rng.rand(16, 1).astype('float32')) for _ in range(30)]
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data('ids', shape=[5], dtype='int64')
+            label = fluid.layers.data('label', shape=[1],
+                                      dtype='float32')
+            rows = HostShardedEmbedding._REGISTRY[name].lookup(ids)
+            feat = fluid.layers.reshape(rows, [0, 5 * 8])
+            pred = fluid.layers.fc(
+                feat, 1, param_attr=fluid.ParamAttr(name='dur_fc_w'),
+                bias_attr=fluid.ParamAttr(name='dur_fc_b'))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+            HostShardedEmbedding._REGISTRY[name].apply_gradients(main)
+        return main, startup, loss
+
+    def run_steps(main, startup_or_none, loss, scope, feed_list,
+                  dense_init=None):
+        out = []
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            if startup_or_none is not None:
+                exe.run(startup_or_none)
+            if dense_init:
+                for k, v in dense_init.items():
+                    scope.set_var(k, v)
+            for ids_np, y_np in feed_list:
+                l, = exe.run(main, feed={'ids': ids_np, 'label': y_np},
+                             fetch_list=[loss])
+                out.append(float(np.asarray(l).ravel()[0]))
+        return out
+
+    srv1, srv2 = PsServer(), PsServer()
+    try:
+        emb = RpcShardedEmbedding(name, 200, 8,
+                                  [srv1.endpoint, srv2.endpoint],
+                                  optimizer='adagrad',
+                                  learning_rate=0.1, seed=5)
+        main, startup, loss = build()
+        scope = fluid.Scope()
+        run_steps(main, startup, loss, scope, feeds[:10])
+        # checkpoint: server-side snapshot + trainer-side dense params
+        paths = emb.checkpoint(str(tmp_path))
+        assert all(os.path.exists(p) for p in paths)
+        dense_snap = {
+            n: np.array(fluid.core.as_array(scope.find_var(n)),
+                        copy=True)
+            for n in ('dur_fc_w', 'dur_fc_b')}
+        # uninterrupted continuation = the reference trajectory
+        ref = run_steps(main, None, loss, scope, feeds[10:])
+
+        # ---- crash: kill both pservers ----
+        srv1.stop()
+        srv2.stop()
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+
+        srv1b, srv2b = PsServer(), PsServer()
+        try:
+            emb2 = RpcShardedEmbedding(
+                name, 200, 8, [srv1b.endpoint, srv2b.endpoint],
+                optimizer='adagrad', learning_rate=0.1,
+                initializer_scale=0)
+            emb2.restore(str(tmp_path))
+            scope2 = fluid.Scope()
+            # fresh process: run startup for aux vars (lr), then load
+            # the checkpointed dense params over the random init
+            got = run_steps(main, startup, loss, scope2, feeds[10:],
+                            dense_init=dense_snap)
+            np.testing.assert_allclose(got, ref, rtol=1e-5)
+        finally:
+            srv1b.stop()
+            srv2b.stop()
+    finally:
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        srv1.stop()
+        srv2.stop()
+
+
+def test_rpc_embedding_state_dict_roundtrip():
+    """Pull-all fallback: state_dict reassembles the full table on the
+    trainer; load_state_dict pushes it into a different server set."""
+    from paddle_tpu.parallel.sparse_embedding import (
+        RpcShardedEmbedding, HostShardedEmbedding)
+    name = 'sd_emb'
+    srv1, srv2 = PsServer(), PsServer()
+    srv3 = PsServer()
+    try:
+        emb = RpcShardedEmbedding(name, 101, 4,
+                                  [srv1.endpoint, srv2.endpoint],
+                                  optimizer='adagrad',
+                                  learning_rate=0.1, seed=9)
+        ids = np.array([0, 1, 50, 100], 'int64')
+        emb._push(ids, np.ones((4, 4), 'float32'))
+        sd = emb.state_dict()
+        assert sd[name + '.table'].shape == (101, 4)
+        assert sd[name + '.acc'].shape == (101,)
+        want = emb._pull(ids)
+
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        # single-shard target: different sharding layout, same content
+        emb2 = RpcShardedEmbedding(name, 101, 4, [srv3.endpoint],
+                                   optimizer='adagrad',
+                                   learning_rate=0.1,
+                                   initializer_scale=0)
+        emb2.load_state_dict(sd)
+        np.testing.assert_allclose(emb2._pull(ids), want, rtol=1e-6)
+        # optimizer state travelled too: one more identical push on
+        # both sides stays identical
+        emb2._push(ids, np.ones((4, 4), 'float32'))
+        emb._push(ids, np.ones((4, 4), 'float32'))
+        np.testing.assert_allclose(emb2._pull(ids), emb._pull(ids),
+                                   rtol=1e-6)
+    finally:
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        for s in (srv1, srv2, srv3):
+            s.stop()
+
+
+def test_attach_mismatch_raises():
+    from paddle_tpu.parallel.sparse_embedding import (
+        RpcShardedEmbedding, HostShardedEmbedding)
+    name = 'mm_emb'
+    srv = PsServer()
+    try:
+        RpcShardedEmbedding(name, 100, 8, [srv.endpoint],
+                            optimizer='adagrad', learning_rate=0.1)
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        with pytest.raises(ValueError, match='incompatible'):
+            RpcShardedEmbedding(name, 100, 16, [srv.endpoint],
+                                optimizer='adagrad', learning_rate=0.1)
+        with pytest.raises(ValueError, match='incompatible'):
+            RpcShardedEmbedding(name, 100, 8, [srv.endpoint],
+                                optimizer='sgd', learning_rate=0.1)
+    finally:
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        srv.stop()
+
+
+def test_save_persistables_includes_ps_tables(tmp_path):
+    """fluid.io.save_persistables on a program with a PS-resident
+    table saves (and load restores) the table state too — the
+    distributed-aware save of reference io.py:393."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.sparse_embedding import (
+        RpcShardedEmbedding, HostShardedEmbedding)
+    name = 'iosave_emb'
+    srv = PsServer()
+    try:
+        emb = RpcShardedEmbedding(name, 60, 4, [srv.endpoint],
+                                  optimizer='adagrad',
+                                  learning_rate=0.1, seed=2)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data('ids', shape=[3], dtype='int64')
+            rows = emb.lookup(ids)
+            out = fluid.layers.reduce_sum(rows)
+        probe = np.array([1, 5, 59], 'int64')
+        before = emb._pull(probe)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            fluid.io.save_persistables(exe, str(tmp_path), main)
+            assert os.path.exists(
+                os.path.join(str(tmp_path), '__dist_tables__.npz'))
+            # clobber the server rows, then restore
+            emb._push(probe, np.full((3, 3, 4), 9.0, 'float32')[0])
+            fluid.io.load_persistables(exe, str(tmp_path), main)
+        np.testing.assert_allclose(emb._pull(probe), before, rtol=1e-6)
+    finally:
+        HostShardedEmbedding._REGISTRY.pop(name, None)
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat wired to the server
+
+def test_server_detects_lost_trainer():
+    """heart_beat_monitor.h end-to-end: a trainer that stops pinging
+    is marked LOST by the SERVER's monitor; a completing trainer is
+    COMPLETED."""
+    srv = PsServer()
+    try:
+        admin = PsClient(srv.endpoint)
+        hb0 = TrainerHeartbeat(srv.endpoint, trainer_id=0, timeout=0.6)
+        hb1 = TrainerHeartbeat(srv.endpoint, trainer_id=1, timeout=0.6)
+        time.sleep(0.3)
+        st = admin.query_trainers()
+        assert st[0]['status'] == 'RUNNING'
+        assert st[1]['status'] == 'RUNNING'
+        hb1.complete()          # clean shutdown
+        hb0.stop()              # silent death: stops pinging
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = admin.query_trainers()
+            if st[0]['status'] == 'LOST':
+                break
+            time.sleep(0.2)
+        assert st[0]['status'] == 'LOST', st
+        assert st[1]['status'] == 'COMPLETED', st
+        admin.close()
+    finally:
+        srv.stop()
+
+
+def test_killed_trainer_subprocess_detected():
+    """Real process death: a trainer SUBPROCESS registers, heartbeats,
+    then is SIGKILLed; the server reports it lost."""
+    trainer_code = '''
+import sys, time
+sys.path.insert(0, %r)
+from paddle_tpu.distributed import TrainerHeartbeat
+hb = TrainerHeartbeat('127.0.0.1:' + sys.argv[1], trainer_id=7,
+                      timeout=0.8)
+print('UP', flush=True)
+time.sleep(60)
+'''
+    srv = PsServer()
+    try:
+        env = dict(os.environ, PYTHONPATH=REPO)
+        proc = subprocess.Popen(
+            [sys.executable, '-c', trainer_code % REPO,
+             str(srv.port)], stdout=subprocess.PIPE, text=True,
+            env=env)
+        try:
+            assert proc.stdout.readline().strip() == 'UP'
+            admin = PsClient(srv.endpoint)
+            assert admin.query_trainers()[7]['status'] == 'RUNNING'
+            proc.kill()
+            proc.wait()
+            deadline = time.monotonic() + 15
+            status = None
+            while time.monotonic() < deadline:
+                status = admin.query_trainers()[7]['status']
+                if status == 'LOST':
+                    break
+                time.sleep(0.2)
+            assert status == 'LOST'
+            admin.close()
+        finally:
+            proc.kill()
+    finally:
+        srv.stop()

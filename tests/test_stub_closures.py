@@ -159,3 +159,72 @@ def test_executor_compile_validates_names():
     with pytest.raises(ValueError, match='not read'):
         exe.compile(main, feed_names=('tpyo',),
                     fetch_names=(h.name,))
+
+
+def test_diag_layer():
+    """Round-3 stub closure: layers.diag (reference diag_op.cc)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = layers.data('d', shape=[4], dtype='float32',
+                        append_batch_size=False)
+        m = layers.diag(d)
+    dv = np.array([1., 2., 3., 4.], 'float32')
+    out, = _run(main, startup, {'d': dv}, [m])
+    np.testing.assert_allclose(np.asarray(out), np.diag(dv))
+
+
+def test_where_index_capacity_padded():
+    """Round-3: where_index with a capacity attr returns [K, rank]
+    indices padded with -1 (the TPU static-shape variant)."""
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[3, 4], dtype='float32',
+                        append_batch_size=False)
+        cond = layers.cast(x, 'bool')
+        block = main.current_block()
+        out = block.create_var(name='wi_out', shape=(6, 2),
+                               dtype='int64')
+        block.append_op('where_index', inputs={'Condition': cond},
+                        outputs={'Out': out},
+                        attrs={'capacity': 6})
+    xv = np.zeros((3, 4), 'float32')
+    xv[0, 1] = xv[2, 3] = 1.0
+    got, = _run(main, startup, {'x': xv}, [out])
+    got = np.asarray(got)
+    assert got.shape == (6, 2)
+    real = got[got[:, 0] >= 0]
+    np.testing.assert_array_equal(real, [[0, 1], [2, 3]])
+    assert (got[2:] == -1).all()
+
+    # without capacity: loud guidance (at shape-inference time), not a
+    # wrong shape
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = layers.data('x', shape=[3], dtype='float32',
+                         append_batch_size=False)
+        c2 = layers.cast(x2, 'bool')
+        b2 = main2.current_block()
+        o2 = b2.create_var(name='wi2', shape=(3, 1), dtype='int64')
+        with pytest.raises(Exception, match='capacity'):
+            b2.append_op('where_index', inputs={'Condition': c2},
+                         outputs={'Out': o2}, attrs={})
+
+
+def test_dice_loss_formula():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.data('p', shape=[4, 3], dtype='float32',
+                        append_batch_size=False)
+        lbl = layers.data('l', shape=[4, 1], dtype='int64',
+                          append_batch_size=False)
+        loss = layers.dice_loss(p, lbl)
+    rng = np.random.RandomState(1)
+    pv = rng.rand(4, 3).astype('float32')
+    lv = rng.randint(0, 3, (4, 1)).astype('int64')
+    got, = _run(main, startup, {'p': pv, 'l': lv}, [loss])
+    onehot = np.eye(3, dtype='float32')[lv[:, 0]]
+    inter = (pv * onehot).sum(1)
+    union = pv.sum(1) + onehot.sum(1)
+    want = (1 - 2 * inter / (union + 1e-5)).mean()
+    np.testing.assert_allclose(float(np.asarray(got)), want, rtol=1e-5)

@@ -97,6 +97,39 @@ def test_ps_async_transpile_trains():
     HostShardedEmbedding._REGISTRY.pop('emb_w', None)
 
 
+def test_ps_async_transpile_adam_rules():
+    """Transpiling an Adam-minimized program moves the adam rule to
+    the server (reference: per-param optimize sub-blocks with adam,
+    distribute_transpiler.py:1110) — no SGD-only restriction."""
+    feeds = _feeds(steps=20)
+    HostShardedEmbedding._REGISTRY.pop('emb_w', None)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 31
+    with fluid.program_guard(main, startup):
+        ids = layers.data('ids', shape=[4], dtype='int64')
+        label = layers.data('label', shape=[1], dtype='float32')
+        emb = layers.embedding(ids, size=[500, 8], is_sparse=True,
+                               param_attr=fluid.ParamAttr(name='emb_w'))
+        feat = layers.reshape(emb, [0, 4 * 8])
+        pred = layers.fc(feat, 1)
+        loss = layers.mean(layers.square_error_cost(pred, label))
+        fluid.optimizer.Adam(0.01, beta1=0.8).minimize(loss)
+    t = fluid.DistributeTranspiler(config=_ps_config())
+    t.transpile(0, program=main, pservers='127.0.0.1:6174',
+                trainers=1, sync_mode=False, startup_program=startup)
+    trainer = t.get_trainer_program()
+    types = [op.type for op in trainer.global_block().ops]
+    assert 'adam' not in types  # moved to the server
+    rules = trainer._ps_async['rules']
+    assert all(r['optimizer'] == 'adam' and r['beta1'] == 0.8
+               for r in rules.values()), rules
+    losses = _run_program(trainer, startup, loss, feeds)
+    from paddle_tpu.fluid.incubate.fleet.parameter_server import fleet
+    fleet.stop_worker()
+    assert losses[-1] < losses[0], losses
+    HostShardedEmbedding._REGISTRY.pop('emb_w', None)
+
+
 def test_ps_server_programs_are_noop():
     HostShardedEmbedding._REGISTRY.pop('emb_w', None)
     main, startup, loss = _build(is_sparse=True, seed=29)

@@ -1,0 +1,116 @@
+"""Flash-vs-naive attention crossover bench (BERT-base shapes).
+
+Measures fwd+bwd wall time of the Pallas flash kernels against the
+naive XLA chain at several sequence lengths on the attached TPU.
+Round-3 goal (VERDICT item 4): flash >= naive at seq 512 for d=64, or
+roofline evidence it can't be on this chip.
+
+Usage: python tools/bench_flash.py [--steps 30] [--block-sweep]
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def naive_attention(q, k, v, causal=False):
+    b, t, h, d = q.shape
+    s = jnp.einsum('bthd,bshd->bhts', q, k,
+                   preferred_element_type=jnp.float32) / (d ** 0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum('bhts,bshd->bthd', p, v)
+
+
+def timed(fn, args, steps):
+    """Chained steps (each consumes the previous grads) + one host
+    readback: block_until_ready alone does not synchronize through the
+    tunnel transport, so serialize on-device and sync via np.asarray
+    (bench.py's convention)."""
+    q, k, v = args
+
+    def step(q, k, v):
+        dq, dk, dv = fn(q, k, v)
+        eps = jnp.bfloat16(1e-3)
+        return (q + eps * dq.astype(q.dtype),
+                k + eps * dk.astype(k.dtype),
+                v + eps * dv.astype(v.dtype))
+
+    step = jax.jit(step)
+    q, k, v = step(q, k, v)
+    np.asarray(q[0, 0, 0, 0].astype(jnp.float32))  # warm + sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        q, k, v = step(q, k, v)
+    np.asarray(q[0, 0, 0, 0].astype(jnp.float32))
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def loss_of(att):
+    def f(q, k, v):
+        return jnp.sum(att(q, k, v).astype(jnp.float32) ** 2)
+    return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=30)
+    ap.add_argument('--batch', type=int, default=32)
+    ap.add_argument('--heads', type=int, default=12)
+    ap.add_argument('--dim', type=int, default=64)
+    ap.add_argument('--seqs', type=int, nargs='+',
+                    default=[128, 512, 2048])
+    ap.add_argument('--causal', action='store_true')
+    ap.add_argument('--block-sweep', action='store_true')
+    args = ap.parse_args()
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    for t in args.seqs:
+        shape = (args.batch, t, args.heads, args.dim)
+        q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+
+        g_naive = loss_of(functools.partial(naive_attention,
+                                            causal=args.causal))
+        ms_naive = timed(g_naive, (q, k, v), args.steps)
+
+        g_flash = loss_of(functools.partial(fa.flash_attention,
+                                            causal=args.causal))
+        ms_flash = timed(g_flash, (q, k, v), args.steps)
+        print('seq %5d  naive %7.2f ms   flash %7.2f ms   (%s)'
+              % (t, ms_naive, ms_flash,
+                 'flash wins' if ms_flash < ms_naive else 'NAIVE wins'),
+              flush=True)
+
+        if args.block_sweep:
+            for bq in (128, 256, 512):
+                for bk in (128, 256, 512):
+                    if bq > t or bk > t:
+                        continue
+                    fa.DEFAULT_BLOCK_Q = bq
+                    fa.DEFAULT_BLOCK_K = bk
+                    gf = loss_of(functools.partial(
+                        fa.flash_attention, causal=args.causal))
+                    ms = timed(gf, (q, k, v), args.steps)
+                    print('    bq=%3d bk=%3d  %7.2f ms' % (bq, bk, ms),
+                          flush=True)
+            fa.DEFAULT_BLOCK_Q = 256
+            fa.DEFAULT_BLOCK_K = 256
+
+
+if __name__ == '__main__':
+    main()
