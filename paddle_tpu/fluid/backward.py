@@ -348,11 +348,19 @@ def _control_flow_backward(block, op, contribs, resolve_grad, no_grad_set):
     """
     is_while = op.type == 'while'
     if is_while and int(op.attrs.get('max_trip_count') or 0) <= 0:
-        raise NotImplementedError(
-            'gradients through a while op need a bounded trip count so '
-            'the backward pass can re-run it as a reverse-differentiable '
-            'lax.scan: build the loop with While(cond, max_trip_count=N) '
-            'or layers.while_loop(..., max_trip_count=N)')
+        # unbounded trip count: AUTO-BUCKET.  The executor cuts the
+        # program before this op, runs a cheap counting pass
+        # (non-differentiable lax.while_loop) on the concrete carries,
+        # rounds the count to the next power of two, and compiles the
+        # masked-scan rendering at that bucket — one executable per
+        # bucket, O(log trips) recompiles, the bucketing-loader recipe
+        # applied to control flow.  The reference's WhileGradOp gets
+        # dynamic trips by replaying saved step scopes
+        # (operators/controlflow/while_op.cc); a shape-static compiler
+        # buys the same with buckets.
+        op.attrs['__auto_bucket__'] = True
+        op.attrs['__bucket_group__'] = framework.unique_name.generate(
+            'while_bucket')
     carry_names = list(op.output('Out'))
     cond_slot = 'Condition' if is_while else 'Cond'
     cond_name = op.input(cond_slot)[0]
@@ -434,7 +442,12 @@ def _control_flow_backward(block, op, contribs, resolve_grad, no_grad_set):
              '__closure_names__': list(closure),
              '__op_role__': 'backward'}
     if is_while:
-        attrs['max_trip_count'] = int(op.attrs['max_trip_count'])
+        if op.attrs.get('__auto_bucket__'):
+            # the executor's counting pass sets max_trip_count on every
+            # op of the group (forward while + this grad) per step
+            attrs['__bucket_group__'] = op.attrs['__bucket_group__']
+        else:
+            attrs['max_trip_count'] = int(op.attrs['max_trip_count'])
     block.append_op(op.type + '_grad', inputs=grad_inputs,
                     outputs={'GRAD::Entry': entry_grad_row,
                              'GRAD::X': closure_grad_row},

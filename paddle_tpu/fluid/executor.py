@@ -27,13 +27,18 @@ from ..ops import registry
 
 class _Segment(object):
     __slots__ = ('ops', 'input_names', 'state_names', 'output_names',
-                 'compiled')
+                 'compiled', 'bucket_ops')
 
     def __init__(self, ops):
         self.ops = ops
         self.input_names = []
         self.state_names = []
         self.output_names = []
+        # ops whose max_trip_count is stamped per step by the
+        # auto-bucket counting pass (static membership, computed once)
+        self.bucket_ops = [op for op in ops
+                           if op.attrs.get('__bucket_group__')
+                           is not None]
         self.compiled = None
 
 
@@ -488,6 +493,15 @@ class Executor(object):
                         'conditional_block_grad')
         for op in block.ops:
             if op.type in CONTROL_FLOW:
+                if op.type == 'while' and \
+                        op.attrs.get('__auto_bucket__'):
+                    # unbounded differentiable while: cut here so the
+                    # carries are concrete in the scope, count trips on
+                    # the host, then compile downstream at the bucket
+                    if cur:
+                        items.append(_Segment(cur))
+                        cur = []
+                    items.append(('bucket', op))
                 cur.append(op)
                 continue
             if op.type in registry.HOST_OPS or not registry.is_registered(
@@ -557,6 +571,8 @@ class Executor(object):
         for item in plan:
             if isinstance(item, _Segment):
                 self._run_segment(item, feed, scope, device, fetched)
+            elif item[0] == 'bucket':
+                self._run_bucket_count(item[1], feed, scope, device)
             else:
                 op = item[1]
                 registry.get(op.type).fn(self, scope, op)
@@ -590,10 +606,81 @@ class Executor(object):
                 'startup program first' % name)
         return core.as_array(val)
 
+    def _run_bucket_count(self, op, feed, scope, device):
+        """Host leg of the unbounded-while gradient: run the loop ONCE
+        as a cheap non-differentiable lax.while_loop over the concrete
+        carries, count the trips, round up to the next power of two,
+        and stamp `max_trip_count` on every op of the bucket group
+        (forward while + its grad).  Downstream segments compile once
+        per distinct bucket (_run_segment keys its executable on the
+        group's buckets) — O(log trips) compiles total, the bucketed-
+        loader recipe applied to control flow."""
+        import jax.numpy as jnp
+        program = op.block.program
+        sub = program.blocks[op.attrs['sub_block']]
+        cond_name = op.input('Condition')[0]
+        carry_names = list(op.attrs['__carry_names__'])
+        if cond_name not in carry_names:
+            carry_names.append(cond_name)
+        env = {}
+        for n in _op_reads(op):
+            env[n] = self._lookup_input(n, feed, scope)
+
+        count_jit = op.attrs.get('__count_fn__')
+        if count_jit is None:
+            def count(env_in):
+                def cond_fn(st):
+                    carry, _ = st
+                    return jnp.asarray(carry[cond_name]).reshape(
+                        ()).astype(bool)
+
+                def body_fn(st):
+                    carry, i = st
+                    local = dict(env_in)
+                    local.update(carry)
+                    _lower_ops(sub.ops, local, 0, False)
+                    new = {n: jnp.asarray(local[n]).astype(
+                        jnp.asarray(carry[n]).dtype)
+                        for n in carry_names}
+                    return new, i + 1
+
+                init = ({n: jnp.asarray(env_in[n])
+                         for n in carry_names}, jnp.int32(0))
+                _, trips = jax.lax.while_loop(cond_fn, body_fn, init)
+                return trips
+
+            count_jit = jax.jit(count)
+            op.attrs['__count_fn__'] = count_jit
+        with jax.default_device(device):
+            trips = int(count_jit(env))
+        bucket = 1
+        while bucket < max(trips, 1):
+            bucket *= 2
+        gid = op.attrs['__bucket_group__']
+        for o in op.block.ops:
+            if o.attrs.get('__bucket_group__') == gid:
+                o.attrs['max_trip_count'] = bucket
+
     def _run_segment(self, seg, feed, scope, device, fetched):
-        if seg.compiled is None:
-            fn = _make_segment_fn(seg)
-            seg.compiled = jax.jit(fn, donate_argnums=(1,))
+        # segments holding auto-bucketed while ops compile one
+        # executable PER BUCKET (the masked-scan length is baked into
+        # the trace); others keep the single cached executable
+        if seg.bucket_ops:
+            bucket_key = tuple(op.attrs.get('max_trip_count')
+                               for op in seg.bucket_ops)
+            cache = seg.compiled if isinstance(seg.compiled, dict) \
+                else {}
+            seg.compiled = cache
+            if bucket_key not in cache:
+                cache[bucket_key] = jax.jit(_make_segment_fn(seg),
+                                            donate_argnums=(1,))
+            compiled = cache[bucket_key]
+        elif seg.compiled is None:
+            seg.compiled = jax.jit(_make_segment_fn(seg),
+                                   donate_argnums=(1,))
+            compiled = seg.compiled
+        else:
+            compiled = seg.compiled
         state = {}
         for n in seg.state_names:
             v = self._lookup_input(n, feed, scope)
@@ -606,7 +693,7 @@ class Executor(object):
         data = {n: self._lookup_input(n, feed, scope)
                 for n in seg.input_names}
         with jax.default_device(device):
-            out = seg.compiled(self._step, state, data)
+            out = compiled(self._step, state, data)
         from .flags import get_flag
         if get_flag('FLAGS_check_nan_inf'):
             # reference: CheckVarHasNanOrInf per-op sweep

@@ -87,12 +87,32 @@ def conv2d_transpose(ctx, ins, attrs):
     p = _pair(attrs.get('paddings', [0, 0]))
     pad = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else [
         (p[0], p[1]), (p[2], p[3])]
-    out = jax.lax.conv_transpose(
-        x, jnp.transpose(w, (2, 3, 0, 1)),  # -> HWIO with I=in_c
-        strides=strides, padding=[(ph[0], ph[1]) for ph in pad],
-        rhs_dilation=dilations,
-        dimension_numbers=('NCHW', 'HWIO', 'NCHW'),
-        transpose_kernel=True)
+    # explicit gradient-of-conv formulation (same as conv3d_transpose):
+    # lhs-dilate by stride, pad by (k_eff-1-p), spatially-flipped
+    # kernel as OIHW with O=out_c.  (The previous jax.lax.conv_transpose
+    # call mis-mapped both the channel slots — it only type-checked for
+    # in_c == out_c — and the padding: for k=3 the parity test's p=1
+    # coincided with k-1-p and masked it.)
+    in_c = x.shape[1]
+    out_c_g = w.shape[1]
+    k_eff = [(w.shape[2] - 1) * dilations[0] + 1,
+             (w.shape[3] - 1) * dilations[1] + 1]
+    pad2 = [(k_eff[i] - 1 - pad[i][0], k_eff[i] - 1 - pad[i][1])
+            for i in range(2)]
+    wf = jnp.flip(w, axis=(2, 3))
+    if groups > 1:
+        # [in_c, out_c/g, kh, kw] -> [out_c, in_c/g, kh, kw] blockwise
+        wf = wf.reshape(groups, in_c // groups, out_c_g,
+                        w.shape[2], w.shape[3])
+        wf = jnp.swapaxes(wf, 1, 2).reshape(
+            groups * out_c_g, in_c // groups, w.shape[2], w.shape[3])
+    else:
+        wf = jnp.swapaxes(wf, 0, 1)
+    out = jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1, 1), padding=pad2,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        feature_group_count=groups)
     return {'Output': [out]}
 
 
@@ -248,12 +268,8 @@ def batch_norm(ctx, ins, attrs):
     else:
         # one-pass statistics: E[x] and E[x^2] reduce in a single fused
         # multi-output pass over x (jnp.mean + jnp.var would read the
-        # conv output twice — measurable at 128x56x56x256).  The second
-        # moment is taken about the RUNNING mean (free: already a [C]
-        # vector, no extra pass over x) so E[(x-s)^2] - E[x-s]^2 doesn't
-        # catastrophically cancel when |mean| >> std, which the naive
-        # E[x^2]-E[x]^2 form does in float32; the identity is exact for
-        # any shift, the shift only conditions it.
+        # conv output twice — measurable at 128x56x56x256); dtype picks
+        # between the fused raw-sum form and a shift-conditioned form
         cnt = float(np.prod([x.shape[i] for i in red]))
         if x.dtype in (jnp.bfloat16, jnp.float16):
             # half-precision inputs: their own ~8-bit mantissa noise

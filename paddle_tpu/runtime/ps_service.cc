@@ -330,7 +330,12 @@ bool read_str(FILE *f, std::string *s2) {
 
 bool save_snapshot(Server *s, const std::string &path,
                    std::string *err) {
-  std::string tmp = path + ".tmp";
+  // unique tmp per call: concurrent SAVEs to the same path (two
+  // trainers checkpointing, or a deadline-retry resend) must not
+  // truncate each other's in-progress tmp file
+  static std::atomic<uint64_t> save_seq{0};
+  std::string tmp = path + ".tmp." + std::to_string(::getpid()) + "." +
+                    std::to_string(save_seq.fetch_add(1));
   FILE *f = std::fopen(tmp.c_str(), "wb");
   if (!f) {
     *err = "cannot open " + tmp + " for writing";

@@ -93,11 +93,77 @@ def test_while_grad_numeric():
         np.testing.assert_allclose(dx[idx], num, rtol=2e-2, atol=1e-4)
 
 
-def test_while_grad_needs_trip_count():
+def test_while_grad_unbounded_auto_bucket():
+    """Round 3: While WITHOUT max_trip_count differentiates — the
+    executor counts trips on the host, buckets to the next power of
+    two, and compiles the masked scan at that bucket (the reference's
+    WhileGradOp handles dynamic trip counts by replaying step scopes,
+    while_op.cc).  Gradients match the bounded build exactly."""
     main, startup, x, w, acc, loss = _build_while_prog(
         max_trip_count=None)
-    with pytest.raises(NotImplementedError, match='max_trip_count'):
-        fluid.backward.append_backward(loss)
+    pg = fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    wgrad = dict((p.name, g.name) for p, g in pg)['w_loop']
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 4).astype('float32')
+    lossv, dx, dw = _run(main, startup, {'x': xv},
+                         [loss, gmap['x'], wgrad])
+    wv, N = 1.5, 8.0
+    np.testing.assert_allclose(
+        lossv, (xv * (wv ** 2 + wv + 1)).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        dx, np.full((2, 4), (wv ** 2 + wv + 1) / N), rtol=1e-5)
+    np.testing.assert_allclose(
+        dw, (xv * (2 * wv + 1)).sum(0) / N, rtol=1e-4)
+
+
+def test_while_grad_unbounded_data_dependent_trips():
+    """Trip count depends on a FED value: the same compiled program
+    serves different trip counts; counts in one power-of-two bucket
+    reuse one executable, the truncation NaN guard never fires because
+    the bucket always covers the measured count."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[2, 4], dtype='float32',
+                        append_batch_size=False)
+        x.stop_gradient = False
+        n = layers.data('n', shape=[1], dtype='float32',
+                        append_batch_size=False)
+        i = layers.fill_constant([1], 'float32', 0)
+        acc = layers.fill_constant([2, 4], 'float32', 0.0)
+        cond = layers.less_than(i, n)
+        wh = layers.While(cond)  # no bound
+        with wh.block():
+            layers.assign(layers.elementwise_add(
+                layers.scale(acc, scale=0.5), x), acc)
+            layers.increment(i)
+            layers.assign(layers.less_than(i, n), cond)
+        loss = layers.mean(acc)
+    fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 4).astype('float32')
+
+    def expect(trips):
+        # acc_T = x * sum_{j<T} 0.5^j; dloss/dx = that sum / 8
+        s = sum(0.5 ** j for j in range(trips))
+        return (xv * s).mean(), np.full((2, 4), s / 8.0, 'float32')
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for trips in (3, 4, 7, 2, 16):
+            nv = np.array([float(trips)], 'float32')
+            lossv, dx = exe.run(main, feed={'x': xv, 'n': nv},
+                                fetch_list=[loss, gmap['x']])
+            want_l, want_dx = expect(trips)
+            np.testing.assert_allclose(
+                float(np.asarray(lossv).ravel()[0]), want_l,
+                rtol=1e-5, err_msg='trips=%d' % trips)
+            np.testing.assert_allclose(np.asarray(dx), want_dx,
+                                       rtol=1e-5,
+                                       err_msg='trips=%d' % trips)
 
 
 def test_while_early_exit_masking():

@@ -222,12 +222,11 @@ def test_gather_grad():
                  {}, grad_slots=['X'])
 
 
-def test_while_grad_without_bound_raises_clear_error():
-    """Gradients through an UNBOUNDED while must say how to fix it
-    (pass max_trip_count so backward can re-run the loop as a
-    reverse-differentiable lax.scan), not fail obscurely.  Bounded
-    loops differentiate — tests/test_control_flow_grad.py."""
-    import pytest
+def test_while_grad_without_bound_auto_buckets():
+    """Gradients through an UNBOUNDED while work via the executor's
+    trip-count auto-bucketing (round 3): v doubles until >= 10, so the
+    trip count is data-dependent and dout/dx = 2^trips.  See
+    tests/test_control_flow_grad.py for the full coverage."""
     import paddle_tpu.fluid as fluid
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -240,9 +239,22 @@ def test_while_grad_without_bound_raises_clear_error():
                 v, fluid.layers.fill_constant([1], 'float32', 2.0)),
             [fluid.layers.elementwise_add(
                 x, fluid.layers.fill_constant([1], 'float32', 0.0))])
-        loss = fluid.layers.mean(out)
-        with pytest.raises(NotImplementedError, match='max_trip_count'):
-            fluid.backward.append_backward(loss)
+        loss = fluid.layers.reduce_sum(out)
+        fluid.backward.append_backward(loss)
+    gname = main._grad_name_map['x']
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for x0, trips in ((1.0, 4), (3.0, 2), (0.2, 6)):
+            xv = np.array([[x0]], 'float32')
+            outv, dx = exe.run(main, feed={'x': xv},
+                               fetch_list=[out.name, gname])
+            np.testing.assert_allclose(
+                np.asarray(outv).ravel()[0], x0 * 2 ** trips,
+                rtol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(dx).ravel()[0], 2 ** trips, rtol=1e-6)
 
 
 def test_cond_grad_differentiates_taken_branch():

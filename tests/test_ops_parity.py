@@ -501,3 +501,42 @@ def test_selected_rows_host_ops():
                             {'Out': ['merged']}, {}))
     np.testing.assert_allclose(
         scope.find_var('merged')[:, 0], [0., 10., 20., 30., 40., 50.])
+
+
+def test_conv2d_transpose_torch_parity_asymmetric():
+    """Round-3 regression: conv2d_transpose channel mapping + padding
+    were wrong whenever in_c != out_c or p != k-1-p (the old p=1, k=3
+    parity case coincidentally masked both)."""
+    import torch
+    import torch.nn.functional as F
+    for stride, pad, inc, outc, dil, groups in (
+            (1, 0, 3, 2, 1, 1), (2, 1, 3, 2, 1, 1), (2, 0, 2, 4, 1, 1),
+            (2, 1, 4, 6, 2, 1), (2, 1, 4, 6, 1, 2)):
+        x = rng.randn(2, inc, 5, 5).astype('f4')
+        w = rng.randn(inc, outc // groups, 3, 3).astype('f4')
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 stride=stride, padding=pad,
+                                 dilation=dil, groups=groups).numpy()
+        got = np.asarray(run(
+            'conv2d_transpose',
+            {'Input': jnp.asarray(x), 'Filter': jnp.asarray(w)},
+            {'strides': [stride] * 2, 'paddings': [pad] * 2,
+             'dilations': [dil] * 2, 'groups': groups})['Output'][0])
+        np.testing.assert_allclose(
+            got, ref, rtol=1e-4, atol=1e-4,
+            err_msg='s=%d p=%d %d->%d d=%d g=%d'
+                    % (stride, pad, inc, outc, dil, groups))
+
+
+def test_conv3d_transpose_torch_parity():
+    import torch
+    import torch.nn.functional as F
+    x = rng.randn(1, 2, 4, 4, 4).astype('f4')
+    w = rng.randn(2, 3, 2, 2, 2).astype('f4')
+    ref = F.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                             stride=2, padding=1).numpy()
+    got = np.asarray(run(
+        'conv3d_transpose',
+        {'Input': jnp.asarray(x), 'Filter': jnp.asarray(w)},
+        {'strides': [2, 2, 2], 'paddings': [1, 1, 1]})['Output'][0])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

@@ -97,6 +97,7 @@ def main():
               flush=True)
 
         if args.block_sweep:
+            shipped = (fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
             for bq in (128, 256, 512):
                 for bk in (128, 256, 512):
                     if bq > t or bk > t:
@@ -108,8 +109,8 @@ def main():
                     ms = timed(gf, (q, k, v), args.steps)
                     print('    bq=%3d bk=%3d  %7.2f ms' % (bq, bk, ms),
                           flush=True)
-            fa.DEFAULT_BLOCK_Q = 256
-            fa.DEFAULT_BLOCK_K = 256
+            # restore the SHIPPED defaults so later seqs measure them
+            fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = shipped
 
 
 if __name__ == '__main__':
