@@ -94,7 +94,7 @@ def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
     return (time.time() - t0) / steps
 
 
-def bench_bert(batch=32, seq_len=128, steps=20):
+def bench_bert(batch=32, seq_len=128, steps=20, cfg=None):
     """BASELINE.json config 2: BERT-base pretrain step time.
 
     At seq 128 the bf16 batched attention chain is the fast path (the
@@ -102,7 +102,7 @@ def bench_bert(batch=32, seq_len=128, steps=20):
     [T,T] probs start to matter — see BENCHMARKS.md crossover)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
-    cfg = models.bert.BertConfig()
+    cfg = cfg or models.bert.BertConfig()
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 42
     with fluid.program_guard(main, startup):
@@ -121,6 +121,20 @@ def bench_bert(batch=32, seq_len=128, steps=20):
             % (batch, seq_len),
             'value': round(dt * 1000, 2), 'unit': 'ms/step',
             'seq_per_sec': round(batch / dt, 1)}
+
+
+def bench_bert_long(batch=4, seq_len=2048, steps=10):
+    """Long-context BERT step: the Pallas flash path (seq >=
+    flash_min_len; attn_dropout=0 so the probs never materialize) —
+    the configuration where the [T,T] probs would otherwise dominate
+    HBM and where the round-3 kernels run ~2x faster than the naive
+    chain (BENCHMARKS.md crossover)."""
+    from paddle_tpu import models
+    cfg = models.bert.BertConfig(max_pos=seq_len, attn_dropout=0.0)
+    return dict(bench_bert(batch=batch, seq_len=seq_len, steps=steps,
+                           cfg=cfg),
+                metric='bert_base_long_ctx_step_ms_b%d_s%d'
+                       % (batch, seq_len))
 
 
 def bench_wide_deep(batch=2048, steps=30, is_sparse=False):
@@ -273,9 +287,10 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == '--all':
         # secondary configs (BASELINE.json 0,2,3,4); the driver contract
         # stays the default single-line ResNet metric
-        for fn in (bench_lenet, bench_bert, bench_wide_deep,
-                   bench_wide_deep_sparse, bench_host_sparse_push,
-                   bench_rpc_sparse_push, bench_transformer):
+        for fn in (bench_lenet, bench_bert, bench_bert_long,
+                   bench_wide_deep, bench_wide_deep_sparse,
+                   bench_host_sparse_push, bench_rpc_sparse_push,
+                   bench_transformer):
             try:
                 print(json.dumps(fn()))
             except Exception as e:

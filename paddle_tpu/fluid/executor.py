@@ -27,7 +27,7 @@ from ..ops import registry
 
 class _Segment(object):
     __slots__ = ('ops', 'input_names', 'state_names', 'output_names',
-                 'compiled', 'bucket_ops')
+                 'compiled', 'bucket_ops', 'prefer_test')
 
     def __init__(self, ops):
         self.ops = ops
@@ -39,7 +39,9 @@ class _Segment(object):
         self.bucket_ops = [op for op in ops
                            if op.attrs.get('__bucket_group__')
                            is not None]
-        self.compiled = None
+        # executables keyed by (auto_layout_flag, per-op bucket sizes)
+        self.compiled = {}
+        self.prefer_test = False
 
 
 def _op_reads(op):
@@ -48,6 +50,16 @@ def _op_reads(op):
 
 def _op_writes(op):
     return [n for ns in op.outputs.values() for n in ns]
+
+
+def _op_dep_reads(op):
+    """Reads for the plan dataflow analysis: the declared input slots,
+    plus gradient-carrying while loops' carries — _lower_while seeds
+    loop state from the env even when the body only WRITES the var, so
+    its initializer in an upstream segment must stay live."""
+    names = list(_op_reads(op))
+    names += op.attrs.get('__carry_names__', ())
+    return names
 
 
 def _lower_ops(ops, env, step, prefer_test):
@@ -360,6 +372,23 @@ def _make_segment_fn(segment, prefer_test=False):
     return fn
 
 
+def _jit_segment(segment, auto_layout=False):
+    """jit a segment for the executor's own run loop.  With
+    FLAGS_segment_auto_layout, state/data boundary layouts are chosen
+    by XLA (jax.experimental.layout AUTO): the persistent state —
+    notably f32 AMP master weights — then lives in the layout the
+    compute wants across steps, so the per-step relayout copies at the
+    jit boundary disappear (the steady state feeds each step's outputs
+    straight back in as inputs with matching layouts)."""
+    fn = _make_segment_fn(segment, segment.prefer_test)
+    if auto_layout:
+        from jax.experimental.layout import Format, Layout
+        auto = Format(Layout.AUTO)
+        return jax.jit(fn, in_shardings=(None, auto, auto),
+                       out_shardings=auto, donate_argnums=(1,))
+    return jax.jit(fn, donate_argnums=(1,))
+
+
 class CompiledStep(object):
     """A program compiled to one jittable callable — the public
     'compile program -> function' surface (the reference's
@@ -383,6 +412,42 @@ class CompiledStep(object):
         return self.fn(step, state, data)
 
 
+class CompiledPipeline(object):
+    """A multi-segment program compiled to its execution plan: device
+    segments are cached jitted executables, host ops (save/load/print/
+    PS pulls) run between them through the scope.  NOT a pure function
+    — host ops may touch external state — so it cannot nest under
+    jit/grad; for that, restructure the program into one device
+    segment (CompiledStep).
+
+    __call__(feed, scope=None) runs one step against `scope` (default:
+    the global scope, where the startup program put the parameters)
+    and returns the fetches in order."""
+
+    __slots__ = ('_exe', '_program', '_plan', 'input_names',
+                 'fetch_names', 'host_op_types')
+
+    def __init__(self, executor, program, plan, feed_names,
+                 fetch_names):
+        self._exe = executor
+        self._program = program
+        self._plan = plan
+        self.input_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.host_op_types = [it[1].type for it in plan
+                              if not isinstance(it, _Segment)
+                              and it[0] == 'host']
+
+    def __call__(self, feed=None, scope=None, return_numpy=True):
+        scope = scope or core.global_scope()
+        exe = self._exe
+        exe._step += 1
+        out = exe._run_plan(self._program, self._plan, feed or {},
+                            self.fetch_names, scope, return_numpy)
+        exe._post_step(self._program, scope)
+        return out
+
+
 class Executor(object):
     """Reference: python/paddle/fluid/executor.py:680."""
 
@@ -394,11 +459,18 @@ class Executor(object):
         pass
 
     def compile(self, program, feed_names=(), fetch_names=(),
-                prefer_test=False):
-        """Compile `program` into ONE pure jittable function
-        (CompiledStep).  The program must lower to a single device
-        segment — host ops (save/load/print/PS pulls) cut segments and
-        cannot live inside a jitted step."""
+                prefer_test=False, allow_host=False):
+        """Compile `program`.
+
+        Single-segment programs (no host ops) return a CompiledStep —
+        ONE pure jittable function usable under jit/grad/shard_map.
+        Programs that split around host ops (save/load/print/PS pulls)
+        cannot be one pure function; with allow_host=True they compile
+        to a CompiledPipeline — each device segment is a cached jitted
+        executable, host ops run between them through a scope — the
+        general 'compile a program' surface (the reference's
+        Executor::Prepare caches exactly this per-program op plan,
+        framework/executor.h:81)."""
         from . import framework as _fw
 
         def _norm(names):
@@ -407,16 +479,57 @@ class Executor(object):
 
         feed_names = _norm(feed_names)
         fetch_names = _norm(fetch_names)
-        plan = self._build_plan(program, tuple(sorted(feed_names)),
-                                tuple(fetch_names))
+        if prefer_test:
+            # test-mode lowering must not share executables with the
+            # training-mode plan Executor.run caches — fresh segments,
+            # marked before their lazy jit
+            plan = self._build_plan(program, tuple(sorted(feed_names)),
+                                    tuple(fetch_names))
+            for it in plan:
+                if isinstance(it, _Segment):
+                    it.prefer_test = True
+        else:
+            plan = self._get_plan(program, tuple(sorted(feed_names)),
+                                  tuple(fetch_names))
         segs = [it for it in plan if isinstance(it, _Segment)]
         if len(segs) != 1 or len(plan) != 1:
-            host = [it[1].type for it in plan
-                    if not isinstance(it, _Segment)]
+            if allow_host:
+                known_out = set()
+                known_in = set()
+                for it in plan:
+                    if isinstance(it, _Segment):
+                        known_out.update(it.output_names)
+                        known_in.update(it.input_names)
+                        known_in.update(it.state_names)
+                    else:
+                        known_out.update(_op_writes(it[1]))
+                        known_in.update(_op_reads(it[1]))
+                missing = [n for n in fetch_names if n not in known_out]
+                if missing:
+                    raise ValueError(
+                        'fetch vars %r are not produced by the program'
+                        % (missing,))
+                bogus = [n for n in feed_names if n not in known_in]
+                if bogus:
+                    raise ValueError(
+                        'feed names %r are not read by the program'
+                        % (bogus,))
+                return CompiledPipeline(self, program, plan,
+                                        feed_names, fetch_names)
+            cuts = [it for it in plan if not isinstance(it, _Segment)]
+            why = []
+            host = [it[1].type for it in cuts if it[0] == 'host']
+            if host:
+                why.append('host ops %r' % (host,))
+            if any(it[0] == 'bucket' for it in cuts):
+                why.append('auto-bucketed unbounded while loops (pass '
+                           'max_trip_count to bound them)')
             raise ValueError(
-                'Executor.compile needs a single-segment program; this '
-                'one splits into %d segments around host ops %r — run '
-                'it with Executor.run instead' % (len(segs), host))
+                'Executor.compile needs a single-segment program for a '
+                'pure jittable step; this one splits into %d segments '
+                'around %s — pass allow_host=True for a '
+                'CompiledPipeline, or run it with Executor.run'
+                % (len(segs), ' and '.join(why) or 'program cuts'))
         seg = segs[0]
         missing = [n for n in fetch_names if n not in seg.output_names]
         if missing:
@@ -458,6 +571,12 @@ class Executor(object):
         self._step += 1
         out = self._run_plan(program, plan, feed, fetch_names, scope,
                              return_numpy)
+        self._post_step(program, scope)
+        return out
+
+    def _post_step(self, program, scope):
+        """Per-step hooks shared by run() and CompiledPipeline: k-step
+        LocalSGD sync and the async-PS grad push/param pull."""
         lsgd = getattr(program, '_local_sgd', None)
         if lsgd:
             lsgd['count'] = lsgd.get('count', 0) + 1
@@ -466,7 +585,6 @@ class Executor(object):
         if getattr(program, '_ps_async', None):
             from .incubate.fleet.parameter_server import ps_async_step
             ps_async_step(self, scope, program)
-        return out
 
     def _local_sgd_sync(self, scope, param_names):
         """LocalSGD sync point: average trainable params across trainer
@@ -531,14 +649,14 @@ class Executor(object):
             item = items[i]
             ops = item.ops if isinstance(item, _Segment) else [item[1]]
             for op in ops:
-                acc.update(_op_reads(op))
+                acc.update(_op_dep_reads(op))
         for i, item in enumerate(items):
             if not isinstance(item, _Segment):
                 continue
             written = set()
             reads_before_write = set()
             for op in item.ops:
-                for n in _op_reads(op):
+                for n in _op_dep_reads(op):
                     if n not in written:
                         reads_before_write.add(n)
                 written.update(_op_writes(op))
@@ -623,12 +741,16 @@ class Executor(object):
         if cond_name not in carry_names:
             carry_names.append(cond_name)
         env = {}
-        for n in _op_reads(op):
+        for n in dict.fromkeys(_op_dep_reads(op)):
             env[n] = self._lookup_input(n, feed, scope)
 
         count_jit = op.attrs.get('__count_fn__')
         if count_jit is None:
-            def count(env_in):
+            def count(env_in, step):
+                # `step` is traced so step-seeded stochastic ops
+                # (dropout keys fold it in) draw the SAME values here
+                # as in the real forward segment — the measured trip
+                # count must match the loop the bucket will run
                 def cond_fn(st):
                     carry, _ = st
                     return jnp.asarray(carry[cond_name]).reshape(
@@ -638,7 +760,7 @@ class Executor(object):
                     carry, i = st
                     local = dict(env_in)
                     local.update(carry)
-                    _lower_ops(sub.ops, local, 0, False)
+                    _lower_ops(sub.ops, local, step, False)
                     new = {n: jnp.asarray(local[n]).astype(
                         jnp.asarray(carry[n]).dtype)
                         for n in carry_names}
@@ -652,7 +774,7 @@ class Executor(object):
             count_jit = jax.jit(count)
             op.attrs['__count_fn__'] = count_jit
         with jax.default_device(device):
-            trips = int(count_jit(env))
+            trips = int(count_jit(env, jnp.uint32(self._step)))
         bucket = 1
         while bucket < max(trips, 1):
             bucket *= 2
@@ -664,23 +786,16 @@ class Executor(object):
     def _run_segment(self, seg, feed, scope, device, fetched):
         # segments holding auto-bucketed while ops compile one
         # executable PER BUCKET (the masked-scan length is baked into
-        # the trace); others keep the single cached executable
-        if seg.bucket_ops:
-            bucket_key = tuple(op.attrs.get('max_trip_count')
-                               for op in seg.bucket_ops)
-            cache = seg.compiled if isinstance(seg.compiled, dict) \
-                else {}
-            seg.compiled = cache
-            if bucket_key not in cache:
-                cache[bucket_key] = jax.jit(_make_segment_fn(seg),
-                                            donate_argnums=(1,))
-            compiled = cache[bucket_key]
-        elif seg.compiled is None:
-            seg.compiled = jax.jit(_make_segment_fn(seg),
-                                   donate_argnums=(1,))
-            compiled = seg.compiled
-        else:
-            compiled = seg.compiled
+        # the trace); the cache also keys on the auto-layout flag so
+        # toggling it takes effect on already-compiled programs
+        from .flags import get_flag
+        auto = bool(get_flag('FLAGS_segment_auto_layout'))
+        key = (auto,) + tuple(op.attrs.get('max_trip_count')
+                              for op in seg.bucket_ops)
+        compiled = seg.compiled.get(key)
+        if compiled is None:
+            compiled = seg.compiled[key] = _jit_segment(seg, auto)
+
         state = {}
         for n in seg.state_names:
             v = self._lookup_input(n, feed, scope)

@@ -24,6 +24,13 @@ _DEFAULTS = {
     'FLAGS_communicator_fake_rpc': False,
     'FLAGS_rpc_deadline': 180000,
     'FLAGS_rpc_retry_times': 3,
+    # let XLA choose boundary layouts for executor segments (AUTO
+    # layouts), so persistent state lives in the layout the compute
+    # wants.  Off by default: measured ~0 gain on the ResNet headline
+    # (the boundary casts are layout-forced for any f32-master-weight
+    # program) and AUTO-layout executables break when reloaded from the
+    # persistent XLA compile cache on this backend (see BENCHMARKS.md)
+    'FLAGS_segment_auto_layout': False,
 }
 
 _flags = {}

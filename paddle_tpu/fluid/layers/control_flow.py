@@ -53,11 +53,15 @@ class While(object):
 
     def __init__(self, cond, is_test=False, name=None,
                  max_trip_count=None):
-        """max_trip_count bounds the loop so gradients can flow through
-        it: backward re-runs the loop as a masked lax.scan of that
-        length (reference WhileGradOp replays saved step scopes,
-        operators/controlflow/while_op.cc — a shape-static compiler
-        needs the bound instead)."""
+        """max_trip_count bounds the loop for gradients: backward
+        re-runs it as a masked lax.scan of that length (reference
+        WhileGradOp replays saved step scopes,
+        operators/controlflow/while_op.cc).  WITHOUT a bound the
+        executor auto-buckets: a host counting pass measures the trip
+        count each step and compiles the scan at the next power of two
+        — one executable per bucket.  Pass max_trip_count when you know
+        the bound to skip the counting pass (one extra forward run of
+        the loop per step)."""
         self.helper = LayerHelper('while', name=name)
         self.status = While.BEFORE_WHILE_BLOCK
         if not isinstance(cond, Variable):

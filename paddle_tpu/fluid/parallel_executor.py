@@ -250,16 +250,17 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         sh = data_shard(n, v)
         return _to_global(v, sh, per_process=sh.spec != P())
     data = {n: _convert_data(n, v) for n, v in data.items()}
-    if seg.compiled is None or not isinstance(seg.compiled, tuple):
+    compiled = seg.compiled.get('parallel')
+    if compiled is None:
         fn = _make_segment_fn(seg)
         in_shardings = (None,
                         {n: state_shard(n, state[n])
                          for n in seg.state_names},
                         {n: data_shard(n, data[n]) for n in
                          seg.input_names})
-        seg.compiled = ('parallel', jax.jit(
-            fn, in_shardings=in_shardings, donate_argnums=(1,)))
-    out = seg.compiled[1](executor._step, state, data)
+        compiled = seg.compiled['parallel'] = jax.jit(
+            fn, in_shardings=in_shardings, donate_argnums=(1,))
+    out = compiled(executor._step, state, data)
     for n, v in out.items():
         scope.set_var(n, v)
         fetched[n] = v
@@ -325,7 +326,8 @@ def run_collective(executor, program, feed, fetch_list, scope,
             data = {n: _to_global(v, NamedSharding(mesh, data_specs[n]),
                                   per_process=data_specs[n] != P())
                     for n, v in data.items()}
-        if seg.compiled is None:
+        compiled = seg.compiled.get('collective')
+        if compiled is None:
             fn = _make_segment_fn(seg)
             in_specs = (P(),
                         {n: P() for n in seg.state_names},
@@ -333,7 +335,8 @@ def run_collective(executor, program, feed, fetch_list, scope,
             out_specs = {n: P() for n in seg.output_names}
             sm = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False)
-            seg.compiled = jax.jit(sm, donate_argnums=(1,))
+            compiled = seg.compiled['collective'] = jax.jit(
+                sm, donate_argnums=(1,))
         if jax.process_count() > 1:
             # a process-local scalar would carry an inconsistent
             # single-device sharding across processes; replicate it
@@ -342,7 +345,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
         else:
             step = jnp.asarray(executor._step)
         try:
-            out = seg.compiled(step, state, data)
+            out = compiled(step, state, data)
         except Exception as e:
             detail = []
             for group, d in (('state', state), ('data', data)):

@@ -157,3 +157,66 @@ def test_transformer_trains_from_ragged_with_bounded_compiles():
     assert len(seen_shapes) >= 2, seen_shapes
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_imdb_sentiment_end_to_end_via_bucketed_loader():
+    """Round 3: the bucketed loader over a REAL dataset reader
+    (paddle_tpu.dataset.imdb, the reference's understand_sentiment
+    data path) — ragged reviews, learnable sentiment signal, accuracy
+    must beat chance by a wide margin after one epoch."""
+    from paddle_tpu import dataset
+
+    word_dict = dataset.imdb.word_dict()
+    vocab = len(word_dict)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        ids = layers.data('ids', shape=[1], dtype='int64', lod_level=1)
+        mask = layers.data('ids@MASK', shape=[1], dtype='float32')
+        label = layers.data('label', shape=[1], dtype='int64')
+        emb = layers.embedding(ids, size=[vocab, 32])
+        feat = fluid.nets.sequence_conv_pool(emb, 48, 3, act='tanh',
+                                             pool_type='max', mask=mask)
+        logits = layers.fc(feat, 2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+
+    def train_samples():
+        for seq, lab in dataset.imdb.train()():
+            yield np.asarray(seq, 'int64'), np.int64(lab)
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[ids, label], bucket_boundaries=[32, 64, 128],
+        batch_size=32)
+    loader.set_sample_generator(train_samples)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for epoch in range(2):
+            for feed in loader:
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        assert np.isfinite(losses).all()
+
+        # eval on the held-out synthetic test split
+        test_loader = fluid.io.DataLoader.from_generator(
+            feed_list=[ids, label], bucket_boundaries=[32, 64, 128],
+            batch_size=32)
+
+        def test_samples():
+            for seq, lab in dataset.imdb.test()():
+                yield np.asarray(seq, 'int64'), np.int64(lab)
+
+        test_loader.set_sample_generator(test_samples)
+        correct = total = 0
+        for feed in test_loader:
+            lg, = exe.run(test_prog, feed=feed, fetch_list=[logits])
+            pred = np.asarray(lg).argmax(1)
+            correct += int((pred == feed['label'].ravel()).sum())
+            total += len(pred)
+    acc = correct / total
+    assert acc > 0.8, (acc, correct, total)

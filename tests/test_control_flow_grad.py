@@ -166,6 +166,70 @@ def test_while_grad_unbounded_data_dependent_trips():
                                        err_msg='trips=%d' % trips)
 
 
+def test_while_grad_unbounded_write_only_carry():
+    """An unbounded loop whose body WRITES a parent var it never reads
+    (assign into a pre-initialized output): the trip-count pass must
+    seed that carry from the scope and the segment DCE must keep its
+    initializer alive (executor._op_dep_reads)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[2, 4], dtype='float32',
+                        append_batch_size=False)
+        x.stop_gradient = False
+        i = layers.fill_constant([1], 'float32', 0)
+        n = layers.fill_constant([1], 'float32', 3)
+        acc = layers.fill_constant([2, 4], 'float32', 0.0)
+        y = layers.fill_constant([2, 4], 'float32', 0.0)
+        cond = layers.less_than(i, n)
+        wh = layers.While(cond)  # no bound -> auto-bucket
+        with wh.block():
+            layers.assign(layers.elementwise_add(acc, x), acc)
+            # y is written from the loop state but never read inside
+            layers.assign(layers.scale(acc, scale=2.0), y)
+            layers.increment(i)
+            layers.assign(layers.less_than(i, n), cond)
+        loss = layers.elementwise_add(layers.mean(acc), layers.mean(y))
+    fluid.backward.append_backward(loss)
+    gmap = main._grad_name_map
+    rng = np.random.RandomState(2)
+    xv = rng.randn(2, 4).astype('float32')
+    # acc_3 = 3x, y = 2*acc_3 = 6x -> loss = 9*mean(x), dx = 9/8
+    lossv, dx = _run(main, startup, {'x': xv}, [loss, gmap['x']])
+    np.testing.assert_allclose(float(np.asarray(lossv).ravel()[0]),
+                               9 * xv.mean(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx),
+                               np.full((2, 4), 9 / 8.0, 'float32'),
+                               rtol=1e-5)
+
+
+def test_unbounded_while_compile_refusal_names_the_cause():
+    """Executor.compile on a program whose only cut is an auto-bucketed
+    unbounded while must name the loop (not claim 'host ops'), and
+    allow_host=True must compile a working pipeline with no host ops
+    reported."""
+    main, startup, x, w, acc, loss = _build_while_prog(
+        max_trip_count=None)
+    fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    with pytest.raises(ValueError, match='max_trip_count'):
+        exe.compile(main, feed_names=('x',), fetch_names=(loss.name,))
+    pipe = exe.compile(main, feed_names=('x',),
+                       fetch_names=(loss.name,), allow_host=True)
+    assert pipe.host_op_types == []
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 4).astype('float32')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        step0 = exe._step
+        got, = pipe({'x': xv}, scope=scope)
+        assert exe._step == step0 + 1  # pipeline advances the RNG step
+    wv = 1.5
+    np.testing.assert_allclose(float(np.asarray(got).ravel()[0]),
+                               (xv * (wv ** 2 + wv + 1)).mean(),
+                               rtol=1e-5)
+
+
 def test_while_early_exit_masking():
     # max_trip_count=8 > 3 actual trips: masked iterations must not
     # contribute to values or gradients

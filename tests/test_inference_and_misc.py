@@ -45,6 +45,45 @@ def test_predictor_roundtrip(tmp_path):
                                atol=1e-6)
 
 
+def test_segment_auto_layout_flag():
+    """FLAGS_segment_auto_layout=1 compiles executor segments with
+    XLA-chosen boundary layouts (jax.experimental.layout AUTO) —
+    training must run and match the default-layout path exactly."""
+    def train(auto):
+        fluid.set_flags({'FLAGS_segment_auto_layout': auto})
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 11
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data('x', shape=[8], dtype='float32')
+                y = fluid.layers.data('y', shape=[1], dtype='float32')
+                pred = fluid.layers.fc(fluid.layers.fc(x, 16,
+                                                       act='relu'), 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            rng = np.random.RandomState(0)
+            xs = rng.randn(64, 8).astype('float32')
+            ys = rng.randn(64, 1).astype('float32')
+            out = []
+            with fluid.scope_guard(fluid.Scope()):
+                exe = fluid.Executor(fluid.XLAPlace(0))
+                exe.run(startup)
+                for _ in range(5):
+                    l, = exe.run(main, feed={'x': xs, 'y': ys},
+                                 fetch_list=[loss])
+                    out.append(float(np.asarray(l).ravel()[0]))
+            return out
+        finally:
+            fluid.set_flags({'FLAGS_segment_auto_layout': False})
+
+    ref = train(False)
+    got = train(True)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    assert got[-1] < got[0]
+
+
 def test_check_nan_inf_flag():
     fluid.set_flags({'FLAGS_check_nan_inf': True})
     try:

@@ -129,7 +129,8 @@ def test_executor_public_compile_api():
         np.testing.assert_allclose(np.asarray(out[h.name]), ref,
                                    rtol=1e-6)
 
-    # host ops split the program -> compile must refuse with guidance
+    # host ops split the program -> the pure-step contract refuses
+    # with guidance, and allow_host=True compiles the PIPELINE instead
     main2, startup2 = fluid.Program(), fluid.Program()
     with fluid.program_guard(main2, startup2):
         x2 = layers.data('x', shape=[4], dtype='float32')
@@ -139,6 +140,13 @@ def test_executor_public_compile_api():
     exe2 = fluid.Executor(fluid.XLAPlace(0))
     with pytest.raises(ValueError, match='single-segment'):
         exe2.compile(main2, feed_names=('x',), fetch_names=(z2.name,))
+    pipe = exe2.compile(main2, feed_names=('x',),
+                        fetch_names=(z2.name,), allow_host=True)
+    assert pipe.host_op_types == ['print']
+    xv2 = np.random.RandomState(1).randn(2, 4).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        got, = pipe({'x': xv2})
+    np.testing.assert_allclose(np.asarray(got), xv2 * 6.0, rtol=1e-6)
 
 
 def test_executor_compile_validates_names():

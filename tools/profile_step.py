@@ -1,0 +1,48 @@
+"""Capture a device profile of ANY bench workload and print the kernel
+rollup (generalizes tools/profile_resnet.py to the whole bench suite).
+
+Usage: python tools/profile_step.py bert [kwargs as k=v ...]
+       python tools/profile_step.py transformer steps=8
+Workloads: any bench_<name> in bench.py (resnet50, lenet, bert,
+bert_long, wide_deep, transformer, ...).
+
+The trace wraps the bench call, so warmup/compile appear in the module
+span but barely perturb the kernel rollup (steady-state steps
+dominate).  Raw trace under /tmp/paddle_tpu_profile_step for
+TensorBoard/Perfetto.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import bench
+    from profile_resnet import analyze
+
+    name = sys.argv[1] if len(sys.argv) > 1 else 'bert'
+    kwargs = {}
+    for arg in sys.argv[2:]:
+        k, v = arg.split('=', 1)
+        try:
+            kwargs[k] = int(v)
+        except ValueError:
+            kwargs[k] = v
+    fn = getattr(bench, 'bench_' + name)
+    logdir = '/tmp/paddle_tpu_profile_step'
+    os.system('rm -rf %s' % logdir)
+    with jax.profiler.trace(logdir):
+        result = fn(**kwargs)
+    print(result)
+    import inspect
+    default_steps = inspect.signature(fn).parameters['steps'].default
+    analyze(logdir, kwargs.get('steps', default_steps))
+
+
+if __name__ == '__main__':
+    main()
