@@ -479,18 +479,8 @@ class Executor(object):
 
         feed_names = _norm(feed_names)
         fetch_names = _norm(fetch_names)
-        if prefer_test:
-            # test-mode lowering must not share executables with the
-            # training-mode plan Executor.run caches — fresh segments,
-            # marked before their lazy jit
-            plan = self._build_plan(program, tuple(sorted(feed_names)),
-                                    tuple(fetch_names))
-            for it in plan:
-                if isinstance(it, _Segment):
-                    it.prefer_test = True
-        else:
-            plan = self._get_plan(program, tuple(sorted(feed_names)),
-                                  tuple(fetch_names))
+        plan = self._get_plan(program, tuple(sorted(feed_names)),
+                              tuple(fetch_names), prefer_test)
         segs = [it for it in plan if isinstance(it, _Segment)]
         if len(segs) != 1 or len(plan) != 1:
             if allow_host:
@@ -595,11 +585,18 @@ class Executor(object):
             scope.set_var(n, avg)
 
     # ------------------------------------------------------------------
-    def _get_plan(self, program, feed_names, fetch_names):
-        key = ('plan', feed_names, fetch_names, id(self))
+    def _get_plan(self, program, feed_names, fetch_names,
+                  prefer_test=False):
+        # prefer_test keys the cache so test-mode lowering never shares
+        # executables with the training-mode plan
+        key = ('plan', feed_names, fetch_names, id(self), prefer_test)
         plan = program._exec_cache.get(key)
         if plan is None:
             plan = self._build_plan(program, feed_names, fetch_names)
+            if prefer_test:
+                for it in plan:
+                    if isinstance(it, _Segment):
+                        it.prefer_test = True
             program._exec_cache[key] = plan
         return plan
 
@@ -686,11 +683,14 @@ class Executor(object):
             for k, v in feed.items():
                 scope.set_var(k, v.data if isinstance(v, core.LoDTensor)
                               else v)
+        prefer_test = any(isinstance(it, _Segment) and it.prefer_test
+                          for it in plan)
         for item in plan:
             if isinstance(item, _Segment):
                 self._run_segment(item, feed, scope, device, fetched)
             elif item[0] == 'bucket':
-                self._run_bucket_count(item[1], feed, scope, device)
+                self._run_bucket_count(item[1], feed, scope, device,
+                                       prefer_test)
             else:
                 op = item[1]
                 registry.get(op.type).fn(self, scope, op)
@@ -724,7 +724,8 @@ class Executor(object):
                 'startup program first' % name)
         return core.as_array(val)
 
-    def _run_bucket_count(self, op, feed, scope, device):
+    def _run_bucket_count(self, op, feed, scope, device,
+                          prefer_test=False):
         """Host leg of the unbounded-while gradient: run the loop ONCE
         as a cheap non-differentiable lax.while_loop over the concrete
         carries, count the trips, round up to the next power of two,
@@ -744,13 +745,15 @@ class Executor(object):
         for n in dict.fromkeys(_op_dep_reads(op)):
             env[n] = self._lookup_input(n, feed, scope)
 
-        count_jit = op.attrs.get('__count_fn__')
+        cache = op.attrs.setdefault('__count_fn__', {})
+        count_jit = cache.get(prefer_test)
         if count_jit is None:
-            def count(env_in, step):
+            def count(env_in, step, _pt=prefer_test):
                 # `step` is traced so step-seeded stochastic ops
                 # (dropout keys fold it in) draw the SAME values here
-                # as in the real forward segment — the measured trip
-                # count must match the loop the bucket will run
+                # as in the real forward segment, and _pt matches the
+                # segment's train/test lowering mode — the measured
+                # trip count must match the loop the bucket will run
                 def cond_fn(st):
                     carry, _ = st
                     return jnp.asarray(carry[cond_name]).reshape(
@@ -760,7 +763,7 @@ class Executor(object):
                     carry, i = st
                     local = dict(env_in)
                     local.update(carry)
-                    _lower_ops(sub.ops, local, step, False)
+                    _lower_ops(sub.ops, local, step, _pt)
                     new = {n: jnp.asarray(local[n]).astype(
                         jnp.asarray(carry[n]).dtype)
                         for n in carry_names}
@@ -771,8 +774,7 @@ class Executor(object):
                 _, trips = jax.lax.while_loop(cond_fn, body_fn, init)
                 return trips
 
-            count_jit = jax.jit(count)
-            op.attrs['__count_fn__'] = count_jit
+            count_jit = cache[prefer_test] = jax.jit(count)
         with jax.default_device(device):
             trips = int(count_jit(env, jnp.uint32(self._step)))
         bucket = 1
@@ -809,7 +811,6 @@ class Executor(object):
                 for n in seg.input_names}
         with jax.default_device(device):
             out = compiled(self._step, state, data)
-        from .flags import get_flag
         if get_flag('FLAGS_check_nan_inf'):
             # reference: CheckVarHasNanOrInf per-op sweep
             # (framework/details/nan_inf_utils.h:28) — here per segment

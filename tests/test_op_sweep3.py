@@ -1,0 +1,905 @@
+"""Op sweep part 3: behavioral coverage for the ops no other test
+exercises — comparisons/logicals, fill-likes, indexing, linalg,
+optimizer update rules vs numpy reference math, quantization helpers,
+streaming AUC, detection host ops, save/load_combine, collective
+variants inside shard_map, and the BoxPS/distributed sparse-table ops.
+
+Reference model: the per-op OpTest discipline
+(python/paddle/fluid/tests/unittests/test_*_op.py) — every op's
+lowering validated through the real executor against a numpy oracle.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from op_test import OpTest
+
+layers = fluid.layers
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# comparisons + logicals
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('op,ref', [
+    ('greater_equal', np.greater_equal),
+    ('less_equal', np.less_equal),
+    ('not_equal', np.not_equal),
+])
+def test_comparison_ops(op, ref):
+    t = OpTest()
+    x = rng.randint(0, 4, (3, 4)).astype('float32')
+    y = rng.randint(0, 4, (3, 4)).astype('float32')
+    t.check_output(op, {'X': x, 'Y': y}, expect={'Out': ref(x, y)})
+
+
+@pytest.mark.parametrize('op,ref', [
+    ('logical_and', np.logical_and),
+    ('logical_or', np.logical_or),
+    ('logical_xor', np.logical_xor),
+])
+def test_logical_binary_ops(op, ref):
+    t = OpTest()
+    x = (rng.rand(3, 4) > 0.5)
+    y = (rng.rand(3, 4) > 0.5)
+    t.check_output(op, {'X': x, 'Y': y}, expect={'Out': ref(x, y)})
+
+
+def test_logical_not():
+    t = OpTest()
+    x = (rng.rand(3, 4) > 0.5)
+    t.check_output('logical_not', {'X': x},
+                   expect={'Out': np.logical_not(x)})
+
+
+# ---------------------------------------------------------------------------
+# fill-likes / constants / misc tensor ops
+# ---------------------------------------------------------------------------
+
+def test_fill_any_like():
+    t = OpTest()
+    x = rng.randn(2, 3).astype('float32')
+    t.check_output('fill_any_like', {'X': x}, attrs={'value': 2.5},
+                   expect={'Out': np.full_like(x, 2.5)})
+
+
+def test_fill_zeros_like():
+    t = OpTest()
+    x = rng.randn(2, 3).astype('float32')
+    t.check_output('fill_zeros_like', {'X': x},
+                   expect={'Out': np.zeros_like(x)})
+
+
+def test_fill_constant_batch_size_like():
+    t = OpTest()
+    x = rng.randn(5, 3).astype('float32')
+    t.check_output('fill_constant_batch_size_like', {'Input': x},
+                   attrs={'shape': [1, 7], 'value': 3.0},
+                   expect={'Out': np.full((5, 7), 3.0, 'float32')})
+
+
+def test_assign_value():
+    t = OpTest()
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = main.global_block().create_var(name='av_out', shape=(),
+                                             dtype='float32')
+        main.global_block().append_op(
+            'assign_value', inputs={}, outputs={'Out': out},
+            attrs={'shape': [2, 3], 'values': vals, 'dtype': 'float32'})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        got, = exe.run(main, feed={}, fetch_list=[out])
+    np.testing.assert_allclose(
+        got, np.asarray(vals, 'float32').reshape(2, 3))
+
+
+def test_share_data_is_identity():
+    t = OpTest()
+    x = rng.randn(4, 2).astype('float32')
+    t.check_output('share_data', {'X': x}, expect={'Out': x})
+
+
+def test_is_empty():
+    t = OpTest()
+    t.check_output('is_empty', {'X': np.zeros((0, 3), 'float32')},
+                   expect={'Out': np.asarray(True)})
+    t.check_output('is_empty', {'X': np.ones((2, 3), 'float32')},
+                   expect={'Out': np.asarray(False)})
+
+
+def test_isnan_isinf():
+    t = OpTest()
+    x = np.array([1.0, np.nan, 2.0], 'float32')
+    y = np.array([1.0, np.inf, 2.0], 'float32')
+    t.check_output('isnan', {'X': x}, expect={'Out': np.asarray(True)})
+    t.check_output('isnan', {'X': y}, expect={'Out': np.asarray(False)})
+    t.check_output('isinf', {'X': y}, expect={'Out': np.asarray(True)})
+    t.check_output('isinf', {'X': x}, expect={'Out': np.asarray(False)})
+
+
+def test_one_hot_v2():
+    t = OpTest()
+    ids = np.array([[0], [2], [1]], 'int64')
+    want = np.eye(4, dtype='float32')[[0, 2, 1]]
+    t.check_output('one_hot_v2', {'X': ids}, attrs={'depth': 4},
+                   expect={'Out': want})
+
+
+def test_ceil():
+    t = OpTest()
+    x = rng.randn(3, 4).astype('float32') * 3
+    t.check_output('ceil', {'X': x}, expect={'Out': np.ceil(x)})
+
+
+# ---------------------------------------------------------------------------
+# indexing / selection
+# ---------------------------------------------------------------------------
+
+def test_arg_min():
+    t = OpTest()
+    x = rng.randn(4, 5).astype('float32')
+    t.check_output('arg_min', {'X': x}, attrs={'axis': 1},
+                   expect={'Out': np.argmin(x, 1)})
+    t.check_output('arg_min', {'X': x}, attrs={'axis': 0},
+                   expect={'Out': np.argmin(x, 0)})
+
+
+def test_gather_nd():
+    t = OpTest()
+    x = rng.randn(3, 4, 5).astype('float32')
+    idx = np.array([[0, 1], [2, 3]], 'int64')
+    t.check_output('gather_nd', {'X': x, 'Index': idx},
+                   expect={'Out': x[[0, 2], [1, 3]]})
+    t.check_grad('gather_nd', {'X': x, 'Index': idx})
+
+
+def test_index_select():
+    t = OpTest()
+    x = rng.randn(4, 6).astype('float32')
+    idx = np.array([3, 0, 0, 2], 'int64')
+    t.check_output('index_select', {'X': x, 'Index': idx},
+                   attrs={'dim': 0}, expect={'Out': x[idx]})
+    t.check_output('index_select', {'X': x, 'Index': idx},
+                   attrs={'dim': 1}, expect={'Out': x[:, idx]})
+    t.check_grad('index_select', {'X': x, 'Index': idx},
+                 attrs={'dim': 0})
+
+
+def test_top_k_v2():
+    t = OpTest()
+    x = rng.randn(3, 8).astype('float32')
+    got = t.run_op('top_k_v2', {'X': x}, attrs={'k': 3},
+                   out_slots=('Out', 'Indices'))
+    want = np.sort(x, axis=1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(got['Out'], want, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.take_along_axis(x, np.asarray(got['Indices'],
+                                         'int64'), 1), want)
+
+
+def test_reduce_any():
+    t = OpTest()
+    x = rng.rand(3, 4) > 0.7
+    t.check_output('reduce_any', {'X': x}, attrs={'dim': [1]},
+                   expect={'Out': x.any(1)})
+    t.check_output('reduce_any', {'X': x}, attrs={'reduce_all': True},
+                   expect={'Out': x.any()})
+
+
+def test_unstack():
+    main, startup = fluid.Program(), fluid.Program()
+    x = rng.randn(3, 4).astype('float32')
+    with fluid.program_guard(main, startup):
+        xv = main.global_block().create_var(name='x', shape=(3, 4),
+                                            dtype='float32')
+        outs = [main.global_block().create_var(
+            name='us_%d' % i, shape=(4,), dtype='float32')
+            for i in range(3)]
+        main.global_block().append_op('unstack', inputs={'X': xv},
+                                      outputs={'Y': outs},
+                                      attrs={'axis': 0, 'num': 3})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        got = exe.run(main, feed={'x': x}, fetch_list=list(outs))
+    for i in range(3):
+        np.testing.assert_allclose(got[i], x[i], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+def test_cholesky():
+    t = OpTest()
+    a = rng.randn(4, 4).astype('float32')
+    spd = (a @ a.T + 4 * np.eye(4)).astype('float32')
+    got = t.check_output('cholesky', {'X': spd},
+                         expect={'Out': np.linalg.cholesky(spd)},
+                         atol=1e-4)
+    del got
+    t.grad_rtol = 2e-2
+    t.grad_atol = 2e-2
+    t.check_grad('cholesky', {'X': spd})
+
+
+def test_inverse():
+    t = OpTest()
+    a = rng.randn(3, 3).astype('float32')
+    a = a + 3 * np.eye(3, dtype='float32')
+    t.check_output('inverse', {'Input': a}, out_slots=['Output'],
+                   expect={'Output': np.linalg.inv(a)}, atol=1e-4)
+    t.check_grad('inverse', {'Input': a}, out_slot='Output')
+
+
+# ---------------------------------------------------------------------------
+# misc shape/value ops
+# ---------------------------------------------------------------------------
+
+def test_clip_by_norm():
+    t = OpTest()
+    x = rng.randn(3, 4).astype('float32') * 5
+    norm = np.sqrt((x ** 2).sum())
+    want = x * min(1.0, 2.0 / norm)
+    t.check_output('clip_by_norm', {'X': x}, attrs={'max_norm': 2.0},
+                   expect={'Out': want})
+    t.check_grad('clip_by_norm', {'X': x}, attrs={'max_norm': 2.0})
+
+
+def test_causal_mask_like():
+    t = OpTest()
+    x = rng.randn(2, 5, 8).astype('float32')
+    got = t.run_op('causal_mask_like', {'X': x})['Out']
+    assert got.shape == (1, 1, 5, 5)
+    m = np.asarray(got)[0, 0]
+    iu = np.triu_indices(5, 1)
+    assert (m[iu] <= -1e8).all()
+    assert (np.tril(m) == 0).all()
+
+
+def test_sequence_reshape():
+    t = OpTest()
+    x = rng.randn(2, 6, 4).astype('float32')
+    got = t.run_op('sequence_reshape', {'X': x},
+                   attrs={'new_dim': 8})['Out']
+    np.testing.assert_allclose(np.asarray(got),
+                               x.reshape(2, 3, 8), rtol=1e-6)
+
+
+def test_interp_nearest():
+    t = OpTest()
+    x = rng.randn(1, 2, 4, 4).astype('float32')
+    got = t.run_op('interp_nearest', {'X': x},
+                   attrs={'out_h': 8, 'out_w': 8})['Out']
+    assert np.asarray(got).shape == (1, 2, 8, 8)
+    # nearest upscale by 2: every 2x2 block equals the source pixel
+    g = np.asarray(got)
+    np.testing.assert_allclose(g[:, :, ::2, ::2], x, rtol=1e-6)
+
+
+def test_random_crop():
+    t = OpTest()
+    x = np.arange(2 * 3 * 8 * 8, dtype='float32').reshape(2, 3, 8, 8)
+    got = np.asarray(t.run_op('random_crop', {'X': x},
+                              attrs={'shape': [5, 5]},
+                              out_slots=('Out', 'SeedOut'))['Out'])
+    assert got.shape == (2, 3, 5, 5)
+    # each sample's crop must be a contiguous window of the source
+    for b in range(2):
+        found = any(
+            np.array_equal(got[b], x[b, :, i:i + 5, j:j + 5])
+            for i in range(4) for j in range(4))
+        assert found, 'crop %d is not a window of the input' % b
+
+
+def test_truncated_gaussian_random():
+    t = OpTest()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = main.global_block().create_var(name='tgr', shape=(),
+                                             dtype='float32')
+        main.global_block().append_op(
+            'truncated_gaussian_random', inputs={},
+            outputs={'Out': out},
+            attrs={'shape': [2000], 'mean': 1.0, 'std': 0.5})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        got, = exe.run(main, feed={}, fetch_list=[out])
+    g = np.asarray(got)
+    assert g.shape == (2000,)
+    # truncation at 2 std
+    assert g.min() >= 1.0 - 2 * 0.5 - 1e-5
+    assert g.max() <= 1.0 + 2 * 0.5 + 1e-5
+    assert abs(g.mean() - 1.0) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# quantization helpers
+# ---------------------------------------------------------------------------
+
+def test_fake_dequantize_max_abs():
+    t = OpTest()
+    x = rng.randint(-127, 127, (3, 4)).astype('float32')
+    scale = np.array([0.5], 'float32')
+    t.check_output('fake_dequantize_max_abs',
+                   {'X': x, 'Scale': scale},
+                   attrs={'max_range': 127.0},
+                   expect={'Out': x * 0.5 / 127.0})
+
+
+def test_moving_average_abs_max_scale():
+    t = OpTest()
+    x = rng.randn(3, 4).astype('float32')
+    in_scale = np.array([0.8], 'float32')
+    got = t.run_op('moving_average_abs_max_scale',
+                   {'X': x, 'InScale': in_scale},
+                   attrs={'moving_rate': 0.9},
+                   out_slots=('Out', 'OutScale'))
+    np.testing.assert_allclose(got['Out'], x, rtol=1e-6)
+    want = 0.9 * 0.8 + 0.1 * np.abs(x).max()
+    np.testing.assert_allclose(got['OutScale'], [want], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update rules vs numpy reference math
+# (reference operators/optimizers/*_op.h formulas)
+# ---------------------------------------------------------------------------
+
+def _opt_inputs(shape=(4, 3)):
+    p = rng.randn(*shape).astype('float32')
+    g = rng.randn(*shape).astype('float32')
+    lr = np.array([0.1], 'float32')
+    return p, g, lr
+
+
+def test_adamw_rule():
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    m1 = rng.randn(4, 3).astype('float32') * 0.1
+    m2 = np.abs(rng.randn(4, 3)).astype('float32') * 0.1
+    b1p = np.array([0.9], 'float32')
+    b2p = np.array([0.999], 'float32')
+    got = t.run_op('adamw', {'Param': p, 'Grad': g, 'LearningRate': lr,
+                             'Moment1': m1, 'Moment2': m2,
+                             'Beta1Pow': b1p, 'Beta2Pow': b2p},
+                   attrs={'coeff': 0.01},
+                   out_slots=('ParamOut', 'Moment1Out', 'Moment2Out'))
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * np.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+    want = p - lr_t * m1n / (np.sqrt(m2n) + eps) - lr * 0.01 * p
+    np.testing.assert_allclose(got['ParamOut'], want, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(got['Moment1Out'], m1n, rtol=1e-6)
+
+
+def test_rmsprop_rule():
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    ms = np.abs(rng.randn(4, 3)).astype('float32')
+    mom = rng.randn(4, 3).astype('float32') * 0.1
+    got = t.run_op('rmsprop',
+                   {'Param': p, 'Grad': g, 'LearningRate': lr,
+                    'MeanSquare': ms, 'Moment': mom},
+                   attrs={'decay': 0.95, 'epsilon': 1e-6,
+                          'momentum': 0.9},
+                   out_slots=('ParamOut', 'MomentOut', 'MeanSquareOut'))
+    msn = 0.95 * ms + 0.05 * g * g
+    momn = 0.9 * mom + lr * g / np.sqrt(msn + 1e-6)
+    np.testing.assert_allclose(got['MeanSquareOut'], msn, rtol=1e-5)
+    np.testing.assert_allclose(got['MomentOut'], momn, rtol=1e-5)
+    np.testing.assert_allclose(got['ParamOut'], p - momn, rtol=1e-5)
+
+
+def test_rmsprop_centered_rule():
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    ms = np.abs(rng.randn(4, 3)).astype('float32')
+    mg = rng.randn(4, 3).astype('float32') * 0.1
+    mom = np.zeros((4, 3), 'float32')
+    got = t.run_op('rmsprop',
+                   {'Param': p, 'Grad': g, 'LearningRate': lr,
+                    'MeanSquare': ms, 'MeanGrad': mg, 'Moment': mom},
+                   attrs={'decay': 0.95, 'epsilon': 1e-6,
+                          'momentum': 0.0, 'centered': True},
+                   out_slots=('ParamOut', 'MeanGradOut'))
+    msn = 0.95 * ms + 0.05 * g * g
+    mgn = 0.95 * mg + 0.05 * g
+    momn = lr * g / np.sqrt(msn - mgn * mgn + 1e-6)
+    np.testing.assert_allclose(got['MeanGradOut'], mgn, rtol=1e-5)
+    np.testing.assert_allclose(got['ParamOut'], p - momn, rtol=1e-5)
+
+
+def test_ftrl_rule():
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    sq = np.abs(rng.randn(4, 3)).astype('float32')
+    lin = rng.randn(4, 3).astype('float32') * 0.1
+    l1, l2 = 0.1, 0.2
+    got = t.run_op('ftrl',
+                   {'Param': p, 'Grad': g, 'LearningRate': lr,
+                    'SquaredAccumulator': sq, 'LinearAccumulator': lin},
+                   attrs={'l1': l1, 'l2': l2, 'lr_power': -0.5},
+                   out_slots=('ParamOut', 'SquaredAccumOut',
+                              'LinearAccumOut'))
+    new_sq = sq + g * g
+    sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / lr
+    lin_out = lin + g - sigma * p
+    denom = np.sqrt(new_sq) / lr + 2 * l2
+    pre = np.clip(lin_out, -l1, l1) - lin_out
+    np.testing.assert_allclose(got['SquaredAccumOut'], new_sq,
+                               rtol=1e-5)
+    np.testing.assert_allclose(got['LinearAccumOut'], lin_out,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got['ParamOut'], pre / denom,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_lars_momentum_rule():
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    v = rng.randn(4, 3).astype('float32') * 0.1
+    got = t.run_op('lars_momentum',
+                   {'Param': p, 'Grad': g, 'LearningRate': lr,
+                    'Velocity': v},
+                   attrs={'mu': 0.9, 'lars_coeff': 0.001,
+                          'lars_weight_decay': 0.0005},
+                   out_slots=('ParamOut', 'VelocityOut'))
+    pn = np.sqrt((p ** 2).sum())
+    gn = np.sqrt((g ** 2).sum())
+    local_lr = lr * 0.001 * pn / (gn + 0.0005 * pn)
+    vn = 0.9 * v + local_lr * (g + 0.0005 * p)
+    np.testing.assert_allclose(got['VelocityOut'], vn, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(got['ParamOut'], p - vn, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_proximal_gd_rule():
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    got = t.run_op('proximal_gd',
+                   {'Param': p, 'Grad': g, 'LearningRate': lr},
+                   attrs={'l1': 0.05, 'l2': 0.1},
+                   out_slots=('ParamOut',))
+    prox = p - lr * g
+    want = (np.sign(prox) * np.maximum(np.abs(prox) - lr * 0.05, 0.0) /
+            (1.0 + lr * 0.1))
+    np.testing.assert_allclose(got['ParamOut'], want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_dpsgd_clips_gradient():
+    """sigma=0 isolates the clipping: update = lr * g * clip/||g||."""
+    t = OpTest()
+    p, g, lr = _opt_inputs()
+    g = g * 100  # make ||g|| >> clip
+    got = t.run_op('dpsgd', {'Param': p, 'Grad': g,
+                             'LearningRate': lr},
+                   attrs={'clip': 1.0, 'sigma': 0.0},
+                   out_slots=('ParamOut',))
+    gn = np.sqrt((g ** 2).sum())
+    want = p - lr * g / (gn / 1.0)
+    np.testing.assert_allclose(got['ParamOut'], want, rtol=1e-4,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# streaming AUC vs numpy
+# ---------------------------------------------------------------------------
+
+def test_auc_streaming():
+    t = OpTest()
+    n_thr = 255
+    preds = rng.rand(200, 2).astype('float32')
+    labels = (rng.rand(200) > 0.5).astype('int64').reshape(-1, 1)
+    stat = np.zeros((n_thr + 1,), 'int64')
+    got = t.run_op('auc', {'Predict': preds, 'Label': labels,
+                           'StatPos': stat, 'StatNeg': stat.copy()},
+                   attrs={'num_thresholds': n_thr},
+                   out_slots=('AUC', 'StatPosOut', 'StatNegOut'))
+    # numpy oracle: rank-sum AUC on the same bucketized scores
+    bucket = np.clip((preds[:, 1] * n_thr).astype(int), 0, n_thr)
+    pos = bucket[labels.ravel() > 0]
+    neg = bucket[labels.ravel() == 0]
+    wins = (pos[:, None] > neg[None, :]).sum() + \
+        0.5 * (pos[:, None] == neg[None, :]).sum()
+    want = wins / (len(pos) * len(neg))
+    np.testing.assert_allclose(float(np.asarray(got['AUC'])), want,
+                               atol=5e-3)
+    assert int(np.asarray(got['StatPosOut']).sum()) == len(pos)
+    assert int(np.asarray(got['StatNegOut']).sum()) == len(neg)
+
+
+# ---------------------------------------------------------------------------
+# detection ops
+# ---------------------------------------------------------------------------
+
+def test_bipartite_match():
+    t = OpTest()
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.2, 0.8, 0.2]], 'float32')
+    got = t.run_op('bipartite_match', {'DistMat': dist},
+                   out_slots=('ColToRowMatchIndices',
+                              'ColToRowMatchDist'))
+    np.testing.assert_array_equal(
+        np.asarray(got['ColToRowMatchIndices']), [[0, 1, -1]])
+    np.testing.assert_allclose(
+        np.asarray(got['ColToRowMatchDist']), [[0.9, 0.8, 0.0]])
+
+
+def test_box_decoder_and_assign():
+    t = OpTest()
+    prior = np.array([[0., 0., 4., 4.],
+                      [2., 2., 6., 6.]], 'float32')
+    n, c = 2, 3
+    deltas = np.zeros((n, 4 * c), 'float32')  # zero deltas: box=prior
+    scores = rng.rand(n, c + 1).astype('float32')
+    got = t.run_op('box_decoder_and_assign',
+                   {'PriorBox': prior, 'TargetBox': deltas,
+                    'BoxScore': scores},
+                   out_slots=('DecodeBox', 'OutputAssignBox'))
+    ab = np.asarray(got['OutputAssignBox'])
+    np.testing.assert_allclose(ab, prior, atol=1e-5)
+
+
+def test_generate_proposals_sane():
+    t = OpTest()
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype('float32')
+    deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype('float32')
+    im_info = np.array([[32., 32., 1.]], 'float32')
+    base = np.array([[0., 0., 8., 8.], [2., 2., 10., 10.],
+                     [4., 4., 12., 12.]], 'float32')
+    anchors = np.tile(base[None, None], (H, W, 1, 1)).astype('float32')
+    variances = np.ones_like(anchors) * 0.1
+    got = t.run_op('generate_proposals',
+                   {'Scores': scores, 'BboxDeltas': deltas,
+                    'ImInfo': im_info, 'Anchors': anchors,
+                    'Variances': variances},
+                   attrs={'pre_nms_topN': 20, 'post_nms_topN': 8,
+                          'nms_thresh': 0.7, 'min_size': 0.5},
+                   out_slots=('RpnRois', 'RpnRoiProbs'))
+    rois = np.asarray(got['RpnRois']).reshape(-1, 4)
+    assert (rois[:, 0] >= -1e-3).all() and (rois[:, 2] <= 32 + 1e-3).all()
+    probs = np.asarray(got['RpnRoiProbs']).ravel()
+    assert ((probs >= 0) & (probs <= 1)).all()
+
+
+def test_locality_aware_nms():
+    t = OpTest()
+    boxes = rng.rand(1, 5, 8).astype('float32') * 10
+    scores = rng.rand(1, 1, 5).astype('float32')
+    got = t.run_op('locality_aware_nms',
+                   {'BBoxes': boxes, 'Scores': scores},
+                   attrs={'keep_top_k': 3},
+                   out_slots=('Out',))
+    out = np.asarray(got['Out'])
+    assert out.shape == (3, 6)
+    # rows sorted by descending score
+    assert (np.diff(out[:, 1]) <= 1e-6).all()
+
+
+def test_retinanet_target_assign():
+    t = OpTest()
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [0, 0, 9, 9]], 'float32')
+    gt = np.array([[0, 0, 10, 10]], 'float32')
+    got = t.run_op('retinanet_target_assign',
+                   {'Anchor': anchors, 'GtBoxes': gt},
+                   attrs={'rpn_positive_overlap': 0.7,
+                          'rpn_negative_overlap': 0.3},
+                   out_slots=('LocationIndex', 'ScoreIndex',
+                              'TargetLabel', 'TargetBBox'))
+    loc = np.asarray(got['LocationIndex']).ravel()
+    assert 0 in loc  # the exact-match anchor is foreground
+    lab = np.asarray(got['TargetLabel']).ravel()
+    assert set(lab.tolist()) <= {0, 1}
+
+
+def test_generate_proposal_labels_and_masks():
+    t = OpTest()
+    rois = np.array([[0, 0, 10, 10], [20, 20, 28, 28]], 'float32')
+    gt_cls = np.array([2], 'int64')
+    gt_box = np.array([[0, 0, 10, 10]], 'float32')
+    got = t.run_op('generate_proposal_labels',
+                   {'RpnRois': rois, 'GtClasses': gt_cls,
+                    'GtBoxes': gt_box},
+                   attrs={'batch_size_per_im': 4, 'fg_thresh': 0.5},
+                   out_slots=('Rois', 'LabelsInt32', 'BboxTargets'))
+    labels = np.asarray(got['LabelsInt32']).ravel()
+    assert 2 in labels  # the matching roi gets the gt class
+    out_rois = np.asarray(got['Rois'])
+    got2 = t.run_op('generate_mask_labels', {'Rois': out_rois},
+                    attrs={'resolution': 7},
+                    out_slots=('MaskRois', 'RoiHasMaskInt32',
+                               'MaskInt32'))
+    assert np.asarray(got2['MaskInt32']).shape == (len(out_rois), 49)
+
+
+def test_roi_perspective_transform():
+    t = OpTest()
+    x = np.arange(1 * 1 * 8 * 8, dtype='float32').reshape(1, 1, 8, 8)
+    rois = np.array([[1, 1, 5, 1, 5, 5, 1, 5]], 'float32')  # quad
+    got = t.run_op('roi_perspective_transform',
+                   {'X': x, 'ROIs': rois},
+                   attrs={'transformed_height': 4,
+                          'transformed_width': 4},
+                   out_slots=('Out',))
+    out = np.asarray(got['Out'])
+    assert out.shape == (1, 1, 4, 4)
+    # values come from the roi's window of the source
+    assert out.min() >= x[0, 0, 1:6, 1:6].min() - 1e-5
+    assert out.max() <= x[0, 0, 1:6, 1:6].max() + 1e-5
+
+
+# ---------------------------------------------------------------------------
+# save/load_combine
+# ---------------------------------------------------------------------------
+
+def test_save_load_combine_roundtrip(tmp_path):
+    path = str(tmp_path / 'combined')
+    a = rng.randn(3, 4).astype('float32')
+    b = rng.randn(2,).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        av = main.global_block().create_var(name='cv_a', shape=(3, 4),
+                                            dtype='float32')
+        bv = main.global_block().create_var(name='cv_b', shape=(2,),
+                                            dtype='float32')
+        main.global_block().append_op(
+            'save_combine', inputs={'X': [av, bv]}, outputs={},
+            attrs={'file_path': path})
+    load_prog = fluid.Program()
+    with fluid.program_guard(load_prog, fluid.Program()):
+        a2 = load_prog.global_block().create_var(
+            name='cv_a', shape=(3, 4), dtype='float32')
+        b2 = load_prog.global_block().create_var(
+            name='cv_b', shape=(2,), dtype='float32')
+        load_prog.global_block().append_op(
+            'load_combine', inputs={}, outputs={'Out': [a2, b2]},
+            attrs={'file_path': path})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(main, feed={'cv_a': a, 'cv_b': b}, fetch_list=[])
+        got_a, got_b = exe.run(load_prog, feed={},
+                               fetch_list=['cv_a', 'cv_b'])
+    np.testing.assert_allclose(got_a, a, rtol=1e-6)
+    np.testing.assert_allclose(got_b, b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# collective variants inside shard_map (8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+def test_collective_variant_ops():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.ops import registry
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ('dp',))
+    n = len(devs)
+    x = (rng.rand(n, 4).astype('float32') + 0.5)
+
+    def body(xs):
+        ctx = registry.LowerCtx(0)
+
+        def run(name, val, **attrs):
+            return registry.get(name).fn(
+                ctx, {'X': [val]},
+                dict({'ring_id': 0}, **attrs))['Out'][0]
+        mn = run('c_allreduce_min', xs)
+        pr = run('c_allreduce_prod', xs)
+        mp_sum = run('mp_allreduce_sum', xs)
+        ident = run('c_identity', xs)
+        cat = run('c_concat', xs)               # [1, n*4]
+        sc1 = run('c_sync_calc_stream', xs)
+        sp = run('c_split', cat, nranks=n)      # undo the concat
+        return mn, pr, mp_sum, ident, cat, sc1, sp
+
+    f = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P('dp'),),
+        out_specs=(P(), P(), P(), P('dp'), P('dp'), P('dp'),
+                   P('dp')),
+        check_vma=False))
+    mn, pr, mp_sum, ident, cat, sc1, sp = f(x)
+    np.testing.assert_allclose(np.asarray(mn).reshape(4), x.min(0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pr).reshape(4), x.prod(0),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(mp_sum).reshape(4), x.sum(0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ident), x, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sc1), x, rtol=1e-6)
+    # c_concat: all_gather along last dim -> every shard sees all cols
+    np.testing.assert_allclose(
+        np.asarray(cat), np.tile(x.reshape(1, -1), (n, 1)), rtol=1e-6)
+    # c_split of the gathered tensor gives back each shard's slice
+    np.testing.assert_allclose(np.asarray(sp), x, rtol=1e-6)
+
+
+def test_c_reducescatter():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.ops import registry
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ('dp',))
+    n = len(devs)
+    # each shard holds a local [n, 3] block; reduce-scatter leaves
+    # every shard with its [1, 3] slice of the cross-shard sum
+    x = rng.rand(n * n, 3).astype('float32')
+
+    def body(xs):
+        ctx = registry.LowerCtx(0)
+        return registry.get('c_reducescatter').fn(
+            ctx, {'X': [xs]}, {'ring_id': 0})['Out'][0]
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P('dp'),),
+                              out_specs=P('dp'), check_vma=False))
+    got = np.asarray(f(x))
+    want = x.reshape(n, n, 3).sum(0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_collective_init_ops_are_noops():
+    main, startup = fluid.Program(), fluid.Program()
+    x = rng.randn(2, 3).astype('float32')
+    with fluid.program_guard(main, startup):
+        xv = main.global_block().create_var(name='x', shape=(2, 3),
+                                            dtype='float32')
+        out = main.global_block().create_var(name='ci_out', shape=(),
+                                             dtype='float32')
+        for t in ('c_comm_init_all', 'c_gen_nccl_id', 'c_comm_init'):
+            main.global_block().append_op(t, inputs={}, outputs={},
+                                          attrs={})
+        main.global_block().append_op('scale', inputs={'X': xv},
+                                      outputs={'Out': out},
+                                      attrs={'scale': 2.0})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        got, = exe.run(main, feed={'x': x}, fetch_list=[out])
+    np.testing.assert_allclose(got, x * 2, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# BoxPS / distributed sparse-table host ops
+# ---------------------------------------------------------------------------
+
+def test_box_sparse_and_distributed_lookup():
+    from paddle_tpu.parallel.sparse_embedding import HostShardedEmbedding
+    emb = HostShardedEmbedding('sweep3_box_emb', 50, 4, optimizer='sgd',
+                               learning_rate=0.5, distributed=False)
+    ids = np.array([3, 7, 3], 'int64')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        iv = blk.create_var(name='ids', shape=(3,), dtype='int64')
+        ov = blk.create_var(name='emb_out', shape=(), dtype='float32')
+        blk.append_op('pull_box_sparse', inputs={'Ids': [iv]},
+                      outputs={'Out': [ov]},
+                      attrs={'table': 'sweep3_box_emb'})
+        o2 = blk.create_var(name='emb_out2', shape=(), dtype='float32')
+        blk.append_op('distributed_lookup_table',
+                      inputs={'Ids': [iv]}, outputs={'Outputs': [o2]},
+                      attrs={'table': 'sweep3_box_emb'})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        pulled, pulled2 = exe.run(main, feed={'ids': ids},
+                                  fetch_list=[ov, o2])
+    want = emb._pull(ids)
+    np.testing.assert_allclose(np.asarray(pulled), want, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pulled2), want, rtol=1e-6)
+
+    # push: rows 3 and 7 move against the summed grads, others don't
+    before = emb._pull(np.arange(50, dtype='int64')).copy()
+    grad = np.ones((3, 4), 'float32')
+    push_main = fluid.Program()
+    with fluid.program_guard(push_main, fluid.Program()):
+        blk = push_main.global_block()
+        iv = blk.create_var(name='ids', shape=(3,), dtype='int64')
+        gv = blk.create_var(name='emb_g', shape=(3, 4),
+                            dtype='float32')
+        blk.append_op('push_box_sparse',
+                      inputs={'Ids': [iv], 'Out@GRAD': [gv]},
+                      outputs={},
+                      attrs={'table': 'sweep3_box_emb'})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(push_main, feed={'ids': ids, 'emb_g': grad},
+                fetch_list=[])
+    after = emb._pull(np.arange(50, dtype='int64'))
+    assert not np.allclose(after[3], before[3])
+    assert not np.allclose(after[7], before[7])
+    mask = np.ones(50, bool)
+    mask[[3, 7]] = False
+    np.testing.assert_allclose(after[mask], before[mask])
+
+
+def test_get_tensor_from_selected_rows():
+    sr = core.SelectedRows(rows=np.array([1, 3], 'int64'),
+                           value=rng.randn(2, 4).astype('float32'),
+                           height=6)
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        blk = main.global_block()
+        xv = blk.create_var(name='sr_in', shape=(), dtype='float32')
+        ov = blk.create_var(name='sr_out', shape=(), dtype='float32')
+        blk.append_op('get_tensor_from_selected_rows',
+                      inputs={'X': xv}, outputs={'Out': ov}, attrs={})
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        scope.set_var('sr_in', sr)
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        got, = exe.run(main, feed={}, fetch_list=[ov])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(sr.value),
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused elementwise + activation
+# ---------------------------------------------------------------------------
+
+def test_fused_elemwise_activation():
+    t = OpTest()
+    x = rng.randn(3, 4).astype('float32')
+    y = rng.randn(3, 4).astype('float32')
+    got = t.run_op('fused_elemwise_activation', {'X': x, 'Y': y},
+                   attrs={'functor_list': ['elementwise_add', 'relu']},
+                   out_slots=('Out', 'IntermediateOut'))
+    np.testing.assert_allclose(got['Out'], np.maximum(x + y, 0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(got['IntermediateOut'], x + y,
+                               rtol=1e-6)
+
+
+def test_split_byref_matches_split():
+    t = OpTest()
+    x = rng.randn(4, 6).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        xv = blk.create_var(name='x', shape=(4, 6), dtype='float32')
+        outs = [blk.create_var(name='sb_%d' % i, shape=(4, 2),
+                               dtype='float32') for i in range(3)]
+        blk.append_op('split_byref', inputs={'X': xv},
+                      outputs={'Out': outs},
+                      attrs={'num': 3, 'axis': 1})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        got = exe.run(main, feed={'x': x}, fetch_list=list(outs))
+    for i in range(3):
+        np.testing.assert_allclose(got[i], x[:, 2 * i:2 * i + 2],
+                                   rtol=1e-6)
+
+
+def test_continuous_value_model_aliases_cvm():
+    t = OpTest()
+    # cvm input convention: [N, D] with first two cols show/click
+    x = np.abs(rng.randn(4, 6)).astype('float32') + 1.0
+    from paddle_tpu.ops import registry as _reg
+    ctx = _reg.LowerCtx(0)
+    want = _reg.get('cvm').fn(ctx, {'X': [x]}, {'use_cvm': True})
+    got = t.run_op('continuous_value_model', {'X': x},
+                   attrs={'use_cvm': True}, out_slots=('Y',))
+    np.testing.assert_allclose(np.asarray(got['Y']),
+                               np.asarray(want['Y'][0]), rtol=1e-6)
+
+
+def test_c_sync_comm_stream_passthrough():
+    from paddle_tpu.ops import registry as _reg
+    ctx = _reg.LowerCtx(0)
+    xs = [rng.randn(2, 2).astype('float32'),
+          rng.randn(3,).astype('float32')]
+    out = _reg.get('c_sync_comm_stream').fn(ctx, {'X': xs}, {})['Out']
+    for o, x in zip(out, xs):
+        np.testing.assert_allclose(np.asarray(o), x, rtol=1e-6)
