@@ -354,6 +354,48 @@ def _op_error_context(op, ins):
     return '\n'.join(lines)
 
 
+def _feed_mismatch_note(program, feed):
+    """Diagnostic for segment failures: list feeds whose shapes diverge
+    from their declared layers.data specs.  Declared shapes are
+    ADVISORY in fluid (the bucketing front-end legitimately feeds
+    re-bucketed dims and the executor re-traces per shape), so
+    divergence is not an error by itself — but when a segment fails
+    with a raw XLA shape error, the diverging feed is almost always
+    the cause, and naming it turns a dot_general dump into a usable
+    message (reference: data_feeder/enforce discipline)."""
+    block = program.global_block()
+    lines = []
+    for name, val in sorted(feed.items()):
+        var = block._find_var_recursive(name)
+        if var is None or getattr(var, 'lod_level', 0):
+            continue
+        spec = getattr(var, 'shape', None)
+        if isinstance(val, core.LoDTensor):
+            val = val.data
+        try:
+            arr_shape = np.shape(val)
+        except Exception:
+            arr_shape = None
+        if not spec or arr_shape is None or arr_shape == ():
+            continue
+        spec = tuple(int(s) for s in spec)
+        ok = len(arr_shape) == len(spec) and all(
+            s < 0 or s == d for s, d in zip(spec, arr_shape))
+        if not ok and len(arr_shape) == len(spec) - 1 and \
+                spec[-1] == 1:
+            # label convention: [N] feeding a [-1, 1] var
+            ok = all(s < 0 or s == d
+                     for s, d in zip(spec[:-1], arr_shape))
+        if not ok:
+            lines.append("  feed '%s': shape %s, declared %s"
+                         % (name, tuple(arr_shape), spec))
+    if lines:
+        return ('feeds diverging from their declared shapes (-1 dims '
+                'accept any size; a diverging feed is the usual cause '
+                'of XLA shape errors):\n' + '\n'.join(lines))
+    return None
+
+
 def _make_segment_fn(segment, prefer_test=False):
     ops = segment.ops
     output_names = list(segment.output_names)
@@ -809,8 +851,14 @@ class Executor(object):
             state[n] = v
         data = {n: self._lookup_input(n, feed, scope)
                 for n in seg.input_names}
-        with jax.default_device(device):
-            out = compiled(self._step, state, data)
+        try:
+            with jax.default_device(device):
+                out = compiled(self._step, state, data)
+        except Exception as e:
+            note = _feed_mismatch_note(seg.ops[0].block.program, feed)
+            if note:
+                _add_note(e, note)
+            raise
         if get_flag('FLAGS_check_nan_inf'):
             # reference: CheckVarHasNanOrInf per-op sweep
             # (framework/details/nan_inf_utils.h:28) — here per segment

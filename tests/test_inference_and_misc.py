@@ -45,6 +45,33 @@ def test_predictor_roundtrip(tmp_path):
                                atol=1e-6)
 
 
+def test_feed_shape_mismatch_is_named_in_error():
+    """When a mis-shaped feed makes a segment fail, the error must name
+    the diverging feed and its declared spec (PEP 678 note), not just
+    dump a raw XLA shape error (reference: data_feeder/enforce)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        try:
+            exe.run(main, feed={'x': np.zeros((3, 5), 'float32')},
+                    fetch_list=[y])
+            raise AssertionError('mis-shaped feed did not fail')
+        except AssertionError:
+            raise
+        except Exception as e:
+            notes = '\n'.join(getattr(e, '__notes__', []))
+            assert "feed 'x': shape (3, 5), declared (-1, 4)" in notes, \
+                notes
+        # -1 batch dim accepts any size
+        out, = exe.run(main, feed={'x': np.zeros((7, 4), 'float32')},
+                       fetch_list=[y])
+        assert np.asarray(out).shape == (7, 2)
+
+
 def test_segment_auto_layout_flag():
     """FLAGS_segment_auto_layout=1 compiles executor segments with
     XLA-chosen boundary layouts (jax.experimental.layout AUTO) —

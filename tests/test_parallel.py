@@ -157,3 +157,15 @@ def test_moe_differentiable():
         assert np.isfinite(np.asarray(g)).all()
     assert float(jnp.abs(g1).sum()) > 0
     assert float(jnp.abs(gg).sum()) > 0
+
+
+def test_3d_pipeline_tp_dp_composition():
+    """The classic 3D composition — GPipe over 'pp', Megatron TP inside
+    each stage over 'mp', batch over 'dp' — trains and matches the
+    single-host numpy oracle (asserted inside _dryrun_3d)."""
+    import sys
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as graft
+    graft._dryrun_3d(8)
