@@ -524,30 +524,49 @@ class Executor(object):
         plan = self._get_plan(program, tuple(sorted(feed_names)),
                               tuple(fetch_names), prefer_test)
         segs = [it for it in plan if isinstance(it, _Segment)]
+
+        def _pipeline():
+            known_out = set()
+            known_in = set()
+            for it in plan:
+                if isinstance(it, _Segment):
+                    known_out.update(it.output_names)
+                    known_in.update(it.input_names)
+                    known_in.update(it.state_names)
+                else:
+                    known_out.update(_op_writes(it[1]))
+                    known_in.update(_op_reads(it[1]))
+            missing = [n for n in fetch_names if n not in known_out]
+            if missing:
+                raise ValueError(
+                    'fetch vars %r are not produced by the program'
+                    % (missing,))
+            bogus = [n for n in feed_names if n not in known_in]
+            if bogus:
+                raise ValueError(
+                    'feed names %r are not read by the program'
+                    % (bogus,))
+            return CompiledPipeline(self, program, plan, feed_names,
+                                    fetch_names)
+
+        # programs carrying per-step host hooks (async-PS push/pull,
+        # k-step LocalSGD sync) cannot be a pure step even when they
+        # lower to one device segment — the hooks ARE the training
+        # semantics (reference: Communicator send queues,
+        # operators/distributed/communicator.h:175)
+        hooked = bool(getattr(program, '_ps_async', None) or
+                      getattr(program, '_local_sgd', None))
+        if hooked and not prefer_test:
+            if not allow_host:
+                raise ValueError(
+                    'this program has per-step host hooks (async-PS '
+                    'communicator / LocalSGD) and cannot compile to a '
+                    'pure step — pass allow_host=True for a '
+                    'CompiledPipeline, or run it with Executor.run')
+            return _pipeline()
         if len(segs) != 1 or len(plan) != 1:
             if allow_host:
-                known_out = set()
-                known_in = set()
-                for it in plan:
-                    if isinstance(it, _Segment):
-                        known_out.update(it.output_names)
-                        known_in.update(it.input_names)
-                        known_in.update(it.state_names)
-                    else:
-                        known_out.update(_op_writes(it[1]))
-                        known_in.update(_op_reads(it[1]))
-                missing = [n for n in fetch_names if n not in known_out]
-                if missing:
-                    raise ValueError(
-                        'fetch vars %r are not produced by the program'
-                        % (missing,))
-                bogus = [n for n in feed_names if n not in known_in]
-                if bogus:
-                    raise ValueError(
-                        'feed names %r are not read by the program'
-                        % (bogus,))
-                return CompiledPipeline(self, program, plan,
-                                        feed_names, fetch_names)
+                return _pipeline()
             cuts = [it for it in plan if not isinstance(it, _Segment)]
             why = []
             host = [it[1].type for it in cuts if it[0] == 'host']

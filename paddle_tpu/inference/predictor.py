@@ -58,6 +58,19 @@ class AnalysisPredictor(object):
                     config.model_dir, self._exe,
                     model_filename=config.model_filename,
                     params_filename=config.params_filename)
+        # the load ops stored params as host numpy; pin them to the
+        # device ONCE or every run() re-uploads the full weight set
+        # (params are pure inputs here — inference never writes them
+        # back as device arrays the way a train step does).  The
+        # reference does the same sync-params-to-device analysis pass
+        # (inference/analysis/passes/ir_params_sync_among_devices_pass).
+        if config._use_xla:
+            import jax
+            dev = self._exe.place.jax_device()
+            for name in list(self._scope._vars):
+                val = self._scope._vars[name]
+                if isinstance(val, np.ndarray):
+                    self._scope.set_var(name, jax.device_put(val, dev))
 
     # -- zero-copy style API ---------------------------------------------
     def get_input_names(self):
@@ -66,10 +79,14 @@ class AnalysisPredictor(object):
     def get_output_names(self):
         return [v.name for v in self._fetch_vars]
 
-    def run_dict(self, feed):
+    def run_dict(self, feed, return_numpy=True):
+        """return_numpy=False keeps outputs as device arrays — the
+        dispatch stays asynchronous, so a caller pipelining requests
+        does not pay a blocking device->host fetch per call."""
         with core.scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_vars)
+                                 fetch_list=self._fetch_vars,
+                                 return_numpy=return_numpy)
         return outs
 
     def run(self, inputs):

@@ -137,3 +137,45 @@ def test_hdfs_client_without_hadoop_errors_clearly(monkeypatch):
     import pytest as _pytest
     with _pytest.raises(ExecuteError, match='no hadoop client'):
         c.ls('hdfs://x/y')
+
+
+def test_async_ps_through_compiled_pipeline():
+    """CompiledPipeline must run the async-PS post-step hooks (grad
+    push / param pull) exactly like Executor.run — training through
+    the pipeline converges the same way."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(fluid.layers.fc(x, 16, act='relu'), 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    config = DistributeTranspilerConfig()
+    config.sync_mode = False
+    with fluid.program_guard(main, startup):
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.05),
+                                          config)
+        opt.minimize(loss)
+    assert getattr(main, '_ps_async', None)
+
+    fleet.run_server()
+    fleet.init_worker()
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 1).astype('float32')
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        pipe = exe.compile(main, feed_names=('x', 'y'),
+                           fetch_names=(loss.name,), allow_host=True)
+        for i in range(60):
+            xb = rng.randn(32, 8).astype('float32')
+            l, = pipe({'x': xb, 'y': xb @ w}, scope=scope)
+            losses.append(float(np.asarray(l).ravel()[0]))
+    fleet.stop_worker()
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, (
+        losses[:5], losses[-5:])

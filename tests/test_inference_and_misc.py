@@ -43,6 +43,15 @@ def test_predictor_roundtrip(tmp_path):
     outs = predictor.run([PaddleTensor(xs)])
     np.testing.assert_allclose(outs[0].as_ndarray(), expect, rtol=1e-5,
                                atol=1e-6)
+    # params were pinned to the device at load (one upload, not one
+    # per call), and the async serving path returns device arrays
+    import jax
+    assert any(isinstance(v, jax.Array)
+               for v in predictor._scope._vars.values())
+    out2, = predictor.run_dict({'x': xs}, return_numpy=False)
+    assert isinstance(out2, jax.Array)
+    np.testing.assert_allclose(np.asarray(out2), expect, rtol=1e-5,
+                               atol=1e-6)
 
 
 def test_feed_shape_mismatch_is_named_in_error():

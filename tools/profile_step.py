@@ -36,8 +36,22 @@ def main():
     fn = getattr(bench, 'bench_' + name)
     logdir = '/tmp/paddle_tpu_profile_step'
     os.system('rm -rf %s' % logdir)
-    with jax.profiler.trace(logdir):
-        result = fn(**kwargs)
+    # the trace hook in bench._timed_steps covers ONLY the steady-state
+    # loop — wrapping the whole call (incl. compile) floods the 1M
+    # host-event cap and the device plane is dropped.  Workloads with
+    # their own timing loop (resnet50, resnet_infer) take the full
+    # wrap; decided upfront so nothing runs twice.
+    import inspect
+    uses_hook = '_timed_steps' in inspect.getsource(fn)
+    if uses_hook:
+        bench.TRACE_LOGDIR = logdir
+        try:
+            result = fn(**kwargs)
+        finally:
+            bench.TRACE_LOGDIR = None
+    else:
+        with jax.profiler.trace(logdir):
+            result = fn(**kwargs)
     print(result)
     import inspect
     default_steps = inspect.signature(fn).parameters['steps'].default
