@@ -8,6 +8,7 @@ from . import lenet
 from . import resnet
 from . import se_resnext
 from . import bert
+from . import gpt
 from . import transformer
 from . import wide_deep
 from . import word2vec

@@ -45,13 +45,16 @@ TINY = BertConfig(vocab_size=1000, hidden=64, layers=2, heads=4,
                   intermediate=128, max_pos=128)
 
 
-def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None):
+def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None,
+                         causal=False):
     """Self-attention: fused QKV projection -> scaled dot product ->
     output projection.  When the config allows it (no attention-probs
     dropout needed) the scaled-dot-product chain runs as ONE Pallas
     flash-attention kernel fwd+bwd (ops/pallas/flash_attention.py) —
     the reference's multihead_matmul fusion
-    (operators/fused/multihead_matmul_op.cu), TPU-style."""
+    (operators/fused/multihead_matmul_op.cu), TPU-style.  causal=True
+    masks future positions (decoder-only LMs): the flash kernel takes
+    it natively, the naive chain adds a causal_mask_like bias."""
     h, heads = cfg.hidden, cfg.heads
     d = h // heads
     qkv = layers.fc(x, size=3 * h, num_flatten_dims=2)
@@ -80,7 +83,8 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None):
             inputs['KeyBias'] = key_bias
         helper.append_op('fused_multihead_attention', inputs=inputs,
                          outputs={'Out': out},
-                         attrs={'causal': False}, infer_shape=False)
+                         attrs={'causal': bool(causal)},
+                         infer_shape=False)
         out.shape = tuple(q3.shape)
         ctx = layers.reshape(out, [0, 0, h])
         return layers.fc(ctx, size=h, num_flatten_dims=2)
@@ -91,6 +95,10 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None):
 
     q, k, v = to_heads(q), to_heads(k), to_heads(v)
     scores = layers.matmul(q, k, transpose_y=True, alpha=d ** -0.5)
+    if causal:
+        from .transformer import _causal_bias
+        scores = layers.elementwise_add(
+            scores, _causal_bias(x, x.shape[1] or -1))
     if attn_bias is not None:
         scores = layers.elementwise_add(scores, attn_bias)
     probs = layers.softmax(scores)

@@ -1,0 +1,119 @@
+"""Dynamic loss-scaling semantics of the AMP decorator.
+
+Reference behavior (contrib/mixed_precision/decorator.py:27 +
+operators/amp/update_loss_scaling_op.cc, check_finite_and_unscale_op.cc):
+an overflowing step SKIPS the parameter update and decays the loss
+scale after decr_every_n_nan_or_inf bad steps; incr_every_n_steps
+consecutive good steps grow it by incr_ratio; master weights stay f32.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _build(incr_every=3, decr_every=1, init_scale=2.0 ** 10):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(0.01),
+            init_loss_scaling=init_scale,
+            use_dynamic_loss_scaling=True,
+            incr_every_n_steps=incr_every,
+            decr_every_n_nan_or_inf=decr_every,
+            incr_ratio=2.0, decr_ratio=0.5)
+        opt.minimize(loss)
+        scale_var = opt.get_loss_scaling()
+    return main, startup, loss, scale_var
+
+
+def test_overflow_skips_update_and_decays_scale():
+    main, startup, loss, scale_var = _build()
+    rng = np.random.RandomState(0)
+    xb = rng.randn(16, 4).astype('float32')
+    yb = rng.randn(16, 1).astype('float32')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        pname = main.all_parameters()[0].name
+        # one healthy step: params move, scale unchanged (incr_every=3)
+        p0 = np.asarray(scope.find_var(pname)).copy()
+        exe.run(main, feed={'x': xb, 'y': yb}, fetch_list=[])
+        p1 = np.asarray(scope.find_var(pname)).copy()
+        s1 = float(np.asarray(scope.find_var(scale_var.name)).ravel()[0])
+        assert not np.allclose(p0, p1)
+        assert s1 == 2.0 ** 10
+        # overflow step: huge feed makes grads non-finite at this scale
+        exe.run(main, feed={'x': xb * 1e30, 'y': yb},
+                fetch_list=[])
+        p2 = np.asarray(scope.find_var(pname)).copy()
+        s2 = float(np.asarray(scope.find_var(scale_var.name)).ravel()[0])
+        np.testing.assert_allclose(p2, p1, rtol=0,
+                                   err_msg='overflow step must skip '
+                                           'the parameter update')
+        assert s2 == 2.0 ** 9, s2  # decayed by decr_ratio after 1 bad
+        # params stay f32 master copies
+        assert np.asarray(scope.find_var(pname)).dtype == np.float32
+
+
+def test_scale_grows_after_n_good_steps():
+    main, startup, loss, scale_var = _build(incr_every=3)
+    rng = np.random.RandomState(1)
+    xb = rng.randn(16, 4).astype('float32')
+    yb = rng.randn(16, 1).astype('float32')
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        scales = []
+        for _ in range(7):
+            exe.run(main, feed={'x': xb, 'y': yb}, fetch_list=[])
+            scales.append(float(np.asarray(
+                scope.find_var(scale_var.name)).ravel()[0]))
+    # after every 3 consecutive good steps the scale doubles
+    assert scales[2] == 2.0 ** 11, scales
+    assert scales[5] == 2.0 ** 12, scales
+    assert scales[0] == scales[1] == 2.0 ** 10, scales
+
+
+def test_amp_training_converges_with_bf16_compute():
+    """bf16 MXU compute + f32 masters trains to the same answer as
+    full-f32 within loose tolerance."""
+    def train(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[8], dtype='float32')
+            y = layers.data('y', shape=[1], dtype='float32')
+            pred = layers.fc(layers.fc(x, 16, act='relu'), 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.SGD(0.05)
+            if amp:
+                opt = fluid.contrib.mixed_precision.decorate(
+                    opt, use_dynamic_loss_scaling=True)
+            opt.minimize(loss)
+        rng = np.random.RandomState(3)
+        w = rng.randn(8, 1).astype('float32')
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            for i in range(80):
+                xb = rng.randn(32, 8).astype('float32')
+                l, = exe.run(main, feed={'x': xb, 'y': xb @ w},
+                             fetch_list=[loss])
+        return float(np.asarray(l).ravel()[0])
+
+    ref = train(False)
+    amp = train(True)
+    # bf16 mantissa (8 bits) slows the tail slightly; the loss must
+    # still be near-converged and track the f32 run
+    assert amp < 0.25, amp
+    assert abs(amp - ref) < 0.15, (amp, ref)

@@ -105,3 +105,73 @@ def test_resnet50_builds():
     # 53 convs + 53 BN(scale+bias) + fc(w+b) and BN means/vars are
     # parameters too in this design
     assert n_params > 150, n_params
+
+
+def test_gpt_lm_learns_pattern_and_generates():
+    """Decoder-only causal LM (models/gpt.py): trains on a deterministic
+    +3 (mod V) token sequence, loss collapses, and greedy decoding
+    continues the pattern — exercising causal attention masks through
+    training AND the host-driven generation loop."""
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GptConfig(vocab_size=23, hidden=32, layers=2, heads=4,
+                        max_pos=16, dropout=0.0)
+    seq = 16
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        feeds, logits, loss = gpt.build_lm(cfg, seq)
+        infer_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(3e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def batch(n=32):
+        starts = rng.randint(0, cfg.vocab_size, (n, 1))
+        ids = (starts + 3 * np.arange(seq)) % cfg.vocab_size
+        return gpt.lm_batch(ids)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for _ in range(120):
+            l, = exe.run(main, feed=batch(), fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < 0.1, (losses[0], losses[-1])
+
+        toks = gpt.greedy_generate(exe, infer_prog, logits, [5, 8, 11],
+                                   steps=6, cfg=cfg)
+    want = [(5 + 3 * i) % cfg.vocab_size for i in range(9)]
+    assert toks == want, (toks, want)
+
+
+def test_gpt_flash_path_matches_naive():
+    """The causal flash dispatch (seq >= flash_min_len) produces the
+    same logits as the naive masked chain — model-level wiring check
+    for fused_multihead_attention(causal=True)."""
+    from paddle_tpu.models import gpt
+
+    def logits_with(use_flash):
+        cfg = gpt.GptConfig(vocab_size=31, hidden=32, layers=1,
+                            heads=4, max_pos=32, dropout=0.0,
+                            use_flash=use_flash)
+        cfg.flash_min_len = 16   # force the flash path at seq 32
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 23
+        with fluid.program_guard(main, startup):
+            feeds, logits, loss = gpt.build_lm(cfg, 32, is_test=True)
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 31, (2, 32))
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            out, = exe.run(main, feed=gpt.lm_batch(ids),
+                           fetch_list=[logits])
+        return np.asarray(out)
+
+    naive = logits_with(False)
+    flash = logits_with(True)
+    np.testing.assert_allclose(flash, naive, rtol=2e-3, atol=2e-3)

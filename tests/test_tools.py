@@ -28,3 +28,9 @@ def test_api_coverage_complete():
     p = _run('check_api_coverage.py')
     assert p.returncode == 0, p.stdout + p.stderr
     assert '(100.0%)' in p.stdout
+
+
+def test_every_op_is_test_referenced():
+    p = _run('check_test_coverage.py')
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert 'every registered op is referenced' in p.stdout
