@@ -348,42 +348,81 @@ def bench_lenet(batch=512, steps=30):
             'value': round(batch / dt, 1), 'unit': 'images/sec'}
 
 
+# --all entries: (name, config variants tried in order).  The second
+# variant is a near-equivalent config with a DIFFERENT XLA program
+# fingerprint — observed failure mode on the tunnel service: one
+# poisoned fingerprint hangs its compile RPC forever while every other
+# program is fine, so a one-off variant recovers the metric.
+ALL_BENCHES = (
+    ('lenet', ({}, {'batch': 500})),
+    ('bert', ({},)),
+    ('bert_long', ({},)),
+    ('wide_deep', ({}, {'batch': 2000})),
+    ('wide_deep_sparse', ({},)),
+    ('host_sparse_push', ({},)),
+    ('rpc_sparse_push', ({},)),
+    ('transformer', ({},)),
+    ('resnet_infer', ({}, {'batch': 64})),
+)
+
+
+def _run_entry(name, kwargs, timeout=900):
+    """Run one bench entry in a child process under a deadline and
+    print its JSON line.  A wedged device RPC (the tunnel compile
+    service can hang on one program fingerprint) costs this attempt,
+    not the whole sweep.  Returns True on success."""
+    import subprocess
+    try:
+        p = subprocess.run(
+            [sys.executable, '-u', os.path.abspath(__file__),
+             '--one', name, json.dumps(kwargs)],
+            capture_output=True, text=True, timeout=timeout)
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith('{')]
+        if p.returncode == 0 and line:
+            print(line[-1])
+            return True
+        sys.stderr.write('%s %s failed (rc=%d): %s\n'
+                         % (name, kwargs or '', p.returncode,
+                            p.stderr[-300:]))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write('%s %s timed out after %ds (wedged device '
+                         'RPC?)\n' % (name, kwargs or '', timeout))
+    return False
+
+
 def main():
     _enable_compile_cache()
+    if len(sys.argv) > 2 and sys.argv[1] == '--one':
+        kwargs = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
+        if sys.argv[2] == 'resnet50':
+            ips = bench_resnet50(**kwargs)
+            print(json.dumps({
+                'metric': 'resnet50_train_images_per_sec_chip',
+                'value': round(ips, 2), 'unit': 'images/sec',
+                'vs_baseline': round(ips / 365.0, 3)}))
+        else:
+            print(json.dumps(
+                globals()['bench_' + sys.argv[2]](**kwargs)))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == '--all':
         # secondary configs (BASELINE.json 0,2,3,4); the driver contract
         # stays the default single-line ResNet metric
-        for fn in (bench_lenet, bench_bert, bench_bert_long,
-                   bench_wide_deep, bench_wide_deep_sparse,
-                   bench_host_sparse_push, bench_rpc_sparse_push,
-                   bench_transformer, bench_resnet_infer):
-            try:
-                print(json.dumps(fn()))
-            except Exception as e:
-                sys.stderr.write('%s failed: %s\n'
-                                 % (fn.__name__, str(e)[:300]))
+        for name, variants in ALL_BENCHES:
+            for kwargs in variants:
+                if _run_entry(name, kwargs):
+                    break
         return
     # NHWC is the TPU-native conv layout (channels on the 128-lane
     # minor dim) and measures ~8% faster than NCHW here
     layout = os.environ.get('PADDLE_TPU_BENCH_LAYOUT', 'NHWC')
     for batch in (128, 64, 32):
-        try:
-            ips = bench_resnet50(batch=batch, data_format=layout)
-            break
-        except Exception as e:
-            sys.stderr.write('batch %d failed: %s\n' % (batch, e))
-            ips = None
-    if ips is None:
-        print(json.dumps({'metric': 'resnet50_train_images_per_sec_chip',
-                          'value': 0.0, 'unit': 'images/sec',
-                          'vs_baseline': 0.0}))
-        return
-    print(json.dumps({
-        'metric': 'resnet50_train_images_per_sec_chip',
-        'value': round(ips, 2),
-        'unit': 'images/sec',
-        'vs_baseline': round(ips / 365.0, 3),
-    }))
+        if _run_entry('resnet50',
+                      {'batch': batch, 'data_format': layout}):
+            return
+    print(json.dumps({'metric': 'resnet50_train_images_per_sec_chip',
+                      'value': 0.0, 'unit': 'images/sec',
+                      'vs_baseline': 0.0}))
 
 
 if __name__ == '__main__':

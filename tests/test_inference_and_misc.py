@@ -195,3 +195,46 @@ def test_launch_cli_single_node(tmp_path):
         capture_output=True, text=True, cwd='/root/repo',
         env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
     assert 'RANK 0 1' in out.stdout, out.stdout + out.stderr
+
+
+def test_training_is_deterministic_across_runs():
+    """Same program + seeds + feeds -> bit-identical loss curves and
+    final params across two independent runs (the reference's
+    cpu_deterministic contract; here step-seeded RNG + XLA give it
+    unconditionally on one device)."""
+    def run_once():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 77
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[6], dtype='float32')
+            y = fluid.layers.data('y', shape=[1], dtype='float32')
+            h = fluid.layers.fc(x, 12, act='relu')
+            h = fluid.layers.dropout(
+                h, 0.3, dropout_implementation='upscale_in_train')
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(fluid.layers.fc(h, 1),
+                                               y))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        rng = np.random.RandomState(0)
+        xb = rng.randn(32, 6).astype('float32')
+        yb = rng.randn(32, 1).astype('float32')
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            for _ in range(10):
+                l, = exe.run(main, feed={'x': xb, 'y': yb},
+                             fetch_list=[loss])
+                losses.append(np.asarray(l).copy())
+            from paddle_tpu.fluid import core
+            pname = main.all_parameters()[0].name
+            final = np.asarray(core.as_array(
+                core.global_scope().find_var(pname)))
+        return losses, final
+
+    # run in fresh scopes; dropout must draw the same step-seeded masks
+    l1, p1 = run_once()
+    l2, p2 = run_once()
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(p1, p2)
