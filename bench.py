@@ -379,7 +379,9 @@ def _run_entry(name, kwargs, timeout=900):
             capture_output=True, text=True, timeout=timeout)
         line = [ln for ln in p.stdout.splitlines()
                 if ln.startswith('{')]
-        if p.returncode == 0 and line:
+        if line:
+            # accept the metric even on a nonzero exit: a measured
+            # JSON line followed by a teardown crash is still a result
             print(line[-1])
             return True
         sys.stderr.write('%s %s failed (rc=%d): %s\n'
@@ -393,6 +395,10 @@ def _run_entry(name, kwargs, timeout=900):
 
 def main():
     _enable_compile_cache()
+    if len(sys.argv) > 1 and sys.argv[1] == '--one' and \
+            len(sys.argv) < 3:
+        sys.stderr.write('usage: bench.py --one NAME [kwargs-json]\n')
+        sys.exit(2)
     if len(sys.argv) > 2 and sys.argv[1] == '--one':
         kwargs = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
         if sys.argv[2] == 'resnet50':

@@ -1,0 +1,147 @@
+"""bf16 dtype sweep: key ops run on bfloat16 inputs and track their
+f32 oracle within bf16 tolerances.
+
+Reference model: OpTest runs each op across dtypes/places
+(python/paddle/fluid/tests/unittests/op_test.py _get_places /
+check_output float16 variants); the TPU-relevant low-precision dtype
+is bfloat16 — the AMP path computes MXU ops in it, so the op surface
+must be numerically sane there, not just under f32.
+"""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+BF16 = ml_dtypes.bfloat16
+rng = np.random.RandomState(11)
+
+
+def _bf16(x):
+    return np.asarray(x, 'float32').astype(BF16)
+
+
+def _check(op, inputs, attrs=None, out_slots=('Out',), rtol=3e-2,
+           atol=3e-2):
+    """Run `op` once on bf16 inputs and once on the SAME (bf16-rounded)
+    values in f32; only compute precision differs, and outputs must
+    agree within bf16 tolerance."""
+    t = OpTest()
+    q = {k: _bf16(v) for k, v in inputs.items()}
+    lo = t.run_op(op, q, attrs, out_slots)
+    hi = t.run_op(op, {k: v.astype('float32') for k, v in q.items()},
+                  attrs, out_slots)
+    for slot in out_slots:
+        got = np.asarray(lo[slot], 'float32')
+        want = np.asarray(hi[slot], 'float32')
+        np.testing.assert_allclose(
+            got, want, rtol=rtol, atol=atol,
+            err_msg='%s[%s] bf16 vs f32' % (op, slot))
+
+
+@pytest.mark.parametrize('op', ['sigmoid', 'tanh', 'relu', 'gelu',
+                                'exp', 'softplus', 'erf', 'swish'])
+def test_bf16_activations(op):
+    _check(op, {'X': rng.randn(4, 8)})
+
+
+def test_bf16_matmul():
+    _check('matmul', {'X': rng.randn(8, 16), 'Y': rng.randn(16, 8)},
+           rtol=5e-2, atol=5e-1)
+
+
+def test_bf16_softmax():
+    _check('softmax', {'X': rng.randn(4, 16) * 2})
+
+
+def test_bf16_layer_norm():
+    x = rng.randn(4, 32)
+    scale = rng.rand(32) + 0.5
+    bias = rng.randn(32) * 0.1
+    _check('layer_norm', {'X': x, 'Scale': scale, 'Bias': bias},
+           attrs={'begin_norm_axis': 1},
+           out_slots=('Y',), rtol=5e-2, atol=5e-2)
+
+
+def test_bf16_elementwise():
+    x, y = rng.randn(4, 8), rng.randn(4, 8)
+    _check('elementwise_add', {'X': x, 'Y': y})
+    _check('elementwise_mul', {'X': x, 'Y': y})
+
+
+def test_bf16_reductions():
+    x = rng.rand(6, 8)
+    _check('reduce_sum', {'X': x}, attrs={'dim': [1]})
+    _check('reduce_mean', {'X': x}, attrs={'dim': [0]})
+    _check('reduce_max', {'X': x}, attrs={'dim': [1]}, rtol=0,
+           atol=1e-2)
+
+
+def test_bf16_conv2d():
+    x = rng.randn(2, 4, 8, 8) * 0.5
+    w = rng.randn(6, 4, 3, 3) * 0.3
+    _check('conv2d', {'Input': x, 'Filter': w},
+           attrs={'strides': [1, 1], 'paddings': [1, 1],
+                  'dilations': [1, 1], 'groups': 1},
+           out_slots=('Output',), rtol=5e-2, atol=3e-1)
+
+
+def test_bf16_pool_and_transpose():
+    x = rng.randn(2, 3, 8, 8)
+    _check('pool2d', {'X': x},
+           attrs={'pooling_type': 'max', 'ksize': [2, 2],
+                  'strides': [2, 2], 'paddings': [0, 0]},
+           rtol=0, atol=1e-2)
+    _check('transpose', {'X': x}, attrs={'axis': [0, 2, 3, 1]},
+           rtol=0, atol=0)
+
+
+def test_bf16_cross_entropy_chain():
+    """softmax_with_cross_entropy keeps labels int; logits bf16."""
+    t = OpTest()
+    logits = rng.randn(8, 10) * 2
+    labels = rng.randint(0, 10, (8, 1)).astype('int64')
+    lo = t.run_op('softmax_with_cross_entropy',
+                  {'Logits': _bf16(logits), 'Label': labels},
+                  out_slots=('Loss',))
+    hi = t.run_op('softmax_with_cross_entropy',
+                  {'Logits': logits.astype('float32'),
+                   'Label': labels}, out_slots=('Loss',))
+    np.testing.assert_allclose(np.asarray(lo['Loss'], 'float32'),
+                               np.asarray(hi['Loss'], 'float32'),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_bf16_grads_flow():
+    """Gradients through a bf16 matmul+activation chain exist, are
+    finite, and track the f32 gradients loosely (the AMP contract:
+    bf16 compute, usable grads)."""
+    import paddle_tpu.fluid as fluid
+    layers = fluid.layers
+    # both runs see the same bf16-rounded values; only the compute
+    # dtype differs
+    xq = rng.randn(4, 8).astype('float32').astype(BF16)
+
+    def grads(dtype):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[8], dtype=dtype)
+            x.stop_gradient = False
+            h = layers.fc(x, 16, act='tanh')
+            loss = layers.reduce_mean(layers.square(h))
+            fluid.backward.append_backward(loss)
+        gmap = main._grad_name_map
+        feed_x = xq if dtype == 'bfloat16' else xq.astype('float32')
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            g, = exe.run(main, feed={'x': feed_x},
+                         fetch_list=[gmap['x']])
+        return np.asarray(g, 'float32')
+
+    g32 = grads('float32')
+    g16 = grads('bfloat16')
+    assert np.isfinite(g16).all()
+    np.testing.assert_allclose(g16, g32, rtol=1e-1, atol=1e-2)
