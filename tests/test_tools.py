@@ -8,11 +8,11 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(tool):
+def _run(tool, *args):
     env = dict(os.environ)
     env.setdefault('JAX_PLATFORMS', 'cpu')
     p = subprocess.run([sys.executable, os.path.join(REPO, 'tools',
-                                                     tool)],
+                                                     tool)] + list(args),
                        capture_output=True, text=True, env=env,
                        cwd=REPO, timeout=300)
     return p
@@ -34,3 +34,28 @@ def test_every_op_is_test_referenced():
     p = _run('check_test_coverage.py')
     assert p.returncode == 0, p.stdout + p.stderr
     assert 'every registered op is referenced' in p.stdout
+
+
+def test_timeline_export(tmp_path):
+    """fluid.profiler capture -> tools/timeline.py -> chrome-trace JSON
+    (the reference's tools/timeline.py flow)."""
+    import gzip
+    import json
+
+    prof = tmp_path / 'profile'
+    # synthesize the jax-profiler layout the tool consumes
+    d = prof / 'plugins' / 'profile' / 'run1'
+    d.mkdir(parents=True)
+    trace = {'traceEvents': [
+        {'ph': 'M', 'pid': 1, 'name': 'process_name',
+         'args': {'name': '/device:TPU:0'}},
+        {'ph': 'X', 'pid': 1, 'tid': 0, 'ts': 0, 'dur': 5,
+         'name': 'fusion.1'}]}
+    with gzip.open(str(d / 'vm.trace.json.gz'), 'wt') as f:
+        json.dump(trace, f)
+    out = tmp_path / 'timeline.json'
+    p = _run('timeline.py', '--profile_path', str(prof),
+             '--timeline_path', str(out))
+    assert p.returncode == 0, p.stdout + p.stderr
+    got = json.load(open(str(out)))
+    assert got['traceEvents'][1]['name'] == 'fusion.1'
