@@ -220,3 +220,59 @@ def test_imdb_sentiment_end_to_end_via_bucketed_loader():
             total += len(pred)
     acc = correct / total
     assert acc > 0.8, (acc, correct, total)
+
+
+def test_bucketed_loader_properties_random_lengths():
+    """Property check over random ragged distributions: every sample is
+    delivered exactly once, each batch's pad length is the smallest
+    boundary covering its samples, masks are exactly 1 over real
+    tokens / 0 over padding, and padded cells are 0."""
+    rng = np.random.RandomState(123)
+    for trial in range(4):
+        n = int(rng.randint(20, 60))
+        lengths = rng.randint(1, 33, size=n)
+        boundaries = [4, 8, 16, 32]
+        samples = [(np.arange(1, L + 1, dtype='int64'),
+                    np.int64(i)) for i, L in enumerate(lengths)]
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data('ids', shape=[1], dtype='int64',
+                              lod_level=1)
+            tag = layers.data('tag', shape=[1], dtype='int64')
+
+        loader = fluid.io.DataLoader.from_generator(
+            feed_list=[ids, tag], bucket_boundaries=boundaries,
+            batch_size=8)
+        loader.set_sample_generator(lambda: iter(samples))
+
+        seen = {}
+        for feed in loader:
+            arr = feed['ids']
+            mask = feed['ids@MASK']
+            tags = feed['tag'].ravel()
+            T = arr.shape[1]
+            assert T in boundaries, T
+            batch_lens = []
+            for row, mrow, t in zip(arr, mask, tags):
+                L = int(mrow.sum())
+                batch_lens.append(L)
+                assert int(t) not in seen
+                seen[int(t)] = L
+                # mask is a 1/0 prefix; padded cells are zero
+                np.testing.assert_array_equal(
+                    mrow.ravel()[:L], np.ones(L, 'float32'))
+                np.testing.assert_array_equal(
+                    mrow.ravel()[L:], np.zeros(T - L, 'float32'))
+                np.testing.assert_array_equal(
+                    row.ravel()[:L], np.arange(1, L + 1))
+                np.testing.assert_array_equal(
+                    row.ravel()[L:], np.zeros(T - L, 'int64'))
+            # tightest covering boundary for this batch
+            lo = max(batch_lens)
+            want_T = min(b for b in boundaries if b >= lo)
+            assert T == want_T, (T, want_T, batch_lens)
+        # exactly-once delivery, and lengths survived the roundtrip
+        assert sorted(seen) == list(range(n))
+        for i, L in enumerate(lengths):
+            assert seen[i] == L, (i, seen[i], L)

@@ -23,12 +23,13 @@ def _bf16(x):
 
 
 def _check(op, inputs, attrs=None, out_slots=('Out',), rtol=3e-2,
-           atol=3e-2):
-    """Run `op` once on bf16 inputs and once on the SAME (bf16-rounded)
-    values in f32; only compute precision differs, and outputs must
-    agree within bf16 tolerance."""
+           atol=3e-2, dtype=BF16):
+    """Run `op` once on low-precision inputs and once on the SAME
+    (rounded) values in f32; only compute precision differs, and
+    outputs must agree within the dtype's tolerance."""
     t = OpTest()
-    q = {k: _bf16(v) for k, v in inputs.items()}
+    q = {k: np.asarray(v, 'float32').astype(dtype)
+         for k, v in inputs.items()}
     lo = t.run_op(op, q, attrs, out_slots)
     hi = t.run_op(op, {k: v.astype('float32') for k, v in q.items()},
                   attrs, out_slots)
@@ -37,7 +38,7 @@ def _check(op, inputs, attrs=None, out_slots=('Out',), rtol=3e-2,
         want = np.asarray(hi[slot], 'float32')
         np.testing.assert_allclose(
             got, want, rtol=rtol, atol=atol,
-            err_msg='%s[%s] bf16 vs f32' % (op, slot))
+            err_msg='%s[%s] %s vs f32' % (op, slot, np.dtype(dtype)))
 
 
 @pytest.mark.parametrize('op', ['sigmoid', 'tanh', 'relu', 'gelu',
@@ -145,3 +146,18 @@ def test_bf16_grads_flow():
     g16 = grads('bfloat16')
     assert np.isfinite(g16).all()
     np.testing.assert_allclose(g16, g32, rtol=1e-1, atol=1e-2)
+
+
+@pytest.mark.parametrize('op', ['sigmoid', 'tanh', 'relu', 'exp'])
+def test_f16_activations(op):
+    """float16 (the reference AMP dtype) works through the same ops;
+    tolerance reflects f16's 10-bit mantissa."""
+    _check(op, {'X': rng.randn(4, 8)}, dtype=np.float16,
+           rtol=5e-3, atol=5e-3)
+
+
+def test_f16_matmul_and_softmax():
+    _check('matmul', {'X': rng.randn(8, 16), 'Y': rng.randn(16, 8)},
+           dtype=np.float16, rtol=2e-2, atol=1e-1)
+    _check('softmax', {'X': rng.randn(4, 16) * 2}, dtype=np.float16,
+           rtol=1e-2, atol=1e-3)
