@@ -856,8 +856,9 @@ def cos_sim(X, Y):
 def nce(input, label, num_total_classes, sample_weight=None,
         param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
         sampler='uniform', custom_dist=None, seed=0, is_sparse=False):
-    """Noise-contrastive estimation loss; samplers: uniform and
-    custom_dist (reference operators/nce_op.h CustomSampler)."""
+    """Noise-contrastive estimation loss; samplers: uniform,
+    log_uniform (Zipfian), and custom_dist (reference
+    operators/nce_op.h + math/sampler.cc LogUniformSampler)."""
     helper = LayerHelper('nce', param_attr=param_attr,
                          bias_attr=bias_attr, name=name)
     dim = input.shape[-1]

@@ -386,10 +386,23 @@ def nce(ctx, ins, attrs):
         neg = jax.random.randint(key, (b, num_neg), 0, v,
                                  dtype=jnp.int32)
         p_of = lambda ids: jnp.full(ids.shape, 1.0 / v, jnp.float32)
+    elif sampler in ('log_uniform', 1):
+        # Zipfian sampler (reference operators/math/sampler.cc
+        # LogUniformSampler): P(k) = log((k+2)/(k+1)) / log(v+1),
+        # drawn by inverse CDF: k = floor(exp(u * log(v+1))) - 1
+        u = jax.random.uniform(key, (b, num_neg))
+        neg = jnp.clip(
+            jnp.floor(jnp.exp(u * np.log(v + 1.0))) - 1.0,
+            0, v - 1).astype(jnp.int32)
+        # log1p form: log((k+2)/(k+1)) cancels catastrophically in f32
+        # for large ids (rounds to log(1)=0 near k~8M vocab entries)
+        p_of = lambda ids: (jnp.log1p(
+            1.0 / (ids.astype(jnp.float32) + 1.0)) /
+            np.log(v + 1.0)).astype(jnp.float32)
     else:
         raise NotImplementedError(
             'nce: sampler %r is not implemented (uniform | '
-            'custom_dist)' % (sampler,))
+            'log_uniform | custom_dist)' % (sampler,))
 
     def logits_of(ids):
         wl = w[ids]                                  # [B, K, D]

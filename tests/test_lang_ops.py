@@ -221,6 +221,51 @@ def test_nce_trains_word2vec_style():
     assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
+def test_nce_log_uniform_sampler():
+    """Zipfian negative sampler (reference math/sampler.cc
+    LogUniformSampler): trains, and the drawn negatives follow the
+    log-uniform marginal (low ids much more frequent than high)."""
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        lab = fluid.layers.data('lab', shape=[1], dtype='int64')
+        cost = fluid.layers.nce(x, lab, num_total_classes=50,
+                                num_neg_samples=8,
+                                sampler='log_uniform')
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    emb = rng.randn(50, 8).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        losses = []
+        for i in range(40):
+            ids = rng.randint(0, 50, (32,))
+            feed = {'x': emb[ids], 'lab': ids[:, None].astype('int64')}
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+    # marginal check on the OP's own negatives: SampleLabels carries
+    # [true, negatives]; under log-uniform with v=1000,
+    # P(id < 10) = log(11)/log(1001) ~ 0.347
+    v, b, k = 1000, 200, 100
+    out = run_op('nce',
+                 {'Input': [np.ones((b, 4), 'float32')],
+                  'Weight': [np.zeros((v, 4), 'float32')],
+                  'Label': [np.zeros((b, 1), 'int64')]},
+                 {'num_total_classes': v, 'num_neg_samples': k,
+                  'sampler': 'log_uniform', 'seed': 3})
+    neg = np.asarray(out['SampleLabels'][0])[:, 1:]
+    assert neg.shape == (b, k)
+    assert (neg >= 0).all() and (neg < v).all()
+    frac = (neg < 10).mean()
+    assert 0.30 < frac < 0.40, frac
+
+
 def test_hsigmoid_loss_decreases_and_path_math():
     # path math: num_classes=4 -> codes 4..7, length 2
     from paddle_tpu.ops.lang_ops import hierarchical_sigmoid  # noqa: F401
