@@ -132,6 +132,10 @@ class PsClient(object):
                          else fd) / 1000.0
         self.retry_times = fr if retry_times is None else retry_times
         self._sock = None
+        # count of non-idempotent pushes discarded after a lost reply
+        # (drop-on-timeout path) — surfaced so flaky-network grad loss
+        # is observable, not silent
+        self.dropped_pushes = 0
         # one in-flight request per connection: the lock makes a shared
         # client safe under AsyncCommunicator's per-variable send
         # threads (request/response stay paired)
@@ -160,11 +164,20 @@ class PsClient(object):
             self._sock = None
 
     # -- framing ----------------------------------------------------------
-    def _call(self, op, name, payload=b'', blocking=False):
+    def _call(self, op, name, payload=b'', blocking=False, resend=True):
         """blocking=True: a call that legitimately parks server-side
         (BARRIER) — no recv deadline and NO retry, because resending
         would double-count this caller at the server (the abandoned
-        handler thread is already parked in the barrier)."""
+        handler thread is already parked in the barrier).
+
+        resend=False: a NON-IDEMPOTENT mutation (grad push).  Connect
+        failures still retry freely (the request never left), but once
+        the frame was fully sent, a lost reply means the server may
+        already have APPLIED it — resending would double-step the
+        optimizer (momentum/adam state advances twice).  Such a call is
+        dropped instead, like the reference's async send path
+        (grpc_client.h completion-queue sends are fire-and-forget for
+        grads), and returns None."""
         nb = name.encode()
         frame = struct.pack('<BI', op, len(nb)) + nb + payload
         msg = struct.pack('<I', len(frame)) + frame
@@ -172,6 +185,7 @@ class PsClient(object):
         with self._lock:
             last = None
             for attempt in range(retries + 1):
+                sent = False
                 try:
                     if self._sock is None or attempt > 0:
                         self._connect()
@@ -179,6 +193,7 @@ class PsClient(object):
                         self._sock.settimeout(None)
                     try:
                         self._sock.sendall(msg)
+                        sent = True
                         (rlen,) = struct.unpack('<I', self._recv(4))
                         body = self._recv(rlen)
                     finally:
@@ -187,6 +202,24 @@ class PsClient(object):
                     break
                 except (socket.timeout, ConnectionError, OSError) as e:
                     last = e
+                    if sent and not resend:
+                        # possibly applied server-side: drop, don't
+                        # double-apply; force a fresh connection so a
+                        # late reply can't desync the next call's
+                        # request/response pairing
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                        self.dropped_pushes += 1
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            'ps push op=%d var=%r to %s:%d dropped '
+                            'after lost reply (%s) — %d dropped so far '
+                            'on this client', op, name, self._addr[0],
+                            self._addr[1], e, self.dropped_pushes)
+                        return None
             else:
                 raise RpcDeadlineError(
                     'ps rpc to %s:%d failed after %d attempts with '
@@ -226,16 +259,23 @@ class PsClient(object):
                    struct.pack('<Bffff', kind, lr, b1, beta2, epsilon))
 
     def push_dense_grad(self, name, grad):
+        """Apply one gradient to the server-side optimizer.  NOT
+        resent on a lost reply (resend=False): the push may already
+        have stepped the optimizer — async-SGD semantics tolerate a
+        dropped grad, not a doubled one."""
         g = np.ascontiguousarray(grad, np.float32).reshape(-1)
         self._call(OP_PUSH_DENSE, name,
-                   struct.pack('<Q', g.size) + g.tobytes())
+                   struct.pack('<Q', g.size) + g.tobytes(),
+                   resend=False)
 
     def add_dense(self, name, delta):
         """p += delta: the GeoSGD delta-shipping leg
-        (operators/distributed/communicator.h:343)."""
+        (operators/distributed/communicator.h:343).  Non-idempotent →
+        drop-on-lost-reply like push_dense_grad."""
         d = np.ascontiguousarray(delta, np.float32).reshape(-1)
         self._call(OP_ADD_DENSE, name,
-                   struct.pack('<Q', d.size) + d.tobytes())
+                   struct.pack('<Q', d.size) + d.tobytes(),
+                   resend=False)
 
     def pull_dense(self, name):
         try:
@@ -259,15 +299,17 @@ class PsClient(object):
         self._rows_op(OP_SET_ROWS, name, ids, values)
 
     def push_rows(self, name, ids, grads):
-        self._rows_op(OP_PUSH_ROWS, name, ids, grads)
+        """Sparse grad push: non-idempotent (per-row optimizer state
+        advances) → drop-on-lost-reply, never resent."""
+        self._rows_op(OP_PUSH_ROWS, name, ids, grads, resend=False)
 
-    def _rows_op(self, op, name, ids, values):
+    def _rows_op(self, op, name, ids, values, resend=True):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         if ids.size == 0:
             return  # zero-row shard (vocab < n_servers): nothing to do
         v = np.ascontiguousarray(values, np.float32).reshape(ids.size, -1)
         self._call(op, name, struct.pack('<Q', ids.size) + ids.tobytes() +
-                   v.tobytes())
+                   v.tobytes(), resend=resend)
 
     def pull_rows(self, name, ids, dim):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
@@ -328,20 +370,43 @@ class PsClient(object):
         """Raw chunked write of table rows (and optimizer state) — the
         restore leg; no optimizer rule is applied."""
         rows = np.ascontiguousarray(rows, np.float32)
-        k = rows.shape[0]
+        if rows.ndim != 2:
+            # flattened rows would mis-encode k as the element count
+            # and slide optimizer-state bytes into table values
+            raise ValueError(
+                'set_shard(%s): rows must be 2-D [k, dim], got shape %s'
+                % (name, rows.shape))
+        k, dim = rows.shape
         payload = struct.pack('<QQ', start, k) + rows.tobytes()
         if state:
             if 'acc' in state:
-                payload += struct.pack('<B', 1) + np.ascontiguousarray(
-                    state['acc'], np.float32).tobytes()
+                acc = np.ascontiguousarray(state['acc'], np.float32)
+                if acc.size != k:
+                    raise ValueError(
+                        'set_shard(%s): adagrad acc has %d entries for '
+                        '%d rows' % (name, acc.size, k))
+                payload += struct.pack('<B', 1) + acc.tobytes()
             elif 'm' in state:
-                payload += (struct.pack('<B', 2) +
-                            np.ascontiguousarray(state['m'],
-                                                 np.float32).tobytes() +
-                            np.ascontiguousarray(state['v'],
-                                                 np.float32).tobytes() +
-                            np.ascontiguousarray(state['t'],
-                                                 np.float32).tobytes())
+                # validate the full adam triple BEFORE packing: a
+                # partial dict must fail with a clear message, not a
+                # KeyError after the rows payload was built
+                missing = [key for key in ('m', 'v', 't')
+                           if key not in state]
+                if missing:
+                    raise ValueError(
+                        'set_shard(%s): adam state needs m, v and t; '
+                        'missing %s' % (name, ', '.join(missing)))
+                m = np.ascontiguousarray(state['m'], np.float32)
+                v = np.ascontiguousarray(state['v'], np.float32)
+                t = np.ascontiguousarray(state['t'], np.float32)
+                want = k * dim
+                if m.size != want or v.size != want or t.size != k:
+                    raise ValueError(
+                        'set_shard(%s): adam state shape mismatch for '
+                        '%d rows x dim %s: m=%d v=%d t=%d'
+                        % (name, k, dim, m.size, v.size, t.size))
+                payload += (struct.pack('<B', 2) + m.tobytes() +
+                            v.tobytes() + t.tobytes())
         self._call(OP_SET_SHARD, name, payload)
 
     # -- durability -------------------------------------------------------

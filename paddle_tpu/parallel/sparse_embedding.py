@@ -460,6 +460,16 @@ class RpcShardedEmbedding(HostShardedEmbedding):
                 rows, st = cl.pull_shard(self.name, start,
                                          self._SHARD_CHUNK,
                                          dim=self.dim)
+                if rows.shape[0] == 0:
+                    # the server shard holds fewer rows than the
+                    # client-side geometry predicts (e.g. it load()ed a
+                    # snapshot with a different vocab after attach-time
+                    # verification) — advancing by 0 would spin forever
+                    raise RuntimeError(
+                        'sparse table %r shard %d geometry mismatch: '
+                        'expected %d rows, server ran out at %d '
+                        '(snapshot from a different vocab_size?)'
+                        % (self.name, e, rows_e, start))
                 parts.append(rows)
                 for lst, key in ((accs, 'acc'), (ms, 'm'), (vs, 'v'),
                                  (ts, 't')):

@@ -341,38 +341,84 @@ bool save_snapshot(Server *s, const std::string &path,
     *err = "cannot open " + tmp + " for writing";
     return false;
   }
-  std::lock_guard<std::mutex> g(s->tables_mu);
+  // Snapshot the name->pointer maps under tables_mu and RELEASE it
+  // before any disk I/O: every push/pull path takes tables_mu to find
+  // its table, so holding it across a multi-GB serialization would
+  // stall the whole server past FLAGS_rpc_deadline and trigger client
+  // retries.  Pointers stay valid after release — tables are never
+  // freed while the server runs (LOAD retires them, stop() frees).
+  // Consistency note: each table is staged atomically under its own
+  // mutex, but tables are staged at slightly different moments, so a
+  // snapshot taken under concurrent pushes is not a single global cut
+  // across tables.  That matches async-PS semantics (there is no
+  // global step to cut at; the reference's checkpoint_notify saves
+  // per-block the same way).  Sync training checkpoints through the
+  // trainer-side barrier before SAVE, which quiesces pushes.
+  std::vector<std::pair<std::string, Dense *>> dlist;
+  std::vector<std::pair<std::string, Sparse *>> slist;
+  {
+    std::lock_guard<std::mutex> g(s->tables_mu);
+    dlist.assign(s->dense.begin(), s->dense.end());
+    slist.assign(s->sparse.begin(), s->sparse.end());
+  }
   std::fwrite(&kMagic, 4, 1, f);
   uint32_t ver = 2;
   std::fwrite(&ver, 4, 1, f);
-  uint32_t nd = static_cast<uint32_t>(s->dense.size());
-  uint32_t ns = static_cast<uint32_t>(s->sparse.size());
+  uint32_t nd = static_cast<uint32_t>(dlist.size());
+  uint32_t ns = static_cast<uint32_t>(slist.size());
   std::fwrite(&nd, 4, 1, f);
   std::fwrite(&ns, 4, 1, f);
-  for (auto &kv : s->dense) {
+  // Per table: copy to staging under the PER-TABLE lock (brief, memory
+  // speed), then fwrite unlocked — a slow disk stalls nobody.  Peak
+  // extra memory is one table's worth.
+  for (auto &kv : dlist) {
     Dense *d = kv.second;
-    std::lock_guard<std::mutex> gd(d->mu);
+    std::vector<float> value, m, v;
+    OptConf opt;
+    uint64_t tstep;
+    uint8_t hc;
+    {
+      std::lock_guard<std::mutex> gd(d->mu);
+      value = d->value;
+      m = d->m;
+      v = d->v;
+      opt = d->opt;
+      tstep = d->t;
+      hc = d->has_conf ? 1 : 0;
+    }
     write_str(f, kv.first);
-    uint8_t hc = d->has_conf ? 1 : 0;
     std::fwrite(&hc, 1, 1, f);
-    std::fwrite(&d->opt, sizeof(OptConf), 1, f);
-    std::fwrite(&d->t, 8, 1, f);
-    write_vec(f, d->value);
-    write_vec(f, d->m);
-    write_vec(f, d->v);
+    std::fwrite(&opt, sizeof(OptConf), 1, f);
+    std::fwrite(&tstep, 8, 1, f);
+    write_vec(f, value);
+    write_vec(f, m);
+    write_vec(f, v);
   }
-  for (auto &kv : s->sparse) {
+  for (auto &kv : slist) {
     Sparse *t = kv.second;
-    std::lock_guard<std::mutex> gt(t->mu);
+    std::vector<float> table, acc, m, v, tv;
+    OptConf opt;
+    uint64_t rows, dim;
+    {
+      std::lock_guard<std::mutex> gt(t->mu);
+      table = t->table;
+      acc = t->acc;
+      m = t->m;
+      v = t->v;
+      tv = t->t;
+      opt = t->opt;
+      rows = t->rows;
+      dim = t->dim;
+    }
     write_str(f, kv.first);
-    std::fwrite(&t->opt, sizeof(OptConf), 1, f);
-    std::fwrite(&t->rows, 8, 1, f);
-    std::fwrite(&t->dim, 8, 1, f);
-    write_vec(f, t->table);
-    write_vec(f, t->acc);
-    write_vec(f, t->m);
-    write_vec(f, t->v);
-    write_vec(f, t->t);
+    std::fwrite(&opt, sizeof(OptConf), 1, f);
+    std::fwrite(&rows, 8, 1, f);
+    std::fwrite(&dim, 8, 1, f);
+    write_vec(f, table);
+    write_vec(f, acc);
+    write_vec(f, m);
+    write_vec(f, v);
+    write_vec(f, tv);
   }
   bool ok = std::fflush(f) == 0;
   ok = (std::fclose(f) == 0) && ok;
