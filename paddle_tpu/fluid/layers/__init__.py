@@ -37,6 +37,8 @@ from . import extras
 from .extras import *  # noqa: F401,F403
 from . import more_layers
 from .more_layers import *  # noqa: F401,F403
+from . import parallel_layers
+from .parallel_layers import *  # noqa: F401,F403
 from .more_layers import sum, shape, size, rank, hash  # noqa: F401,A001
 from . import detection
 from .detection import *  # noqa: F401,F403

@@ -1,0 +1,105 @@
+"""Sequence-parallel attention and Mixture-of-Experts layers.
+
+NEW capability vs the reference (SURVEY.md §2.4: fluid v1.6 has no
+sequence/context or expert parallelism), surfaced the reference WAY: a
+layer call appends ops to the Program, and the parallelism is realized
+when the program compiles under a mesh with 'sp'/'ep' axes
+(CompiledProgram.with_mesh) — the same contract by which dp/mp reach
+the user through CompiledProgram/fleet rather than raw device code
+(reference python/paddle/fluid/transpiler/collective.py:36).
+
+The layers also stamp mesh-sharding HINTS for their parameters and
+activations on the program (program._sharding_hints), which the GSPMD
+executor path picks up so expert weights land sharded over 'ep'
+without the user writing a with_param_shardings rule.
+"""
+
+from ..layer_helper import LayerHelper
+from ..initializer import Normal
+
+__all__ = ['context_parallel_attention', 'moe']
+
+
+def _add_hint(program, var_name, axes):
+    """Record `axes` (tuple of mesh-axis names / None, one per dim) as
+    the preferred sharding for var_name; axes absent from the runtime
+    mesh degrade to replication (parallel_executor._hint_to_spec)."""
+    hints = getattr(program, '_sharding_hints', None)
+    if hints is None:
+        hints = program._sharding_hints = {}
+    hints[var_name] = tuple(axes)
+
+
+def context_parallel_attention(q, k, v, causal=False, use_flash=False,
+                               axis='sp', name=None):
+    """Multi-head attention whose sequence dim shards over the `axis`
+    mesh axis (ring attention: K/V blocks rotate over the ICI ring via
+    ppermute while each device streams its Q block's online softmax).
+
+    q, k, v: [B, T, H, D] variables (batch, time, heads, head_dim).
+    use_flash: use the Pallas flash kernel as the per-block engine
+        (long-context memory profile; falls back off-TPU to interpret
+        mode, so tests keep it False).
+    Returns Out [B, T, H, D].
+
+    On a mesh without `axis` (or single-device) the op computes the
+    identical dense attention, so programs are portable across meshes.
+    """
+    helper = LayerHelper(name or 'context_parallel_attention')
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op('ring_attention',
+                     inputs={'Q': q, 'K': k, 'V': v},
+                     outputs={'Out': out},
+                     attrs={'causal': bool(causal),
+                            'use_flash': bool(use_flash),
+                            'axis': axis})
+    prog = helper.main_program
+    for var in (q, k, v, out):
+        _add_hint(prog, var.name, ('dp', axis, None, None))
+    return out
+
+
+def moe(x, num_experts, hidden_size, capacity_factor=2.0,
+        aux_weight=0.01, axis='ep', param_attr=None, name=None):
+    """GShard-style top-1 Mixture-of-Experts FFN layer.
+
+    x: [B, T, D].  Creates gate [D, E] and per-expert FFN weights
+    W1 [E, D, hidden_size], W2 [E, hidden_size, D]; under a mesh with
+    an `axis` ('ep') dimension the experts shard across it and tokens
+    route via all_to_all over ICI.
+
+    Returns (out [B, T, D], aux_loss []): add `aux_loss` (already
+    scaled by aux_weight) to the training loss — the Switch
+    load-balance term that keeps routing spread across experts.
+    """
+    helper = LayerHelper(name or 'moe', param_attr=param_attr)
+    d = int(x.shape[-1])
+    e, h = int(num_experts), int(hidden_size)
+    wg = helper.create_parameter(param_attr, shape=[d, e],
+                                 dtype=x.dtype,
+                                 default_initializer=Normal(0., 0.02))
+    w1 = helper.create_parameter(param_attr, shape=[e, d, h],
+                                 dtype=x.dtype,
+                                 default_initializer=Normal(0., 0.02))
+    w2 = helper.create_parameter(param_attr, shape=[e, h, d],
+                                 dtype=x.dtype,
+                                 default_initializer=Normal(0., 0.02))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    aux = helper.create_variable_for_type_inference('float32')
+    helper.append_op('moe_ffn',
+                     inputs={'X': x, 'Gate': wg, 'W1': w1, 'W2': w2},
+                     outputs={'Out': out, 'AuxLoss': aux},
+                     attrs={'axis': axis,
+                            'capacity_factor': float(capacity_factor)})
+    prog = helper.main_program
+    _add_hint(prog, w1.name, (axis, None, None))
+    _add_hint(prog, w2.name, (axis, None, None))
+    _add_hint(prog, x.name, ('dp', ('sp', axis), None))
+    _add_hint(prog, out.name, ('dp', ('sp', axis), None))
+    # always scale (aux_weight=0.0 must yield a ZEROED term, honoring
+    # the "already scaled" contract — not the raw Switch loss)
+    scaled = helper.create_variable_for_type_inference('float32')
+    helper.append_op('scale', inputs={'X': aux},
+                     outputs={'Out': scaled},
+                     attrs={'scale': float(aux_weight)})
+    return out, scaled

@@ -153,6 +153,31 @@ def _check_mesh_spans_processes(mesh):
     return mesh
 
 
+def _hint_to_spec(hint, mesh, shape):
+    """Layer-stamped sharding hint (tuple over dims; each entry None, an
+    axis name, or a tuple of axis names) -> PartitionSpec valid on
+    `mesh`: axes absent from the mesh (or with indivisible dims) degrade
+    to replication, so one program runs on any mesh."""
+    if len(hint) != len(shape):
+        return None
+    spec = []
+    for dim, entry in zip(shape, hint):
+        if entry is None:
+            spec.append(None)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        keep = [a for a in axes
+                if a in mesh.axis_names and mesh.shape[a] > 1]
+        prod = 1
+        for a in keep:
+            prod *= mesh.shape[a]
+        if keep and dim % prod == 0:
+            spec.append(tuple(keep) if len(keep) > 1 else keep[0])
+        else:
+            spec.append(None)
+    return P(*spec)
+
+
 def get_mesh(compiled):
     if getattr(compiled, '_mesh', None) is None:
         compiled._mesh = _default_mesh(compiled._places)
@@ -182,6 +207,19 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     executor._step += 1
     fetched = {}
     param_rule = getattr(compiled, '_param_sharding_rule', None)
+    hints = getattr(program, '_sharding_hints', None)
+    if hints:
+        # layer-stamped hints (moe expert weights on 'ep', attention
+        # activations on 'sp') take precedence; the user rule fills in
+        # the rest
+        user_rule = param_rule
+
+        def param_rule(name, shape, _u=user_rule, _h=hints):
+            if name in _h:
+                spec = _hint_to_spec(_h[name], mesh, shape)
+                if spec is not None:
+                    return spec
+            return _u(name, shape) if _u is not None else None
     zero_axis = getattr(compiled, '_shard_opt_states_axis', None)
     if zero_axis is not None:
         param_names = set(p.name for p in program.all_parameters())
@@ -202,7 +240,8 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     for item in plan:
         if isinstance(item, _Segment):
             _run_segment_parallel(executor, item, feed, scope, mesh, ndev,
-                                  fetched, param_rule, batch_feeds)
+                                  fetched, param_rule, batch_feeds,
+                                  hints)
         else:
             from ..ops import registry
             op = item[1]
@@ -217,13 +256,18 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
 
 
 def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
-                          param_rule=None, batch_feeds=None):
+                          param_rule=None, batch_feeds=None, hints=None):
     repl = NamedSharding(mesh, P())
     dp = mesh.axis_names[0]
     dp_size = mesh.shape[dp]
     batch_feeds = feed if batch_feeds is None else batch_feeds
 
     def data_shard(name, val):
+        if hints and name in hints and jax.process_count() == 1:
+            spec = _hint_to_spec(hints[name], mesh,
+                                 getattr(val, 'shape', ()))
+            if spec is not None:
+                return NamedSharding(mesh, spec)
         if name in feed and name in batch_feeds and \
                 _guard_local_batch(name, val, mesh, dp_size):
             return NamedSharding(mesh, P(dp))
@@ -252,7 +296,17 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
     data = {n: _convert_data(n, v) for n, v in data.items()}
     compiled = seg.compiled.get('parallel')
     if compiled is None:
-        fn = _make_segment_fn(seg)
+        fn0 = _make_segment_fn(seg)
+
+        # publish the mesh for the duration of TRACING so mesh-aware op
+        # lowerings (ring_attention / moe_ffn, ops/parallel_ops.py) can
+        # open shard_maps over its named axes; the context manager runs
+        # inside the traced python body, i.e. exactly at trace time
+        def fn(step, state, data, _fn0=fn0, _mesh=mesh):
+            from ..parallel import mesh as pmesh
+            with pmesh.use_trace_mesh(_mesh):
+                return _fn0(step, state, data)
+        fn.__name__ = fn0.__name__
         in_shardings = (None,
                         {n: state_shard(n, state[n])
                          for n in seg.state_names},

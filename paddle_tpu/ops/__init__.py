@@ -27,6 +27,7 @@ from . import loss_ops  # noqa: F401
 from . import vision_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import detection_host_ops  # noqa: F401
+from . import parallel_ops  # noqa: F401
 
 # host-sharded embedding (PS analog) host ops: registration lives with
 # the table implementation; import so distributed_lookup_table /

@@ -1,0 +1,160 @@
+"""Sequence-parallel and expert-parallel op lowerings.
+
+These make ring attention (context parallelism over 'sp') and GShard
+MoE (expert parallelism over 'ep') FIRST-CLASS Program ops: a fluid
+layer appends them like any other op, and the SAME program runs
+
+- single-device: dense fallbacks (reference attention / dense MoE);
+- under CompiledProgram.with_mesh on a mesh with 'sp'/'ep' axes: the
+  lowering opens a jax.shard_map over the trace-time mesh
+  (parallel.mesh.trace_mesh, published by the executor's GSPMD path)
+  and runs the ppermute ring / all_to_all dispatch, with GSPMD
+  resharding activations at the shard_map boundary.
+
+This mirrors the reference's design law that every parallelism mode is
+a program rewrite reachable from the user API (the collective
+transpiler inserts c_* ops into the Program the same way —
+python/paddle/fluid/transpiler/collective.py:36,178;
+operators/collective/c_allreduce_op.h:33) — except here the "rewrite"
+is a mesh-conditional lowering, so one program serves every mesh.
+
+Gradients: both lowerings are differentiable (vjp reverses the
+ppermute ring / all_to_all), so registry.grad_op_def synthesizes
+ring_attention_grad / moe_ffn_grad like for any op.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .registry import register
+
+
+def _token_axes(mesh, dims, prefer):
+    """Build a PartitionSpec for an activation of shape `dims`:
+    dim 0 (batch) over 'dp', dim 1 (time/tokens) over `prefer` axes —
+    each axis used only when present in the mesh and the dim divides
+    evenly.  Returns (spec, used_axis_names)."""
+    used = []
+    spec = [None] * len(dims)
+    if 'dp' in mesh.axis_names and dims[0] % mesh.shape['dp'] == 0 \
+            and mesh.shape['dp'] > 1:
+        spec[0] = 'dp'
+        used.append('dp')
+    taxes = []
+    prod = 1
+    for ax in prefer:
+        if ax in mesh.axis_names and mesh.shape[ax] > 1:
+            taxes.append(ax)
+            prod *= mesh.shape[ax]
+    if len(dims) > 1 and taxes and dims[1] % prod == 0:
+        spec[1] = tuple(taxes) if len(taxes) > 1 else taxes[0]
+        used.extend(taxes)
+    return P(*spec), used
+
+
+@register('ring_attention')
+def ring_attention_op(ctx, ins, attrs):
+    """Q,K,V: [B, T, H, D] -> Out [B, T, H, D].
+
+    attrs:
+      causal (bool): causal masking.
+      use_flash (bool): per-block engine is the Pallas flash kernel
+        (long-context memory profile) instead of the online-softmax
+        einsum ring.
+      axis (str): mesh axis carrying the sequence shards ('sp').
+
+    Under a trace mesh whose `axis` has size > 1, the sequence dim is
+    sharded over it and K/V blocks rotate via ppermute
+    (parallel/ring_attention.py); otherwise the dense fallback runs the
+    identical math on one device, so shape inference and single-chip
+    execution never need a mesh.
+    """
+    from ..parallel import mesh as pmesh
+    from ..parallel.ring_attention import (
+        reference_attention, ring_attention_inner,
+        ring_flash_attention_inner)
+
+    q, k, v = ins['Q'][0], ins['K'][0], ins['V'][0]
+    causal = bool(attrs.get('causal', False))
+    use_flash = bool(attrs.get('use_flash', False))
+    axis = attrs.get('axis', 'sp')
+
+    mesh = pmesh.trace_mesh()
+    sp = pmesh.axis_size(mesh, axis)
+    if sp > 1 and q.shape[1] % sp == 0:
+        spec = [None, axis, None, None]
+        if 'dp' in mesh.axis_names and mesh.shape['dp'] > 1 and \
+                q.shape[0] % mesh.shape['dp'] == 0:
+            spec[0] = 'dp'
+        spec = P(*spec)
+        inner = ring_flash_attention_inner if use_flash \
+            else ring_attention_inner
+        f = jax.shard_map(
+            functools.partial(inner, axis_name=axis, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return {'Out': [f(q, k, v)]}
+    if use_flash:
+        from .pallas.flash_attention import flash_attention
+        return {'Out': [flash_attention(q, k, v, causal=causal)]}
+    return {'Out': [reference_attention(q, k, v, causal=causal)]}
+
+
+@register('moe_ffn', no_grad_out_slots=())
+def moe_ffn_op(ctx, ins, attrs):
+    """GShard top-1 MoE FFN.
+
+    X [B, T, D] tokens; Gate [D, E]; W1 [E, D, H]; W2 [E, H, D].
+    Outs: Out [B, T, D], AuxLoss [] (Switch load-balance loss — add it
+    to the training objective scaled by attrs['aux_weight'] upstream).
+
+    Under a trace mesh with an 'ep' axis (attrs['axis']), experts shard
+    over 'ep' (leading dim of W1/W2) and tokens route via all_to_all
+    (parallel/moe.py); tokens additionally shard over dp/sp/ep when
+    divisible so no compute duplicates.  Dense fallback otherwise.
+
+    Capacity semantics match parallel.moe: per-shard capacity =
+    capacity_factor * local_tokens / n_experts, so the sharded and
+    dense paths agree exactly only when token counts per shard match
+    (the parity tests feed shard-divisible shapes).
+    """
+    from ..parallel import mesh as pmesh
+    from ..parallel.moe import moe_ffn_inner, reference_moe_ffn
+
+    x, wg = ins['X'][0], ins['Gate'][0]
+    w1, w2 = ins['W1'][0], ins['W2'][0]
+    axis = attrs.get('axis', 'ep')
+    cf = float(attrs.get('capacity_factor', 2.0))
+
+    mesh = pmesh.trace_mesh()
+    ep = pmesh.axis_size(mesh, axis)
+    if ep > 1 and w1.shape[0] % ep == 0:
+        b, t, d = x.shape
+        xspec, token_axes = _token_axes(mesh, (b, t), ('sp', axis))
+        xspec = P(*(list(xspec) + [None]))
+        b_loc = b // (mesh.shape['dp'] if 'dp' in token_axes else 1)
+        t_loc = t
+        for ax in token_axes:
+            if ax != 'dp':
+                t_loc //= mesh.shape[ax]
+
+        def inner(xl, wg_, w1_, w2_):
+            out, aux = moe_ffn_inner(
+                xl.reshape(b_loc * t_loc, d), wg_, w1_, w2_, axis, cf)
+            # aux is computed from this shard's tokens; average over
+            # every axis the tokens are split (or replicated) across
+            for ax in mesh.axis_names:
+                aux = jax.lax.pmean(aux, ax)
+            return out.reshape(b_loc, t_loc, d), aux
+
+        f = jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(xspec, P(), P(axis), P(axis)),
+            out_specs=(xspec, P()), check_vma=False)
+        out, aux = f(x, wg, w1, w2)
+        return {'Out': [out], 'AuxLoss': [aux]}
+    out, aux = reference_moe_ffn(x, wg, w1, w2, capacity_factor=cf)
+    return {'Out': [out], 'AuxLoss': [jnp.asarray(aux, jnp.float32)]}

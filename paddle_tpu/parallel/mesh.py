@@ -39,6 +39,41 @@ def create_mesh(dp=None, mp=1, pp=1, sp=1, ep=1, devices=None):
     return Mesh(arr, tuple(axes))
 
 
+# --- trace-time mesh context ------------------------------------------
+# The executor's GSPMD path (parallel_executor._run_segment_parallel)
+# publishes the active mesh here while a segment traces, so MESH-AWARE
+# op lowerings (ring_attention, moe_ffn in ops/parallel_ops.py) can
+# open a shard_map over named axes.  Thread-local: parallel test
+# runners trace independent programs concurrently.
+
+import contextlib
+import threading
+
+_TRACE = threading.local()
+
+
+@contextlib.contextmanager
+def use_trace_mesh(mesh):
+    prev = getattr(_TRACE, 'mesh', None)
+    _TRACE.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _TRACE.mesh = prev
+
+
+def trace_mesh():
+    """The mesh the current segment is being traced under, or None
+    (single-device executor path / inside an outer shard_map)."""
+    return getattr(_TRACE, 'mesh', None)
+
+
+def axis_size(mesh, name):
+    """Size of a named mesh axis, 1 when absent."""
+    return int(mesh.shape[name]) if (mesh is not None and
+                                     name in mesh.axis_names) else 1
+
+
 def set_global_mesh(mesh):
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh

@@ -33,7 +33,8 @@ def top1_gating(x, wg, n_experts, capacity):
     pos_in_expert = jnp.sum(pos, axis=-1)                   # [S]
     keep = pos_in_expert < capacity
     gate = jnp.max(probs * onehot, axis=-1) * keep          # [S]
-    pos_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)
     dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
     combine = dispatch * gate[:, None, None]
     # load-balance aux loss: E * sum_e fraction_e * mean_prob_e

@@ -206,13 +206,39 @@ def _chain_boundary_specs(raw_fns, stage_params, alive, x_micro_aval):
     return specs
 
 
+def _localize_aval(arr, spec, mesh):
+    """ShapeDtypeStruct of the PER-DEVICE shard of `arr` under `spec`."""
+    shape = list(arr.shape)
+    if spec is not None:
+        for i, entry in enumerate(tuple(spec)):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            for a in axes:
+                shape[i] //= mesh.shape[a]
+    return jax.ShapeDtypeStruct(tuple(shape), arr.dtype)
+
+
 def pipeline_forward_hetero(raw_fns, stage_params, x, mesh, alive,
                             union_keys, output_name, axis='pp',
-                            n_microbatches=4, step_idx=0):
+                            n_microbatches=4, step_idx=0,
+                            data_axis=None, param_specs=None):
     """GPipe forward over HETEROGENEOUS stages: every device applies its
-    own stage via lax.switch (params replicated; per-stage placement is
-    a memory follow-up); the ring buffer is a dict of boundary
-    activations hopping via ppermute."""
+    own stage via lax.switch; the ring buffer is a dict of boundary
+    activations hopping via ppermute.
+
+    Composable with the other mesh axes (the classic 3D layout):
+
+    - data_axis ('dp'): the micro-batch's batch dim shards over it, so
+      each dp row pipelines its own batch slice.
+    - param_specs {param_name: PartitionSpec}: per-param shardings over
+      e.g. 'mp' (Megatron tensor parallelism INSIDE a stage); the
+      program expresses the partial-sum reduction with a
+      c_allreduce_sum op whose ring maps to the 'mp' axis
+      (ops/collective_ops.RING_AXES), exactly how the reference writes
+      model-parallel programs (transpiler/collective.py inserts c_*
+      ops).  Unlisted params ride replicated.
+    """
     n_stages = mesh.shape[axis]
     if len(raw_fns) != n_stages:
         raise ValueError('%d stages but %s axis has %d devices'
@@ -221,10 +247,22 @@ def pipeline_forward_hetero(raw_fns, stage_params, x, mesh, alive,
     assert b % n_microbatches == 0, 'batch must divide microbatches'
     x_micro = x.reshape((n_microbatches, b // n_microbatches)
                         + x.shape[1:])
+    param_specs = param_specs or {}
+    pspec_trees = tuple({n: param_specs.get(n, P()) for n in sp}
+                        for sp in stage_params)
+    xspec = P(None, data_axis) if data_axis else P()
     in_key = sorted(alive[0])[0]
+    # boundary buffers live INSIDE the shard_map: size them from the
+    # PER-DEVICE avals (batch over data_axis, params over param_specs)
+    local_params = tuple(
+        {n: _localize_aval(sp[n], pspec_trees[s].get(n), mesh)
+         for n in sp}
+        for s, sp in enumerate(stage_params))
     specs = _chain_boundary_specs(
-        raw_fns, stage_params, alive,
-        jax.ShapeDtypeStruct(x_micro.shape[1:], x_micro.dtype))
+        raw_fns, local_params, alive,
+        _localize_aval(
+            jax.ShapeDtypeStruct(x_micro.shape[1:], x_micro.dtype),
+            P(data_axis) if data_axis else None, mesh))
     union_zero = {n: jnp.zeros(specs[n].shape, specs[n].dtype)
                   for n in union_keys}
 
@@ -278,20 +316,27 @@ def pipeline_forward_hetero(raw_fns, stage_params, x, mesh, alive,
 
     f = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(tuple(P() for _ in raw_fns), P()),
-        out_specs=P(), check_vma=False)
+        in_specs=(pspec_trees, xspec),
+        out_specs=xspec, check_vma=False)
     out = f(tuple(stage_params), x_micro)
     return out.reshape((b,) + out.shape[2:])
 
 
 def build_train_step(program, scope, input_name, cut_list,
                      output_name, loss_fn, mesh, axis='pp',
-                     n_microbatches=4, learning_rate=0.01):
+                     n_microbatches=4, learning_rate=0.01,
+                     data_axis=None, param_specs=None):
     """Compile a full GPipe SGD train step from a cut program.
 
     cut_list entries may be single var names or LISTS of var names per
     boundary (multi-slot scope queues); skip connections across stage
     boundaries ride the ring automatically.
+
+    data_axis/param_specs: compose the pipeline with data parallelism
+    (batch sharded over `data_axis`) and in-stage Megatron tensor
+    parallelism (params sharded per param_specs; the program carries
+    the c_allreduce_sum over the tensor axis) — the 3D dp x pp x mp
+    layout from ONE fluid Program.
 
     loss_fn(output, *labels) -> scalar is applied OUTSIDE the pipeline.
     Returns (step, params): step(params, x, *labels) -> (loss,
@@ -309,7 +354,8 @@ def build_train_step(program, scope, input_name, cut_list,
         def loss_of(params):
             out = pipeline_forward_hetero(
                 raw_fns, params, x, mesh, alive, union_keys,
-                output_name, axis, n_microbatches, step_idx=step_idx)
+                output_name, axis, n_microbatches, step_idx=step_idx,
+                data_axis=data_axis, param_specs=param_specs)
             return loss_fn(out, *labels)
         loss, grads = jax.value_and_grad(loss_of)(params)
         new_params = jax.tree.map(
