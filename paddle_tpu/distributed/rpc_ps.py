@@ -386,10 +386,11 @@ class PsClient(object):
                         'set_shard(%s): adagrad acc has %d entries for '
                         '%d rows' % (name, acc.size, k))
                 payload += struct.pack('<B', 1) + acc.tobytes()
-            elif 'm' in state:
-                # validate the full adam triple BEFORE packing: a
-                # partial dict must fail with a clear message, not a
-                # KeyError after the rows payload was built
+            elif {'m', 'v', 't'} & set(state):
+                # ANY adam key present means adam state intended:
+                # validate the full triple BEFORE packing — a partial
+                # dict (missing m included) must fail loudly, not ship
+                # rows with silently-zeroed optimizer state
                 missing = [key for key in ('m', 'v', 't')
                            if key not in state]
                 if missing:

@@ -37,6 +37,9 @@ from .io import (save_params, save_persistables, load_params,
                  load_inference_model)
 from . import metrics
 from . import profiler
+from . import trainer_desc  # noqa: F401
+from . import device_worker  # noqa: F401
+from .trainer_desc import TrainerFactory  # noqa: F401
 from . import dygraph
 from .dygraph.base import enable_dygraph, disable_dygraph
 from . import data_feeder

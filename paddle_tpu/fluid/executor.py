@@ -625,6 +625,49 @@ class Executor(object):
         self._post_step(program, scope)
         return out
 
+    def program_cost(self, program, feed, fetch_list=None, scope=None):
+        """XLA cost analysis summed over the program's device segments
+        for the given feed: {'flops', 'bytes'} per step.  The basis for
+        the benches' achieved-TFLOP/s and MFU reporting — XLA's own
+        count of what the compiled executable does, not a hand model.
+        Segments are lowered/compiled AOT here; the XLA compile caches
+        (service + persistent) dedupe against the run-path executables.
+        """
+        scope = scope or core.global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [v.name if isinstance(v, framework.Variable)
+                       else v for v in fetch_list]
+        plan = self._get_plan(program, tuple(sorted(feed.keys())),
+                              tuple(fetch_names))
+        total = {'flops': 0.0, 'bytes': 0.0}
+        device = self.place.jax_device()
+        prefer_test = any(isinstance(it, _Segment) and it.prefer_test
+                          for it in plan)
+        for item in plan:
+            if not isinstance(item, _Segment):
+                if item[0] == 'bucket':
+                    # stamp max_trip_count like the run path does, or
+                    # downstream segments cannot lower (they read the
+                    # bucketed trip bound at trace time)
+                    self._run_bucket_count(item[1], feed, scope,
+                                           device, prefer_test)
+                continue
+            fn = _make_segment_fn(item, item.prefer_test)
+            state = {n: self._lookup_input(n, feed, scope)
+                     for n in item.state_names}
+            data = {n: self._lookup_input(n, feed, scope)
+                    for n in item.input_names}
+            compiled = jax.jit(fn, donate_argnums=(1,)).lower(
+                self._step, state, data).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else {}
+            total['flops'] += float(ca.get('flops', 0.0) or 0.0)
+            total['bytes'] += float(ca.get('bytes accessed', 0.0)
+                                    or 0.0)
+        return total
+
     def _post_step(self, program, scope):
         """Per-step hooks shared by run() and CompiledPipeline: k-step
         LocalSGD sync and the async-PS grad push/param pull."""
@@ -648,12 +691,19 @@ class Executor(object):
     # ------------------------------------------------------------------
     def _get_plan(self, program, feed_names, fetch_names,
                   prefer_test=False):
+        from . import profiler as _profiler
+        # per-op profiling compiles every device op as its own one-op
+        # segment (separately cached), so each can be host-timed —
+        # the reference's per-op RecordEvent granularity
+        per_op = _profiler.is_enabled()
         # prefer_test keys the cache so test-mode lowering never shares
         # executables with the training-mode plan
-        key = ('plan', feed_names, fetch_names, id(self), prefer_test)
+        key = ('plan', feed_names, fetch_names, id(self), prefer_test,
+               per_op)
         plan = program._exec_cache.get(key)
         if plan is None:
-            plan = self._build_plan(program, feed_names, fetch_names)
+            plan = self._build_plan(program, feed_names, fetch_names,
+                                    per_op=per_op)
             if prefer_test:
                 for it in plan:
                     if isinstance(it, _Segment):
@@ -661,7 +711,8 @@ class Executor(object):
             program._exec_cache[key] = plan
         return plan
 
-    def _build_plan(self, program, feed_names, fetch_names):
+    def _build_plan(self, program, feed_names, fetch_names,
+                    per_op=False):
         block = program.global_block()
         items = []  # list of _Segment | ('host', op)
         cur = []
@@ -692,6 +743,17 @@ class Executor(object):
                 cur.append(op)
         if cur:
             items.append(_Segment(cur))
+
+        if per_op:
+            # profiling granularity: one op per segment (dataflow
+            # analysis below then scopes inputs/outputs per op)
+            split = []
+            for it in items:
+                if isinstance(it, _Segment):
+                    split.extend(_Segment([op]) for op in it.ops)
+                else:
+                    split.append(it)
+            items = split
 
         # dataflow analysis: inputs / outputs per segment
         feed_set = set(feed_names)
@@ -746,7 +808,12 @@ class Executor(object):
                               else v)
         prefer_test = any(isinstance(it, _Segment) and it.prefer_test
                           for it in plan)
+        from . import profiler as _profiler
+        prof = _profiler.is_enabled()
         for item in plan:
+            if prof:
+                import time as _time
+                t0 = _time.perf_counter()
             if isinstance(item, _Segment):
                 self._run_segment(item, feed, scope, device, fetched)
             elif item[0] == 'bucket':
@@ -755,6 +822,17 @@ class Executor(object):
             else:
                 op = item[1]
                 registry.get(op.type).fn(self, scope, op)
+            if prof:
+                if isinstance(item, _Segment):
+                    # host-time to COMPLETION, not dispatch
+                    for n in item.output_names:
+                        if n in fetched:
+                            jax.block_until_ready(fetched[n])
+                    name = item.ops[0].type if len(item.ops) == 1 \
+                        else 'segment[%d ops]' % len(item.ops)
+                else:
+                    name = item[1].type
+                _profiler.record_op(name, _time.perf_counter() - t0)
         results = []
         for name in fetch_names:
             if name in fetched:
@@ -906,14 +984,40 @@ def _train_or_infer_from_dataset(executor, program, dataset, scope,
     Reference: executor.py:1115 train_from_dataset -> TrainerFactory ->
     MultiTrainer threads (framework/trainer.h:64, hogwild_worker.cc:163).
     TPU-native: the native feeder (runtime/datafeed.cc) overlaps parsing
-    with device steps; the jitted segment is the 'device worker'."""
+    with device steps; the jitted segment is the 'device worker'.
+    thread=N (N>1) adds the Hogwild-worker overlap that remains
+    meaningful on one XLA device: an N-deep background prefetch queue
+    staging batches onto the device while the current step runs (the
+    N-workers-one-queue shape; true hogwild param racing has no analog
+    under jit, and the reference's N>1 result is nondeterministic
+    anyway)."""
     program = program or framework.default_main_program()
     scope = scope or core.global_scope()
     fetch_list = fetch_list or []
     fetch_names = [v.name if isinstance(v, framework.Variable) else v
                    for v in fetch_list]
+    # trainer/worker config plane (reference TrainerFactory in
+    # executor.py:962): fleet opt_info picks the trainer class and can
+    # set thread_num when the call leaves thread=0
+    from .trainer_desc import TrainerFactory
+    opt_info = getattr(program, '_fleet_opt', None)
+    trainer = TrainerFactory()._create_trainer(opt_info)
+    trainer._set_program(program)
+    trainer._set_debug(debug)
+    if thread:
+        trainer._set_thread(thread)
+    elif not opt_info or 'thread_num' not in opt_info:
+        trainer._set_thread(0)  # serial default without explicit config
+    trainer._gen_trainer_desc()
+    thread = trainer.proto_desc['thread_num']
     step = 0
-    for feed in dataset.batches():
+    if thread and int(thread) > 1:
+        from .reader import _AsyncBatchIterator
+        batches = _AsyncBatchIterator(dataset.batches, int(thread),
+                                      executor.place.jax_device())
+    else:
+        batches = dataset.batches()
+    for feed in batches:
         fetches = fetch_names if (fetch_names and print_period and
                                   step % print_period == 0) else []
         out = executor.run(program, feed=feed, fetch_list=fetches,

@@ -27,22 +27,80 @@ def is_persistable(var):
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, save_format='native'):
+    """save_format='native': one .npz (the default everywhere).
+    save_format='paddle': the reference's binary LoDTensor layout —
+    one file per var named after it (save_op.cc), or all streams
+    concatenated into `filename` (save_combine_op.h) — so models
+    trained here load in reference fluid unchanged."""
     main_program = main_program or framework.default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if (predicate is None or predicate(v))]
     scope = core.global_scope()
     os.makedirs(dirname, exist_ok=True)
-    if filename is None:
-        filename = '__model_params__'
-    arrs = {}
+    arrs = []
     for v in vars:
         val = scope.find_var(v.name)
         if val is None:
             raise RuntimeError('save: var %s not in scope' % v.name)
-        arrs[v.name] = np.asarray(core.as_array(val))
-    np.savez(os.path.join(dirname, filename + '.npz'), **arrs)
+        arrs.append((v.name, np.asarray(core.as_array(val))))
+    if save_format == 'paddle':
+        from . import paddle_format
+        if filename is not None:
+            paddle_format.save_tensors(os.path.join(dirname, filename),
+                                       arrs)
+        else:
+            for name, arr in arrs:
+                paddle_format.save_tensors(os.path.join(dirname, name),
+                                           [(name, arr)])
+        return
+    if save_format != 'native':
+        raise ValueError("save_format must be 'native' or 'paddle'")
+    if filename is None:
+        filename = '__model_params__'
+    np.savez(os.path.join(dirname, filename + '.npz'), **dict(arrs))
+
+
+def _load_vars_paddle_format(dirname, vars, filename):
+    """Reference-format fallback: per-var LoDTensor files (save_op.cc)
+    or one combined stream (save_combine_op.h, records in the SAME var
+    order the saver iterated — the program's var order, which both
+    sides derive from the same program)."""
+    from . import paddle_format
+    scope = core.global_scope()
+    if filename is not None and os.path.exists(
+            os.path.join(dirname, filename)):
+        records = paddle_format.load_tensors(
+            os.path.join(dirname, filename))
+        if len(records) != len(vars):
+            raise RuntimeError(
+                'combined params file %s holds %d tensors, program '
+                'expects %d' % (filename, len(records), len(vars)))
+        for v, (arr, _lod) in zip(vars, records):
+            scope.set_var(v.name, arr)
+        return
+    for v in vars:
+        path = os.path.join(dirname, v.name)
+        if not os.path.exists(path):
+            raise RuntimeError('load: var %s missing in checkpoint dir '
+                               '%s' % (v.name, dirname))
+        (arr, _lod), = paddle_format.load_tensors(path, count=1)
+        scope.set_var(v.name, arr)
+
+
+def _dir_is_paddle_format(dirname, vars, filename):
+    from . import paddle_format
+    if filename is not None:
+        p = os.path.join(dirname, filename)
+        if os.path.exists(p) and not p.endswith('.npz'):
+            return paddle_format.looks_like_lod_tensor_file(p)
+    for v in vars[:3]:
+        p = os.path.join(dirname, v.name)
+        if os.path.exists(p) and \
+                paddle_format.looks_like_lod_tensor_file(p):
+            return True
+    return False
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
@@ -51,9 +109,14 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if (predicate is None or predicate(v))]
-    if filename is None:
-        filename = '__model_params__'
-    data = np.load(os.path.join(dirname, filename + '.npz'))
+    npz = os.path.join(dirname, (filename or '__model_params__') +
+                       '.npz')
+    if not os.path.exists(npz) and _dir_is_paddle_format(
+            dirname, vars, filename):
+        # directory written by reference fluid: binary LoDTensor files
+        _load_vars_paddle_format(dirname, vars, filename)
+        return
+    data = np.load(npz)
     scope = core.global_scope()
     for v in vars:
         if v.name not in data:
@@ -62,10 +125,12 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         scope.set_var(v.name, data[v.name])
 
 
-def save_params(executor, dirname, main_program=None, filename=None):
+def save_params(executor, dirname, main_program=None, filename=None,
+                save_format='native'):
     main_program = main_program or framework.default_main_program()
     save_vars(executor, dirname, main_program,
-              vars=main_program.all_parameters(), filename=filename)
+              vars=main_program.all_parameters(), filename=filename,
+              save_format=save_format)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -91,10 +156,12 @@ def _program_ps_tables(program):
     return [HostShardedEmbedding._REGISTRY[n] for n in names]
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      save_format='native'):
     main_program = main_program or framework.default_main_program()
     save_vars(executor, dirname, main_program,
-              vars=_persistable_vars(main_program), filename=filename)
+              vars=_persistable_vars(main_program), filename=filename,
+              save_format=save_format)
     tables = _program_ps_tables(main_program)
     if tables:
         arrs = {}
@@ -157,7 +224,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     model_filename = model_filename or '__model__'
-    with open(os.path.join(dirname, model_filename + '.json')) as f:
+    json_path = os.path.join(dirname, model_filename + '.json')
+    if not os.path.exists(json_path) and os.path.exists(
+            os.path.join(dirname, model_filename)):
+        # binary __model__ written by reference fluid: parse the
+        # ProgramDesc protobuf and its feed/fetch scaffolding
+        return _load_reference_inference_model(
+            dirname, model_filename, params_filename)
+    with open(json_path) as f:
         model = json.load(f)
     program = Program.from_dict(model['program'])
     load_persistables(executor, dirname, program,
@@ -165,6 +239,22 @@ def load_inference_model(dirname, executor, model_filename=None,
     fetch_vars = [program.global_block().var(n)
                   for n in model['fetch_names']]
     return program, model['feed_names'], fetch_vars
+
+
+def _load_reference_inference_model(dirname, model_filename,
+                                    params_filename):
+    """save_inference_model layout as reference fluid writes it:
+    binary ProgramDesc in `__model__`, params as per-var LoDTensor
+    files (or one combined `params_filename`)."""
+    from . import paddle_format
+    with open(os.path.join(dirname, model_filename), 'rb') as f:
+        program = paddle_format.parse_program_desc(f.read())
+    program, feed_names, fetch_names = \
+        paddle_format.strip_feed_fetch(program)
+    persistables = _persistable_vars(program)
+    _load_vars_paddle_format(dirname, persistables, params_filename)
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
 
 
 def save_train_model(dirname, main_program, startup_program, feed_names,

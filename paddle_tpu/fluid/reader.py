@@ -1,6 +1,15 @@
 """DataLoader. Reference: python/paddle/fluid/reader.py —
 DataLoader.from_generator(:75) feeding a LoDTensorBlockingQueue(:298),
-DataLoader.from_dataset(:261) over the Dataset runtime.
+DataLoader.from_dataset(:261) over the Dataset runtime, double-buffered
+to the device by operators/reader/buffered_reader.cc.
+
+TPU-native async pipeline: a background thread drains the user
+generator into a bounded queue (`capacity` — the LoDTensorBlockingQueue
+analog) and, with use_double_buffer, stages each batch onto the device
+with jax.device_put as it is enqueued.  device_put returns immediately
+(the H2D DMA runs behind the XLA stream), so the NEXT batch's transfer
+overlaps the CURRENT step's compute — buffered_reader's double buffer
+without a dedicated stream API.
 
 The LoD-replacement front-end lives here too: BucketedGeneratorLoader
 groups genuinely ragged samples into a small set of padded shapes
@@ -9,9 +18,127 @@ bounded recompiles where the reference used LoD offset vectors
 (framework/lod_tensor.h:219, operators/math/sequence_padding.h).
 """
 
+import queue as _queue
+import threading
+
 import numpy as np
 
 from . import core
+
+
+class _AsyncBatchIterator(object):
+    """Background-thread prefetch over a batch generator: the
+    LoDTensorBlockingQueue + buffered_reader pair.
+
+    The HOST queue holds up to `capacity` numpy batches (the blocking
+    queue); the DEVICE window stages only `stage_depth` (default 2,
+    buffered_reader.cc's depth) of them onto `device` with
+    jax.device_put — so capacity bounds host memory, not HBM.  Staging
+    happens in the consumer's next(): jit dispatch is async, so the
+    device_put DMA for batch N+1/N+2 overlaps batch N's compute.
+
+    Producer exceptions re-raise at the consumer's next(); exhaustion
+    is sticky (every later next() raises StopIteration again); close()
+    (or GC) stops the producer without draining the generator."""
+
+    _END = object()
+
+    def __init__(self, gen, capacity, device=None, stage_depth=2):
+        self._q = _queue.Queue(maxsize=max(1, int(capacity)))
+        self._stop = threading.Event()
+        self._exc = None
+        self._device = device
+        self._staged = []
+        self._stage_depth = max(1, int(stage_depth))
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._work, args=(gen,), daemon=True)
+        self._thread.start()
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _work(self, gen):
+        try:
+            for batch in gen():
+                if not self._put(batch):
+                    return
+        except BaseException as e:  # noqa: B036 — must cross threads
+            self._exc = e
+        finally:
+            self._put(self._END)
+
+    def _stage(self, batch):
+        if self._device is None:
+            return batch
+        import jax
+        out = {}
+        for k, v in batch.items():
+            if isinstance(v, core.LoDTensor):
+                v = v.data
+            if isinstance(v, (np.ndarray, np.generic)) or not hasattr(
+                    v, 'devices'):
+                v = jax.device_put(np.asarray(v), self._device)
+            out[k] = v
+        return out
+
+    def _fill_window(self):
+        while not self._done and len(self._staged) < self._stage_depth:
+            if self._staged:
+                # window non-empty: only top up opportunistically, a
+                # slow producer must not block the consumer here
+                try:
+                    item = self._q.get_nowait()
+                except _queue.Empty:
+                    return
+            else:
+                item = self._q.get()
+            if item is self._END:
+                self._done = True
+                self._stop.set()
+                return
+            self._staged.append(self._stage(item))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill_window()
+        if not self._staged:
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        batch = self._staged.pop(0)
+        self._fill_window()  # keep the DMA window ahead of compute
+        return batch
+
+    next = __next__
+
+    def close(self):
+        self._stop.set()
+        self._done = True
+        self._staged = []
+        # unblock a producer parked on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def __del__(self):  # best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 
 
 class DataLoader(object):
@@ -31,8 +158,10 @@ class DataLoader(object):
                 feed_list, bucket_boundaries, batch_size,
                 mask_map=mask_map, drop_last=drop_last,
                 capacity=capacity, iterable=iterable,
-                ragged_fields=ragged_fields)
-        return GeneratorLoader(feed_list, capacity, iterable)
+                ragged_fields=ragged_fields,
+                use_double_buffer=use_double_buffer)
+        return GeneratorLoader(feed_list, capacity, iterable,
+                               use_double_buffer=use_double_buffer)
 
     @staticmethod
     def from_dataset(dataset, places, drop_last=True):
@@ -76,12 +205,29 @@ class DatasetLoader(object):
 
 
 class GeneratorLoader(object):
-    def __init__(self, feed_list, capacity=64, iterable=True):
+    def __init__(self, feed_list, capacity=64, iterable=True,
+                 use_double_buffer=True):
         self._feed_list = feed_list or []
         self._capacity = capacity
         self._iterable = iterable
+        self._use_double_buffer = use_double_buffer
         self._generator = None
         self._places = None
+        self._iter = None
+
+    def _target_device(self):
+        """Device the double buffer stages onto (first place passed to
+        set_*_generator, else device 0)."""
+        if not self._use_double_buffer:
+            return None
+        place = self._places[0] if isinstance(
+            self._places, (list, tuple)) and self._places else \
+            (self._places or core.XLAPlace(0))
+        try:
+            return place.jax_device()
+        except Exception:
+            import jax
+            return jax.devices()[0]
 
     def set_sample_generator(self, reader, batch_size, drop_last=True,
                              places=None):
@@ -98,6 +244,7 @@ class GeneratorLoader(object):
 
     def set_sample_list_generator(self, reader, places=None):
         from .data_feeder import DataFeeder
+        self._places = places
         place = places[0] if isinstance(places, (list, tuple)) else \
             (places or core.XLAPlace(0))
         feeder = DataFeeder(self._feed_list, place)
@@ -109,6 +256,8 @@ class GeneratorLoader(object):
         return self
 
     def set_batch_generator(self, reader, places=None):
+        self._places = places
+
         def gen():
             for batch in reader():
                 if isinstance(batch, dict):
@@ -119,19 +268,33 @@ class GeneratorLoader(object):
         self._generator = gen
         return self
 
-    def __iter__(self):
+    def _make_iter(self):
         if self._generator is None:
             raise RuntimeError('DataLoader: call set_*_generator first')
-        return iter(self._generator())
+        # one live prefetch pipeline per loader: an abandoned earlier
+        # iteration (early break) is closed here so its thread and
+        # device-staged batches don't linger until GC
+        prev = getattr(self, '_live_iter', None)
+        if prev is not None:
+            prev.close()
+        it = _AsyncBatchIterator(self._generator, self._capacity,
+                                 self._target_device())
+        self._live_iter = it
+        return it
+
+    def __iter__(self):
+        return self._make_iter()
 
     def start(self):
-        self._iter = iter(self._generator())
+        self._iter = self._make_iter()
 
     def next(self):
         return next(self._iter)
 
     def reset(self):
-        self._iter = iter(self._generator())
+        if self._iter is not None:
+            self._iter.close()
+        self._iter = self._make_iter()
 
 
 
@@ -156,9 +319,11 @@ class BucketedGeneratorLoader(GeneratorLoader):
 
     def __init__(self, feed_list, bucket_boundaries, batch_size,
                  mask_map=None, drop_last=False, capacity=64,
-                 iterable=True, ragged_fields=None):
+                 iterable=True, ragged_fields=None,
+                 use_double_buffer=True):
         super(BucketedGeneratorLoader, self).__init__(
-            feed_list, capacity, iterable)
+            feed_list, capacity, iterable,
+            use_double_buffer=use_double_buffer)
         self.boundaries = sorted(int(b) for b in bucket_boundaries)
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -253,7 +418,9 @@ class PyReader(GeneratorLoader):
 
     def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
                  iterable=True, return_list=False):
-        super(PyReader, self).__init__(feed_list, capacity, iterable)
+        super(PyReader, self).__init__(
+            feed_list, capacity, iterable,
+            use_double_buffer=use_double_buffer)
         self._return_list = return_list
         self._started = False
 
@@ -275,10 +442,12 @@ class PyReader(GeneratorLoader):
 
     def start(self):
         self._started = True
-        self._iter = iter(self._generator())
+        self._iter = self._make_iter()
 
     def reset(self):
         self._started = False
+        if self._iter is not None:
+            self._iter.close()
         self._iter = None
 
     def next(self):

@@ -31,9 +31,20 @@ from jax.experimental import pallas as pl
 # extra VMEM residency.  512/1024 is the best compiling config at seq
 # 2048 (39.1 ms vs 69.1 ms at 256/256 and 77.7 ms naive XLA) and
 # clamps to 512/512 at seq 512 (5.6 ms vs 7.2 ms naive); 2048-wide
-# blocks exceed VMEM and fail to compile.
+# blocks exceed VMEM — _block_sizes clamps them (see VMEM model there).
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
+
+# Measured flash-vs-naive crossover (fwd+bwd, BENCHMARKS.md round-3/4
+# tables): below this sequence length XLA's fused dense chain fits
+# VMEM outright and beats the kernel, so flash_attention() auto-selects
+# the dense path — the public entry never ships the regression pocket.
+FLASH_MIN_SEQ = 512
+
+# VMEM budget for the block-size clamp.  v5e cores have 16 MB less
+# scratch/compiler overhead; 10 MB keeps every swept config compiling
+# with headroom.
+VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
@@ -242,13 +253,33 @@ def _on_tpu():
         return False
 
 
-def _block_sizes(t, block_q, block_k):
+def _vmem_estimate(t, d, block_q, block_k, itemsize):
+    """Bytes a kernel instance keeps resident in VMEM.  Dominant terms
+    across the three kernels: the full K and V rows (streamed via
+    dslice but block-spec'd whole), the q/o/do row blocks, and the f32
+    p/s score blocks (plus their exp/corr temporaries -> x3)."""
+    kv = 2 * t * d * itemsize
+    rows = 3 * block_q * d * itemsize
+    scores = 3 * block_q * block_k * 4
+    return kv + rows + scores + (1 << 18)  # fixed slack
+
+
+def _block_sizes(t, block_q, block_k, d=64, itemsize=2):
+    """Clamp requested blocks to divide t AND fit the VMEM budget —
+    an oversized config degrades to the largest fitting one instead of
+    failing to compile (round-3's 2048-wide failure mode)."""
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     while t % block_q:
         block_q //= 2
     while t % block_k:
         block_k //= 2
+    while _vmem_estimate(t, d, block_q, block_k, itemsize) > \
+            VMEM_BUDGET_BYTES and max(block_q, block_k) > 128:
+        if block_k >= block_q and block_k > 128:
+            block_k //= 2
+        else:
+            block_q //= 2
     return block_q, block_k
 
 
@@ -256,7 +287,8 @@ def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
     """q,k,v: [BH, T, D], bias: [B, T] or None
     -> (o [BH,T,D], lse [BH,T])."""
     bh, t, d = q.shape
-    block_q, block_k = _block_sizes(t, block_q, block_k)
+    block_q, block_k = _block_sizes(t, block_q, block_k, d,
+                                    q.dtype.itemsize)
     scale = 1.0 / (d ** 0.5)
     has_bias = bias is not None
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
@@ -293,7 +325,8 @@ def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
 def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
                block_k, interpret):
     bh, t, d = q.shape
-    block_q, block_k = _block_sizes(t, block_q, block_k)
+    block_q, block_k = _block_sizes(t, block_q, block_k, d,
+                                    q.dtype.itemsize)
     scale = 1.0 / (d ** 0.5)
     # delta = rowsum(dO * O): one fused elementwise+reduce in XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -465,10 +498,37 @@ def _flash_bwd_rule(h, causal, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, causal=False, key_bias=None):
+def _dense_path(q, k, v, causal, key_bias):
+    """Fused-by-XLA dense chain on [B, T, H, D] (bf16 dots, f32
+    softmax) — the measured winner below FLASH_MIN_SEQ, where the
+    whole chain fits VMEM outright.  Differentiable via XLA autodiff."""
+    d = q.shape[-1]
+    s = jnp.einsum('bthd,bshd->bhts', q, k,
+                   preferred_element_type=jnp.float32) / (d ** 0.5)
+    if key_bias is not None:
+        s = s + key_bias.astype(jnp.float32)[:, None, None, :]
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum('bhts,bshd->bthd', p, v)
+
+
+def flash_attention(q, k, v, causal=False, key_bias=None,
+                    min_seq=None):
     """q,k,v: [B, T, H, D]; key_bias: optional [B, T] additive score
-    bias (e.g. padding mask as 0 / -10000) -> [B, T, H, D]."""
+    bias (e.g. padding mask as 0 / -10000) -> [B, T, H, D].
+
+    Auto-dispatch: sequences shorter than `min_seq` (default
+    FLASH_MIN_SEQ, the measured crossover) run the dense XLA chain —
+    the entry point never loses to naive.  Pass min_seq=0 to force the
+    Pallas kernels (benchmark sweeps)."""
     b, t, h, d = q.shape
+    if min_seq is None:
+        min_seq = FLASH_MIN_SEQ
+    if t < min_seq:
+        return _dense_path(q, k, v, causal, key_bias)
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)

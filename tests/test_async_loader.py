@@ -1,0 +1,263 @@
+"""Async double-buffered input pipeline (round-4 VERDICT item 4).
+
+Reference: operators/reader/buffered_reader.cc (double-buffer batches
+to the device) + python/paddle/fluid/reader.py:298 (GeneratorLoader
+over LoDTensorBlockingQueue).  The rebuild's GeneratorLoader now runs
+the user generator on a background thread into a bounded queue
+(capacity) and stages batches onto the device as they are enqueued
+(use_double_buffer) — these tests pin the semantics the parameters
+promise."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.reader import _AsyncBatchIterator
+
+
+def _feed_vars():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+    return main, startup, [x, y]
+
+
+def test_loader_preserves_order_and_values():
+    _, _, feeds = _feed_vars()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=feeds, capacity=4, use_double_buffer=True)
+
+    def gen():
+        for i in range(10):
+            yield {'x': np.full((2, 4), i, 'float32'),
+                   'y': np.full((2, 1), i, 'float32')}
+    loader.set_batch_generator(gen)
+    seen = [float(np.asarray(b['x']).ravel()[0]) for b in loader]
+    assert seen == [float(i) for i in range(10)]
+    # a second iteration re-runs the generator from scratch
+    seen2 = [float(np.asarray(b['x']).ravel()[0]) for b in loader]
+    assert seen2 == seen
+
+
+def test_double_buffer_stages_batches_on_device():
+    import jax
+    _, _, feeds = _feed_vars()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=feeds, capacity=2, use_double_buffer=True)
+    loader.set_batch_generator(
+        lambda: iter([{'x': np.zeros((2, 4), 'float32'),
+                       'y': np.zeros((2, 1), 'float32')}]))
+    batch = next(iter(loader))
+    assert isinstance(batch['x'], jax.Array)
+    # no double buffer -> host arrays pass through untouched
+    loader2 = fluid.io.DataLoader.from_generator(
+        feed_list=feeds, capacity=2, use_double_buffer=False)
+    loader2.set_batch_generator(
+        lambda: iter([{'x': np.zeros((2, 4), 'float32'),
+                       'y': np.zeros((2, 1), 'float32')}]))
+    batch2 = next(iter(loader2))
+    assert isinstance(batch2['x'], np.ndarray)
+
+
+def test_capacity_bounds_producer_runahead():
+    """With a slow consumer the producer must park at `capacity`
+    batches ahead, not drain the generator eagerly."""
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield {'x': np.full((1,), i, 'float32')}
+
+    it = _AsyncBatchIterator(gen, capacity=3, device=None)
+    next(it)
+    time.sleep(0.3)  # producer free-runs until the queue fills
+    # bounded by capacity(3) + stage window(2) + in-hand(1) + consumed
+    assert len(produced) <= 8, produced
+    it.close()
+
+
+def test_exhaustion_is_sticky():
+    """next() after StopIteration must raise StopIteration again, not
+    park forever on an empty queue."""
+    it = _AsyncBatchIterator(
+        lambda: iter([{'x': np.zeros(1, 'float32')}]), capacity=2,
+        device=None)
+    assert next(it) is not None
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(it)
+
+
+def test_new_iteration_closes_abandoned_one():
+    _, _, feeds = _feed_vars()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=feeds, capacity=2, use_double_buffer=False)
+
+    def gen():
+        for i in range(100):
+            yield {'x': np.full((1,), i, 'float32')}
+    loader.set_batch_generator(gen)
+    it1 = iter(loader)
+    next(it1)
+    it2 = iter(loader)  # must close it1's pipeline
+    assert it1._stop.is_set()
+    assert float(np.asarray(next(it2)['x'])[0]) == 0.0
+    loader._live_iter.close()
+
+
+def test_producer_exception_reraises_at_consumer():
+    def gen():
+        yield {'x': np.zeros(1, 'float32')}
+        raise RuntimeError('boom in the reader thread')
+
+    it = _AsyncBatchIterator(gen, capacity=2, device=None)
+    next(it)
+    with pytest.raises(RuntimeError, match='boom in the reader'):
+        next(it)
+
+
+def test_early_break_stops_producer_without_deadlock():
+    stopped = threading.Event()
+
+    def gen():
+        try:
+            for i in range(10 ** 6):
+                yield {'x': np.full((1,), i, 'float32')}
+        finally:
+            stopped.set()
+
+    it = _AsyncBatchIterator(gen, capacity=2, device=None)
+    for k, _ in enumerate(it):
+        if k == 3:
+            break
+    it.close()
+    # producer notices the stop within its put timeout
+    assert stopped.wait(2.0) or it._thread.join(2.0) is None
+    assert not it._thread.is_alive()
+
+
+def test_training_through_async_loader_learns():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        p = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype('float32')
+
+    def gen():
+        for _ in range(40):
+            xb = rng.randn(16, 4).astype('float32')
+            yield {'x': xb, 'y': xb @ w}
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=8, use_double_buffer=True)
+    loader.set_batch_generator(gen)
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for batch in loader:
+            l, = exe.run(main, feed=batch, fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    assert len(losses) == 40
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_train_from_dataset_thread_prefetch(tmp_path):
+    """thread=N now drives the N-deep device prefetch (was a silent
+    no-op — round-3 VERDICT weak #5); result must match the serial
+    path's step count and still learn."""
+    from tests.test_dataset_trainer import _write_ctr_file
+    rng = np.random.RandomState(1)
+    path = str(tmp_path / 'train.txt')
+    _write_ctr_file(path, 640, rng)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = layers.data('dense', shape=[4], dtype='float32')
+        ids = layers.data('ids', shape=[3], dtype='int64')
+        label = layers.data('label', shape=[1], dtype='int64')
+        emb = layers.embedding(ids, size=[50, 8])
+        emb = layers.reshape(emb, [0, 24])
+        h = layers.fc(layers.concat([dense, emb], axis=1), 32,
+                      act='relu')
+        logit = layers.fc(h, 1)
+        loss = layers.mean(
+            layers.sigmoid_cross_entropy_with_logits(
+                logit, layers.cast(label, 'float32')))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    dataset = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    dataset.set_batch_size(64)
+    dataset.set_thread(2)
+    dataset.set_filelist([path])
+    dataset.set_use_var([dense, ids, label])
+    dataset.load_into_memory()
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        steps = exe.train_from_dataset(main, dataset, thread=4,
+                                       fetch_list=[loss],
+                                       print_period=5)
+    assert steps == 10, steps
+
+
+def test_trainer_desc_and_factory_surface():
+    """TrainerDesc/DeviceWorker config plane (reference
+    trainer_desc.py:21, device_worker.py:19, trainer_factory.py:23):
+    the knobs must be real state, the factory must map fleet opt_info
+    to trainer+worker classes, and junk must raise."""
+    from paddle_tpu.fluid.trainer_desc import (
+        TrainerDesc, MultiTrainer, DistMultiTrainer, PipelineTrainer,
+        TrainerFactory)
+    from paddle_tpu.fluid.device_worker import (
+        DeviceWorker, Hogwild, DownpourSGD, Section,
+        DeviceWorkerFactory)
+
+    t = TrainerFactory()._create_trainer(None)
+    assert isinstance(t, MultiTrainer)
+    assert isinstance(t._device_worker, Hogwild)
+    t._gen_trainer_desc()
+    assert t._desc()['device_worker_name'] == 'HogwildWorker'
+
+    t2 = TrainerFactory()._create_trainer(
+        {'trainer': 'DistMultiTrainer', 'device_worker': 'DownpourSGD',
+         'fleet_desc': {'tables': 1}, 'thread_num': 7})
+    assert isinstance(t2, DistMultiTrainer)
+    assert isinstance(t2._device_worker, DownpourSGD)
+    t2._gen_trainer_desc()
+    d = t2._desc()
+    assert d['thread_num'] == 7
+    assert d['device_worker_name'] == 'DownpourWorker'
+    assert d['fleet_desc'] == {'tables': 1}
+
+    t3 = TrainerFactory()._create_trainer(
+        {'trainer': 'PipelineTrainer', 'device_worker': 'Section'})
+    assert isinstance(t3, PipelineTrainer)
+    assert isinstance(t3._device_worker, Section)
+
+    class V:
+        name = 'v'
+    td = TrainerDesc()
+    td._set_fetch_var_and_info([V()], ['loss: '], 5)
+    td._set_debug(True)
+    fc = td._desc()['fetch_config']
+    assert fc['fetch_var_names'] == ['v'] and fc['print_period'] == 5
+    assert td._desc()['debug'] is True
+
+    with pytest.raises(ValueError):
+        TrainerFactory()._create_trainer({'trainer': 'NopeTrainer'})
+    with pytest.raises(ValueError):
+        DeviceWorkerFactory()._create_device_worker('nope')
+    with pytest.raises(NotImplementedError):
+        DeviceWorker()._gen_worker_desc({})

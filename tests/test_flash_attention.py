@@ -157,3 +157,49 @@ def test_ring_flash_attention_parity(causal):
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_auto_dispatch_and_vmem_clamp():
+    """Round-4 VERDICT item 7: below the measured crossover the public
+    entry runs the dense XLA chain (same math), and oversized block
+    configs clamp to the VMEM budget instead of failing to compile."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    rng = np.random.RandomState(7)
+    b, t, h, d = 2, 128, 2, 64
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    assert t < fa.FLASH_MIN_SEQ  # the regression pocket
+    o_auto = fa.flash_attention(q, k, v, causal=True)
+    o_forced = fa.flash_attention(q, k, v, causal=True, min_seq=0)
+    o_dense = fa.flash_attention(q, k, v, causal=True, min_seq=10 ** 9)
+    np.testing.assert_allclose(np.asarray(o_auto), np.asarray(o_dense),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o_forced),
+                               np.asarray(o_dense), rtol=2e-4,
+                               atol=2e-5)
+    # dense fallback honors the key bias too
+    bias = jnp.asarray(rng.randn(b, t) * -2.0, jnp.float32)
+    ob_auto = fa.flash_attention(q, k, v, key_bias=bias)
+    ob_forced = fa.flash_attention(q, k, v, key_bias=bias, min_seq=0)
+    np.testing.assert_allclose(np.asarray(ob_auto),
+                               np.asarray(ob_forced), rtol=2e-4,
+                               atol=2e-5)
+    # grads agree across the dispatch boundary
+    gf = jax.grad(lambda q_: jnp.sum(
+        fa.flash_attention(q_, k, v, causal=True, min_seq=0) ** 2))(q)
+    gd = jax.grad(lambda q_: jnp.sum(
+        fa.flash_attention(q_, k, v, causal=True,
+                           min_seq=10 ** 9) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=5e-3, atol=5e-4)
+    # oversized blocks degrade inside the budget, never raise
+    bq, bk = fa._block_sizes(4096, 4096, 4096, d=128, itemsize=2)
+    assert fa._vmem_estimate(4096, 128, bq, bk, 2) <= \
+        fa.VMEM_BUDGET_BYTES
+    # d=128 runs through the kernels (interpret off-TPU)
+    q2 = jnp.asarray(rng.randn(1, 64, 2, 128), jnp.float32)
+    o2 = fa.flash_attention(q2, q2, q2, min_seq=0)
+    assert o2.shape == (1, 64, 2, 128)

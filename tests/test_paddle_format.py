@@ -1,0 +1,294 @@
+"""Reference binary checkpoint/model format interop (round-4 VERDICT
+item 5).
+
+Golden-bytes cross-checks: the expected bytes are built (a) fully by
+hand from the documented stream layout (lod_tensor.cc:219,
+tensor_util.cc TensorToStream) and (b) with REAL protobuf — protoc
+compiles the reference's framework.proto and google.protobuf encodes
+the ProgramDesc — so the hand-rolled wire codec is validated against
+an independent implementation, not against itself."""
+
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid import paddle_format as pf
+
+REFERENCE_PROTO = '/root/reference/paddle/fluid/framework/framework.proto'
+
+
+@pytest.fixture(scope='module')
+def framework_pb2(tmp_path_factory):
+    if not os.path.exists(REFERENCE_PROTO):
+        pytest.skip('reference framework.proto unavailable')
+    d = tmp_path_factory.mktemp('pb')
+    import shutil
+    shutil.copy(REFERENCE_PROTO, d / 'framework.proto')
+    subprocess.run(['protoc', '--python_out=.', 'framework.proto'],
+                   cwd=d, check=True)
+    sys.path.insert(0, str(d))
+    try:
+        import framework_pb2 as mod
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def test_lod_tensor_golden_bytes(tmp_path):
+    """[2,3] f32 vs the byte layout SerializeToStream documents."""
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    path = str(tmp_path / 't')
+    pf.save_tensors(path, [('t', arr)])
+    got = open(path, 'rb').read()
+    desc = (b'\x08\x05'        # field 1 varint: data_type FP32 (5)
+            b'\x10\x02'        # field 2 varint: dim 2
+            b'\x10\x03')       # field 2 varint: dim 3
+    want = (struct.pack('<I', 0) +      # LoDTensor version
+            struct.pack('<Q', 0) +      # lod levels
+            struct.pack('<I', 0) +      # Tensor version
+            struct.pack('<i', len(desc)) + desc +
+            arr.tobytes())
+    assert got == want
+    (back, lod), = pf.load_tensors(path, count=1)
+    np.testing.assert_array_equal(back, arr)
+    assert lod == []
+
+
+def test_tensor_desc_matches_real_protobuf(framework_pb2):
+    """Our TensorDesc encoder must byte-match google.protobuf's."""
+    d = framework_pb2.VarType.TensorDesc()
+    d.data_type = framework_pb2.VarType.INT64
+    d.dims.extend([128, 30522])
+    assert pf._encode_tensor_desc('int64', [128, 30522]) == \
+        d.SerializeToString()
+    dtype, dims = pf._decode_tensor_desc(d.SerializeToString())
+    assert dtype == 'int64' and dims == [128, 30522]
+
+
+def test_roundtrip_dtypes_lod_and_combined(tmp_path):
+    rng = np.random.RandomState(0)
+    arrays = [
+        ('f32', rng.randn(4, 5).astype('float32')),
+        ('f64', rng.randn(3).astype('float64')),
+        ('f16', rng.randn(2, 2).astype('float16')),
+        ('i64', rng.randint(0, 100, (7,)).astype('int64')),
+        ('i32', rng.randint(0, 100, (2, 3)).astype('int32')),
+        ('u8', rng.randint(0, 255, (4,)).astype('uint8')),
+        ('b', (rng.randn(3) > 0)),
+    ]
+    combined = str(tmp_path / 'all')
+    pf.save_tensors(combined, arrays)
+    back = pf.load_tensors(combined)
+    assert len(back) == len(arrays)
+    for (name, arr), (got, _lod) in zip(arrays, back):
+        np.testing.assert_array_equal(got, arr)
+        assert got.dtype == arr.dtype
+    # LoD info round-trips
+    with open(str(tmp_path / 'lod'), 'wb') as f:
+        pf.write_lod_tensor(f, np.zeros((5, 2), 'float32'),
+                            lod=[[0, 2, 5]])
+    with open(str(tmp_path / 'lod'), 'rb') as f:
+        arr, lod = pf.read_lod_tensor(f)
+    assert arr.shape == (5, 2)
+    np.testing.assert_array_equal(lod[0], [0, 2, 5])
+
+
+def test_load_persistables_from_reference_format_dir(tmp_path):
+    """A dir of per-var binary LoDTensor files (what reference
+    save_persistables writes) populates the scope through the normal
+    fluid.io.load_persistables call; the save side round-trips through
+    save_format='paddle'."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        h = layers.fc(x, size=8, act='relu')
+        out = layers.fc(h, size=2)
+    rng = np.random.RandomState(1)
+    xd = rng.randn(6, 4).astype('float32')
+
+    ref_dir = str(tmp_path / 'refmodel')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        base, = exe.run(main, feed={'x': xd}, fetch_list=[out])
+        # writer leg: reference layout, one file per var
+        fluid.io.save_persistables(exe, ref_dir, main,
+                                   save_format='paddle')
+    for p in fluid.io._persistable_vars(main):
+        assert os.path.exists(os.path.join(ref_dir, p.name))
+        assert pf.looks_like_lod_tensor_file(
+            os.path.join(ref_dir, p.name))
+
+    # reader leg: a FRESH scope loads the reference-format dir
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        fluid.io.load_persistables(exe, ref_dir, main)
+        got, = exe.run(main, feed={'x': xd}, fetch_list=[out])
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+
+    # combined (save_combine) layout round-trips too
+    comb_dir = str(tmp_path / 'refcomb')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        fluid.io.load_persistables(exe, ref_dir, main)
+        fluid.io.save_persistables(exe, comb_dir, main,
+                                   filename='__params__',
+                                   save_format='paddle')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        fluid.io.load_persistables(exe, comb_dir, main,
+                                   filename='__params__')
+        got2, = exe.run(main, feed={'x': xd}, fetch_list=[out])
+    np.testing.assert_allclose(got2, base, rtol=1e-6)
+
+
+def _build_reference_model_pb(framework_pb2, w, b):
+    """Encode with REAL protobuf the inference ProgramDesc reference
+    fluid would save for out = relu(x @ w + b): feed -> mul ->
+    elementwise_add -> relu -> fetch."""
+    fp = framework_pb2
+    prog = fp.ProgramDesc()
+    blk = prog.blocks.add()
+    blk.idx, blk.parent_idx = 0, 0
+
+    def add_var(name, dims, dtype, kind=None, persistable=False):
+        v = blk.vars.add()
+        v.name = name
+        v.persistable = persistable
+        if kind is not None:
+            v.type.type = kind
+            return v
+        v.type.type = fp.VarType.LOD_TENSOR
+        v.type.lod_tensor.tensor.data_type = dtype
+        v.type.lod_tensor.tensor.dims.extend(dims)
+        return v
+
+    add_var('feed', [], 0, kind=fp.VarType.FEED_MINIBATCH)
+    add_var('fetch', [], 0, kind=fp.VarType.FETCH_LIST)
+    add_var('x', [-1, 4], fp.VarType.FP32)
+    add_var('w', list(w.shape), fp.VarType.FP32, persistable=True)
+    add_var('b', list(b.shape), fp.VarType.FP32, persistable=True)
+    add_var('mul_out', [-1, 2], fp.VarType.FP32)
+    add_var('add_out', [-1, 2], fp.VarType.FP32)
+    add_var('relu_out', [-1, 2], fp.VarType.FP32)
+
+    def add_op(type_, ins, outs, attrs=()):
+        op = blk.ops.add()
+        op.type = type_
+        for slot, args in ins:
+            var = op.inputs.add()
+            var.parameter = slot
+            var.arguments.extend(args)
+        for slot, args in outs:
+            var = op.outputs.add()
+            var.parameter = slot
+            var.arguments.extend(args)
+        for name, atype, val in attrs:
+            a = op.attrs.add()
+            a.name = name
+            a.type = atype
+            if atype == fp.INT:
+                a.i = val
+            elif atype == fp.FLOAT:
+                a.f = val
+            elif atype == fp.STRING:
+                a.s = val
+            elif atype == fp.INTS:
+                a.ints.extend(val)
+            elif atype == fp.BOOLEAN:
+                a.b = val
+            elif atype == fp.LONG:
+                a.l = val
+
+    add_op('feed', [('X', ['feed'])], [('Out', ['x'])],
+           [('col', fp.INT, 0)])
+    add_op('mul', [('X', ['x']), ('Y', ['w'])],
+           [('Out', ['mul_out'])],
+           [('x_num_col_dims', fp.INT, 1), ('y_num_col_dims', fp.INT, 1)])
+    add_op('elementwise_add', [('X', ['mul_out']), ('Y', ['b'])],
+           [('Out', ['add_out'])], [('axis', fp.INT, 1)])
+    add_op('relu', [('X', ['add_out'])], [('Out', ['relu_out'])])
+    add_op('fetch', [('X', ['relu_out'])], [('Out', ['fetch'])],
+           [('col', fp.INT, 0)])
+    return prog.SerializeToString()
+
+
+def test_load_inference_model_from_reference_binary(framework_pb2,
+                                                    tmp_path):
+    """End to end: binary __model__ (real protobuf bytes) + per-var
+    param files -> load_inference_model -> executor serves it; numpy
+    oracle checks the math."""
+    rng = np.random.RandomState(5)
+    w = rng.randn(4, 2).astype('float32')
+    b = rng.randn(2).astype('float32')
+    d = str(tmp_path / 'refinf')
+    os.makedirs(d)
+    with open(os.path.join(d, '__model__'), 'wb') as f:
+        f.write(_build_reference_model_pb(framework_pb2, w, b))
+    pf.save_tensors(os.path.join(d, 'w'), [('w', w)])
+    pf.save_tensors(os.path.join(d, 'b'), [('b', b)])
+
+    xd = rng.randn(8, 4).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            d, exe)
+        assert feed_names == ['x']
+        assert [v.name for v in fetch_vars] == ['relu_out']
+        got, = exe.run(program, feed={'x': xd}, fetch_list=fetch_vars)
+    oracle = np.maximum(xd @ w + b, 0.0)
+    np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_parse_program_desc_attr_types(framework_pb2):
+    """Every AttrType the decoder claims must round-trip through real
+    protobuf encoding."""
+    fp = framework_pb2
+    prog = fp.ProgramDesc()
+    blk = prog.blocks.add()
+    blk.idx, blk.parent_idx = 0, 0
+    op = blk.ops.add()
+    op.type = 'dropout'
+    a = op.attrs.add(); a.name = 'i'; a.type = fp.INT; a.i = -3
+    a = op.attrs.add(); a.name = 'f'; a.type = fp.FLOAT; a.f = 0.5
+    a = op.attrs.add(); a.name = 's'; a.type = fp.STRING
+    a.s = 'downgrade_in_infer'
+    a = op.attrs.add(); a.name = 'ints'; a.type = fp.INTS
+    a.ints.extend([1, -2, 3])
+    a = op.attrs.add(); a.name = 'floats'; a.type = fp.FLOATS
+    a.floats.extend([0.25, -1.5])
+    a = op.attrs.add(); a.name = 'strings'; a.type = fp.STRINGS
+    a.strings.extend(['a', 'bc'])
+    a = op.attrs.add(); a.name = 'b'; a.type = fp.BOOLEAN; a.b = True
+    a = op.attrs.add(); a.name = 'bools'; a.type = fp.BOOLEANS
+    a.bools.extend([True, False])
+    a = op.attrs.add(); a.name = 'blk'; a.type = fp.BLOCK
+    a.block_idx = 1
+    a = op.attrs.add(); a.name = 'l'; a.type = fp.LONG
+    a.l = 1 << 40
+    a = op.attrs.add(); a.name = 'longs'; a.type = fp.LONGS
+    a.longs.extend([-(1 << 40), 7])
+
+    program = pf.parse_program_desc(prog.SerializeToString())
+    got = program.global_block().ops[0].attrs
+    assert got['i'] == -3
+    assert abs(got['f'] - 0.5) < 1e-7
+    assert got['s'] == 'downgrade_in_infer'
+    assert got['ints'] == [1, -2, 3]
+    assert got['floats'] == [0.25, -1.5]
+    assert got['strings'] == ['a', 'bc']
+    assert got['b'] is True
+    assert got['bools'] == [True, False]
+    assert got['blk'] == 1
+    assert got['l'] == 1 << 40
+    assert got['longs'] == [-(1 << 40), 7]

@@ -27,7 +27,8 @@ def find_trace(profile_path):
     if not hits:
         raise SystemExit(
             'no trace found under %s — capture one with '
-            'fluid.profiler.profiler(profile_path=...)' % profile_path)
+            'fluid.profiler.start_trace(logdir)/stop_trace() around '
+            'the steps to convert' % profile_path)
     return max(hits, key=os.path.getmtime)
 
 
