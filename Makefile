@@ -1,0 +1,58 @@
+# Top-level build for paddle_tpu's native artifacts + package checks.
+# Reference analog: the cmake tree (CMakeLists.txt + cmake/) that builds
+# libpaddle_framework / capi / train demo.  Here the native surface is
+# three artifacts:
+#
+#   paddle_tpu/runtime/libptruntime.so      multithreaded datafeed + PS
+#   paddle_tpu/inference/capi/libpaddle_tpu_capi.so   stable C API
+#   build/demo_trainer                      C++ training entry demo
+#
+# `make` builds all three; `make test` runs the suite on the 8-device
+# virtual CPU mesh; `make wheel` packages the python tree + built .so
+# files with setup.py.
+
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -pthread -Wall
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags)
+
+NATIVE := paddle_tpu/runtime/libptruntime.so \
+          paddle_tpu/inference/capi/libpaddle_tpu_capi.so \
+          build/demo_trainer
+
+all: $(NATIVE)
+
+paddle_tpu/runtime/libptruntime.so: \
+		paddle_tpu/runtime/datafeed.cc \
+		paddle_tpu/runtime/ps_service.cc
+	$(MAKE) -C paddle_tpu/runtime
+
+paddle_tpu/inference/capi/libpaddle_tpu_capi.so: \
+		paddle_tpu/inference/capi/c_api.cc \
+		paddle_tpu/inference/capi/c_api.h
+	$(MAKE) -C paddle_tpu/inference/capi
+
+build/demo_trainer: paddle_tpu/train/demo/demo_trainer.cc \
+		paddle_tpu/inference/capi/libpaddle_tpu_capi.so
+	mkdir -p build
+	$(CXX) $(CXXFLAGS) -Ipaddle_tpu/inference/capi -o $@ $< \
+	  -Lpaddle_tpu/inference/capi -lpaddle_tpu_capi \
+	  -Wl,-rpath,'$$ORIGIN/../paddle_tpu/inference/capi'
+
+test: all
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+wheel: all
+	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist
+
+clean:
+	$(MAKE) -C paddle_tpu/runtime clean 2>/dev/null || true
+	$(MAKE) -C paddle_tpu/inference/capi clean
+	rm -rf build dist *.egg-info
+
+.PHONY: all test bench wheel clean

@@ -73,6 +73,13 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
                         fetch_list=[loss])
         np.asarray(last)  # block on the last step
         dt = time.time() - t0
+        global LAST_PERF
+        try:
+            cost = exe.program_cost(main, {'image': x, 'label': y},
+                                    fetch_list=[loss])
+            LAST_PERF = _perf_fields(dt / steps, cost)
+        except Exception:
+            LAST_PERF = {}
     return batch * steps / dt
 
 
@@ -80,6 +87,48 @@ def bench_resnet50(batch=128, steps=30, warmup=5, amp=True,
 # steady-state timed loop: wrapping warmup/compile floods the trace
 # buffer with host events (1M cap) and the device plane gets dropped
 TRACE_LOGDIR = None
+
+
+def _chip_peak():
+    """(peak bf16 TFLOP/s, peak HBM GB/s) for the attached chip kind.
+    PADDLE_TPU_PEAK_TFLOPS / PADDLE_TPU_PEAK_HBM_GBPS override the
+    builtin table unconditionally (differently-binned parts, new
+    chips)."""
+    import jax
+    env_tf = os.environ.get('PADDLE_TPU_PEAK_TFLOPS')
+    env_bw = os.environ.get('PADDLE_TPU_PEAK_HBM_GBPS')
+    kind = jax.devices()[0].device_kind.lower()
+    table = {'v5 lite': (197.0, 819.0), 'v5e': (197.0, 819.0),
+             'v5p': (459.0, 2765.0), 'v4': (275.0, 1228.0),
+             'v6': (918.0, 1640.0)}
+    tf, bw = 197.0, 819.0
+    for key, peaks in table.items():
+        if key in kind:
+            tf, bw = peaks
+            break
+    if env_tf:
+        tf = float(env_tf)
+    if env_bw:
+        bw = float(env_bw)
+    return tf, bw
+
+
+# set by _timed_steps from XLA's own cost analysis of the program it
+# just timed; benches merge it into their JSON line so every entry
+# reports achieved TFLOP/s and MFU (round-4 VERDICT item 2)
+LAST_PERF = {}
+
+
+def _perf_fields(step_s, cost):
+    if not cost or not cost.get('flops'):
+        return {}
+    peak_tf, peak_bw = _chip_peak()
+    tflops = cost['flops'] / step_s / 1e12
+    gbps = cost.get('bytes', 0.0) / step_s / 1e9
+    return {'tflops': round(tflops, 2),
+            'mfu_pct': round(100.0 * tflops / peak_tf, 2),
+            'hbm_gbps': round(gbps, 1),
+            'hbm_pct': round(100.0 * gbps / peak_bw, 1)}
 
 
 def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
@@ -104,6 +153,13 @@ def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
     finally:
         if TRACE_LOGDIR:
             jax.profiler.stop_trace()
+    global LAST_PERF
+    try:
+        cost = exe.program_cost(main_prog, feed, fetch_list=[loss])
+        LAST_PERF = _perf_fields(dt / steps, cost)
+    except Exception as e:
+        LAST_PERF = {}
+        sys.stderr.write('cost analysis unavailable: %s\n' % e)
     return dt / steps
 
 
@@ -130,10 +186,10 @@ def bench_bert(batch=32, seq_len=128, steps=20, cfg=None):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
         dt = _timed_steps(exe, main, batch_data, loss, steps)
-    return {'metric': 'bert_base_pretrain_step_ms_b%d_s%d'
-            % (batch, seq_len),
-            'value': round(dt * 1000, 2), 'unit': 'ms/step',
-            'seq_per_sec': round(batch / dt, 1)}
+    return dict({'metric': 'bert_base_pretrain_step_ms_b%d_s%d'
+                 % (batch, seq_len),
+                 'value': round(dt * 1000, 2), 'unit': 'ms/step',
+                 'seq_per_sec': round(batch / dt, 1)}, **LAST_PERF)
 
 
 def bench_bert_long(batch=4, seq_len=2048, steps=10):
@@ -224,9 +280,10 @@ def bench_wide_deep(batch=2048, steps=30, is_sparse=False):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
         dt = _timed_steps(exe, main, feed, loss, steps)
-    return {'metric': 'wide_deep_ctr_examples_per_sec_b%d%s'
-            % (batch, '_sparse' if is_sparse else ''),
-            'value': round(batch / dt, 1), 'unit': 'examples/sec'}
+    return dict({'metric': 'wide_deep_ctr_examples_per_sec_b%d%s'
+                 % (batch, '_sparse' if is_sparse else ''),
+                 'value': round(batch / dt, 1),
+                 'unit': 'examples/sec'}, **LAST_PERF)
 
 
 def bench_wide_deep_sparse(batch=2048, steps=30):
@@ -322,16 +379,90 @@ def bench_transformer(batch=32, src_len=64, tgt_len=64, steps=20):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
         dt = _timed_steps(exe, main, feed, loss, steps)
-    return {'metric': 'transformer_nmt_tokens_per_sec_b%d' % batch,
-            'value': round(batch * tgt_len / dt, 1),
-            'unit': 'tokens/sec',
-            'step_ms': round(dt * 1000, 2)}
+    return dict({'metric': 'transformer_nmt_tokens_per_sec_b%d' % batch,
+                 'value': round(batch * tgt_len / dt, 1),
+                 'unit': 'tokens/sec',
+                 'step_ms': round(dt * 1000, 2)}, **LAST_PERF)
 
 
-def bench_lenet(batch=512, steps=30):
-    """BASELINE.json config 0: MNIST LeNet throughput."""
+def bench_resnet50_hostfed(batch=128, steps=20, warmup=3,
+                           data_format='NHWC'):
+    """ResNet-50 training fed from HOST memory through the async
+    double-buffered DataLoader (capacity queue + 2-deep device_put
+    window) — proves the input pipeline overlaps H2D with compute: the
+    number should sit within a few % of the device-resident
+    resnet50 entry (round-4 VERDICT item 4).  Note the feed here ALSO
+    rides the tunnel, which an on-host deployment would not pay."""
+    import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, logits, loss, acc = models.resnet.build(
+            data_format=data_format)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Momentum(0.1, momentum=0.9),
+            use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(0)
+    shape = (batch, 224, 224, 3) if data_format == 'NHWC' else \
+        (batch, 3, 224, 224)
+    # a couple of distinct host batches, cycled: the loader must
+    # device_put fresh data each step (no accidental caching)
+    host_batches = [
+        {'image': rng.rand(*shape).astype('float32'),
+         'label': rng.randint(0, 1000, (batch, 1)).astype('int32')}
+        for _ in range(2)]
+
+    n_total = warmup + steps
+
+    def gen():
+        for i in range(n_total):
+            yield host_batches[i % 2]
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[feeds['image'], feeds['label']], capacity=4,
+        use_double_buffer=True)
+    loader.set_batch_generator(gen)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        it = iter(loader)
+        for _ in range(warmup):
+            exe.run(main, feed=next(it), fetch_list=[])
+        l, = exe.run(main, feed=host_batches[0], fetch_list=[loss])
+        np.asarray(l)
+        t0 = time.time()
+        n = 0
+        for batch_data in it:
+            exe.run(main, feed=batch_data, fetch_list=[])
+            n += 1
+        l, = exe.run(main, feed=host_batches[0], fetch_list=[loss])
+        np.asarray(l)
+        dt = time.time() - t0
+    return {'metric': 'resnet50_train_hostfed_images_per_sec_b%d'
+            % batch,
+            'value': round(batch * (n + 1) / dt, 1),
+            'unit': 'images/sec'}
+
+
+def bench_lenet(batch=512, steps=30, conv_precision=None):
+    """BASELINE.json config 0: MNIST LeNet throughput.
+
+    conv_precision: FLAGS_conv_precision override.  The service's
+    compiler hangs on multi-pass (HIGHEST/HIGH) f32 weight-gradient
+    convs at this model's b512/b256/b128 shapes (minimal repro:
+    tools/repro_conv_wedge.py) — 'default' keeps the REQUESTED batch
+    and downgrades only the conv algorithm, which is the principled
+    fallback (vs the former b500 batch swap)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    if conv_precision:
+        fluid.flags.set_flags({'FLAGS_conv_precision': conv_precision})
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 42
     with fluid.program_guard(main, startup):
@@ -344,8 +475,9 @@ def bench_lenet(batch=512, steps=30):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
         dt = _timed_steps(exe, main, feed, loss, steps)
-    return {'metric': 'lenet_mnist_images_per_sec_b%d' % batch,
-            'value': round(batch / dt, 1), 'unit': 'images/sec'}
+    return dict({'metric': 'lenet_mnist_images_per_sec_b%d' % batch,
+                 'value': round(batch / dt, 1),
+                 'unit': 'images/sec'}, **LAST_PERF)
 
 
 # --all entries: (name, config variants tried in order).  The second
@@ -354,7 +486,10 @@ def bench_lenet(batch=512, steps=30):
 # poisoned fingerprint hangs its compile RPC forever while every other
 # program is fine, so a one-off variant recovers the metric.
 ALL_BENCHES = (
-    ('lenet', ({}, {'batch': 500})),
+    # lenet fallback chain: the wedged compile (multi-pass dW conv,
+    # tools/repro_conv_wedge.py) is dodged FIRST by downgrading the
+    # conv algorithm at the same batch, THEN by the old b500 swap
+    ('lenet', ({}, {'conv_precision': 'default'}, {'batch': 500})),
     ('bert', ({},)),
     ('bert_long', ({},)),
     ('wide_deep', ({}, {'batch': 2000})),
@@ -363,6 +498,7 @@ ALL_BENCHES = (
     ('rpc_sparse_push', ({},)),
     ('transformer', ({},)),
     ('resnet_infer', ({}, {'batch': 64})),
+    ('resnet50_hostfed', ({},)),
 )
 
 
@@ -403,10 +539,10 @@ def main():
         kwargs = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
         if sys.argv[2] == 'resnet50':
             ips = bench_resnet50(**kwargs)
-            print(json.dumps({
+            print(json.dumps(dict({
                 'metric': 'resnet50_train_images_per_sec_chip',
                 'value': round(ips, 2), 'unit': 'images/sec',
-                'vs_baseline': round(ips / 365.0, 3)}))
+                'vs_baseline': round(ips / 365.0, 3)}, **LAST_PERF)))
         else:
             print(json.dumps(
                 globals()['bench_' + sys.argv[2]](**kwargs)))

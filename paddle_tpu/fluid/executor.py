@@ -931,7 +931,10 @@ class Executor(object):
         # toggling it takes effect on already-compiled programs
         from .flags import get_flag
         auto = bool(get_flag('FLAGS_segment_auto_layout'))
-        key = (auto,) + tuple(op.attrs.get('max_trip_count')
+        # flags that change the LOWERING must key the executable cache,
+        # or toggling them after first compile is silently ignored
+        prec = str(get_flag('FLAGS_conv_precision', 'highest'))
+        key = (auto, prec) + tuple(op.attrs.get('max_trip_count')
                               for op in seg.bucket_ops)
         compiled = seg.compiled.get(key)
         if compiled is None:

@@ -31,6 +31,14 @@ _DEFAULTS = {
     # program) and AUTO-layout executables break when reloaded from the
     # persistent XLA compile cache on this backend (see BENCHMARKS.md)
     'FLAGS_segment_auto_layout': False,
+    # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
+    # reference-accurate fp32 — the default), 'high' (3-pass), or
+    # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA
+    # backend pathology: multi-pass weight-gradient convs at certain
+    # shapes (e.g. LeNet b512/b256/b128 dW with a fused cotangent
+    # producer) hang this service's compiler — see BENCHMARKS.md
+    # round-4 and tools/repro_conv_wedge.py.
+    'FLAGS_conv_precision': 'highest',
 }
 
 _flags = {}

@@ -78,6 +78,15 @@ def _load_vars_paddle_format(dirname, vars, filename):
                 'combined params file %s holds %d tensors, program '
                 'expects %d' % (filename, len(records), len(vars)))
         for v, (arr, _lod) in zip(vars, records):
+            # positional pairing is the save_combine contract; a shape
+            # check catches order mismatches before they become
+            # silently swapped weights
+            want = tuple(int(d) for d in (v.shape or ()))
+            if want and -1 not in want and tuple(arr.shape) != want:
+                raise RuntimeError(
+                    'combined params order mismatch: record for %r has '
+                    'shape %s, program declares %s'
+                    % (v.name, tuple(arr.shape), want))
             scope.set_var(v.name, arr)
         return
     for v in vars:

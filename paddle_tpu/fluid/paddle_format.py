@@ -248,12 +248,7 @@ def _decode_attr(data):
     if dec is None:
         raise ValueError('unsupported attr type %d for %r'
                          % (atype, name))
-    value = dec(fields)
-    if atype == 8:
-        # BLOCK attrs carry a sub-block index; our control-flow ops use
-        # the same convention under the attr's own name (sub_block)
-        pass
-    return name, value
+    return name, dec(fields)
 
 
 def _decode_op_var(data):

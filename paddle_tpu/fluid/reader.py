@@ -43,11 +43,13 @@ class _AsyncBatchIterator(object):
 
     _END = object()
 
-    def __init__(self, gen, capacity, device=None, stage_depth=2):
+    def __init__(self, gen, capacity, device=None, stage_depth=2,
+                 stage_exclude=()):
         self._q = _queue.Queue(maxsize=max(1, int(capacity)))
         self._stop = threading.Event()
         self._exc = None
         self._device = device
+        self._stage_exclude = frozenset(stage_exclude)
         self._staged = []
         self._stage_depth = max(1, int(stage_depth))
         self._done = False
@@ -80,6 +82,9 @@ class _AsyncBatchIterator(object):
         import jax
         out = {}
         for k, v in batch.items():
+            if k in self._stage_exclude:
+                out[k] = v
+                continue
             if isinstance(v, core.LoDTensor):
                 v = v.data
             if isinstance(v, (np.ndarray, np.generic)) or not hasattr(
@@ -147,7 +152,7 @@ class DataLoader(object):
                        iterable=True, return_list=False,
                        use_multiprocess=False, bucket_boundaries=None,
                        batch_size=None, mask_map=None, drop_last=False,
-                       ragged_fields=None):
+                       ragged_fields=None, stage_exclude=None):
         """bucket_boundaries + batch_size turn the loader into the
         bucketing front-end for variable-length data (see
         BucketedGeneratorLoader)."""
@@ -161,7 +166,8 @@ class DataLoader(object):
                 ragged_fields=ragged_fields,
                 use_double_buffer=use_double_buffer)
         return GeneratorLoader(feed_list, capacity, iterable,
-                               use_double_buffer=use_double_buffer)
+                               use_double_buffer=use_double_buffer,
+                               stage_exclude=stage_exclude)
 
     @staticmethod
     def from_dataset(dataset, places, drop_last=True):
@@ -206,11 +212,17 @@ class DatasetLoader(object):
 
 class GeneratorLoader(object):
     def __init__(self, feed_list, capacity=64, iterable=True,
-                 use_double_buffer=True):
+                 use_double_buffer=True, stage_exclude=None):
+        """stage_exclude: feed names the double buffer must NOT
+        device_put — fields consumed only by HOST ops (PS sparse-id
+        lookups etc.); staging those would ship them to the device and
+        pull them straight back per step (two extra tunnel crossings
+        on a remote-attached chip)."""
         self._feed_list = feed_list or []
         self._capacity = capacity
         self._iterable = iterable
         self._use_double_buffer = use_double_buffer
+        self._stage_exclude = frozenset(stage_exclude or ())
         self._generator = None
         self._places = None
         self._iter = None
@@ -278,7 +290,8 @@ class GeneratorLoader(object):
         if prev is not None:
             prev.close()
         it = _AsyncBatchIterator(self._generator, self._capacity,
-                                 self._target_device())
+                                 self._target_device(),
+                                 stage_exclude=self._stage_exclude)
         self._live_iter = it
         return it
 

@@ -14,6 +14,23 @@ import jax.numpy as jnp
 from .registry import register
 
 
+def _f32_conv_precision():
+    """MXU algorithm for f32 convs, from FLAGS_conv_precision:
+    'highest' matches reference fp32 accuracy (6-pass bf16 emulation);
+    'default'/'high' are the escape hatch for the backend's multi-pass
+    dW-conv compile hang (BENCHMARKS.md round-4,
+    tools/repro_conv_wedge.py)."""
+    try:
+        from ..fluid.flags import get_flag
+        name = str(get_flag('FLAGS_conv_precision', 'highest')).lower()
+    except Exception:
+        name = 'highest'
+    return {'highest': jax.lax.Precision.HIGHEST,
+            'high': jax.lax.Precision.HIGH,
+            'default': jax.lax.Precision.DEFAULT}.get(
+        name, jax.lax.Precision.HIGHEST)
+
+
 def _pair(v):
     if isinstance(v, (list, tuple)):
         return list(v)
@@ -63,7 +80,7 @@ def conv2d(ctx, ins, attrs):
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=dn,
-        precision=(jax.lax.Precision.HIGHEST
+        precision=(_f32_conv_precision()
                    if x.dtype == jnp.float32 else None),
         preferred_element_type=None if amp else (
             jnp.float32 if x.dtype != jnp.float64 else None))

@@ -280,6 +280,18 @@ def _block_sizes(t, block_q, block_k, d=64, itemsize=2):
             block_k //= 2
         else:
             block_q //= 2
+    if _vmem_estimate(t, d, block_q, block_k, itemsize) > \
+            VMEM_BUDGET_BYTES:
+        # the resident K/V rows alone exceed the budget (huge t*d):
+        # block shrinking cannot help — surface it so a compile
+        # failure is attributable; sequences this long belong on the
+        # ring-attention path (T sharded over 'sp'), not one kernel
+        import logging
+        logging.getLogger(__name__).warning(
+            'flash attention t=%d d=%d: K/V residency exceeds the '
+            'VMEM budget at the smallest blocks (%d/%d); compile may '
+            'fail — use ring attention / sequence parallelism for '
+            'this length', t, d, block_q, block_k)
     return block_q, block_k
 
 

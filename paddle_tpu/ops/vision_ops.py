@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register, register_host
+from .nn_ops import _f32_conv_precision
 
 
 def _triple(v):
@@ -48,7 +49,7 @@ def conv3d(ctx, ins, attrs):
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, feature_group_count=groups,
         dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
-        precision=(jax.lax.Precision.HIGHEST
+        precision=(_f32_conv_precision()
                    if x.dtype == jnp.float32 else None))
     return {'Output': [out]}
 

@@ -261,3 +261,17 @@ def test_trainer_desc_and_factory_surface():
         DeviceWorkerFactory()._create_device_worker('nope')
     with pytest.raises(NotImplementedError):
         DeviceWorker()._gen_worker_desc({})
+
+
+def test_stage_exclude_keeps_host_fields_on_host():
+    import jax
+    _, _, feeds = _feed_vars()
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=feeds, capacity=2, use_double_buffer=True,
+        stage_exclude=['y'])
+    loader.set_batch_generator(
+        lambda: iter([{'x': np.zeros((2, 4), 'float32'),
+                       'y': np.zeros((2, 1), 'float32')}]))
+    batch = next(iter(loader))
+    assert isinstance(batch['x'], jax.Array)
+    assert isinstance(batch['y'], np.ndarray)

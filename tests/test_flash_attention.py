@@ -203,3 +203,74 @@ def test_auto_dispatch_and_vmem_clamp():
     q2 = jnp.asarray(rng.randn(1, 64, 2, 128), jnp.float32)
     o2 = fa.flash_attention(q2, q2, q2, min_seq=0)
     assert o2.shape == (1, 64, 2, 128)
+
+
+def test_conv_precision_flag():
+    """FLAGS_conv_precision selects the f32 MXU algorithm (escape
+    hatch for the multi-pass dW-conv compile hang,
+    tools/repro_conv_wedge.py) without changing results beyond
+    algorithm tolerance."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.ops.nn_ops import _f32_conv_precision
+    import jax
+
+    assert _f32_conv_precision() == jax.lax.Precision.HIGHEST
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 3, 16, 16).astype('float32')
+
+    def run():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            img = layers.data('img', shape=[3, 16, 16],
+                              dtype='float32')
+            out = layers.conv2d(img, num_filters=8, filter_size=3)
+            loss = layers.reduce_mean(out)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            l, = exe.run(main, feed={'img': x}, fetch_list=[loss])
+        return float(np.asarray(l).ravel()[0])
+
+    base = run()
+    try:
+        fluid.flags.set_flags({'FLAGS_conv_precision': 'default'})
+        assert _f32_conv_precision() == jax.lax.Precision.DEFAULT
+        got = run()
+    finally:
+        fluid.flags.set_flags({'FLAGS_conv_precision': 'highest'})
+    # single-pass bf16 vs 6-pass: same value within bf16 tolerance
+    assert abs(got - base) < 5e-2 * max(1.0, abs(base)), (got, base)
+
+
+def test_conv_precision_flag_rekeys_executable_cache():
+    """Toggling FLAGS_conv_precision after first compile must produce
+    a NEW executable for the SAME program (the cache keys on it), not
+    silently reuse the stale one."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.executor import _Segment
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        img = layers.data('img', shape=[3, 8, 8], dtype='float32')
+        out = layers.conv2d(img, num_filters=4, filter_size=3)
+        loss = layers.reduce_mean(out)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        exe.run(main, feed={'img': x}, fetch_list=[loss])
+        try:
+            fluid.flags.set_flags({'FLAGS_conv_precision': 'default'})
+            exe.run(main, feed={'img': x}, fetch_list=[loss])
+        finally:
+            fluid.flags.set_flags({'FLAGS_conv_precision': 'highest'})
+        plan = exe._get_plan(main, ('img',), (loss.name,))
+        seg = next(it for it in plan if isinstance(it, _Segment))
+        precs = {k[1] for k in seg.compiled if isinstance(k, tuple)
+                 and len(k) >= 2 and isinstance(k[1], str)}
+    assert {'highest', 'default'} <= precs, seg.compiled.keys()

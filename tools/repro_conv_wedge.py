@@ -1,0 +1,70 @@
+"""Minimal reproducer for the 'lenet b512' compile wedge (round-3
+BENCHMARKS.md; root-caused round 4).
+
+The trigger is NOT the batch-512 program fingerprint but this exact
+pattern: an f32 conv2d WEIGHT-gradient (dW) computed at multi-pass MXU
+precision (jax.lax.Precision.HIGHEST or HIGH — the 6-/3-pass bf16
+emulation algorithms) whose cotangent arrives from fused elementwise
+producers (a relu-grad select and/or a bias-grad reduce).  On the axon
+TPU v5e compile service this hangs the compile RPC (>150 s, never
+returns) for LeNet-conv1-shaped dW at batch 128, 256 and 512, while
+
+  - batch 500 compiles in ~14 s (the round-3 bench fallback worked by
+    accident of shape, not because 512 is special),
+  - Precision.DEFAULT (single-pass bf16) always compiles in ~15 s,
+  - the same dW WITHOUT a fused producer compiles (slowly, ~57 s),
+  - the data-gradient (dImg) side alone always compiles.
+
+Run on the attached TPU:
+
+  python tools/repro_conv_wedge.py 512 highest   # hangs (ctrl-C / timeout)
+  python tools/repro_conv_wedge.py 512 default   # ~15 s, OK
+  python tools/repro_conv_wedge.py 500 highest   # ~14 s, OK
+
+Framework mitigation: FLAGS_conv_precision ('highest'|'high'|'default')
+selects the f32 conv algorithm; bench.py's lenet entry falls back to
+'default' at the SAME batch when the compile deadline fires.
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    prec = {'highest': jax.lax.Precision.HIGHEST,
+            'high': jax.lax.Precision.HIGH,
+            'default': jax.lax.Precision.DEFAULT}[
+        sys.argv[2] if len(sys.argv) > 2 else 'highest']
+    rng = np.random.RandomState(0)
+    ct = jnp.asarray(rng.rand(batch, 20, 24, 24).astype('float32'))
+    y = jnp.asarray(rng.rand(batch, 20, 24, 24).astype('float32') - .5)
+    img = jnp.asarray(rng.rand(batch, 1, 28, 28).astype('float32'))
+    w = jnp.asarray(rng.randn(20, 1, 5, 5).astype('float32') * 0.1)
+
+    def conv(im, ww):
+        return jax.lax.conv_general_dilated(
+            im, ww, (1, 1), 'VALID',
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+            precision=prec)
+
+    def dw_with_fused_producer(ct, img, w):
+        d = jnp.where(y > 0, ct, 0.0)        # relu_grad
+        dbias = jnp.sum(d, (0, 2, 3))        # bias grad
+        _, vjp = jax.vjp(lambda ww: conv(img, ww), w)
+        return (dbias,) + vjp(d)
+
+    print('compiling dW conv b%d precision=%s ...'
+          % (batch, sys.argv[2] if len(sys.argv) > 2 else 'highest'),
+          flush=True)
+    t0 = time.time()
+    out = jax.jit(dw_with_fused_producer)(ct, img, w)
+    np.asarray(out[0]).ravel()[:1]
+    print('compiled + ran in %.0f s' % (time.time() - t0))
+
+
+if __name__ == '__main__':
+    main()
