@@ -13,8 +13,6 @@
 
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -pthread -Wall
-PY_INCLUDES := $(shell python3-config --includes)
-PY_LDFLAGS := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags)
 
 NATIVE := paddle_tpu/runtime/libptruntime.so \
           paddle_tpu/inference/capi/libpaddle_tpu_capi.so \

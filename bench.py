@@ -444,10 +444,23 @@ def bench_resnet50_hostfed(batch=128, steps=20, warmup=3,
         l, = exe.run(main, feed=host_batches[0], fetch_list=[loss])
         np.asarray(l)
         dt = time.time() - t0
+        # baseline: the SAME host batches fed synchronously (numpy
+        # straight into run, no background thread, no device window) —
+        # the loader's overlap must beat this.  On the tunnel BOTH are
+        # wire-bound (~77 MB/batch over the link), so the comparison,
+        # not the absolute number, is the signal; an on-host deployment
+        # pays PCIe instead and approaches the device-resident entry.
+        t0 = time.time()
+        for i in range(max(4, steps // 4)):
+            exe.run(main, feed=host_batches[i % 2], fetch_list=[])
+        l, = exe.run(main, feed=host_batches[0], fetch_list=[loss])
+        np.asarray(l)
+        sync_dt = (time.time() - t0) / (max(4, steps // 4) + 1)
     return {'metric': 'resnet50_train_hostfed_images_per_sec_b%d'
             % batch,
             'value': round(batch * (n + 1) / dt, 1),
-            'unit': 'images/sec'}
+            'unit': 'images/sec',
+            'sync_feed_images_per_sec': round(batch / sync_dt, 1)}
 
 
 def bench_lenet(batch=512, steps=30, conv_precision=None):
@@ -461,20 +474,27 @@ def bench_lenet(batch=512, steps=30, conv_precision=None):
     fallback (vs the former b500 batch swap)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
+    prev_precision = fluid.flags.get_flag('FLAGS_conv_precision',
+                                          'highest')
     if conv_precision:
         fluid.flags.set_flags({'FLAGS_conv_precision': conv_precision})
-    main, startup = fluid.Program(), fluid.Program()
-    main.random_seed = startup.random_seed = 42
-    with fluid.program_guard(main, startup):
-        feeds, pred, loss, acc = models.lenet.build()
-        fluid.optimizer.Adam(1e-3).minimize(loss)
-    rng = np.random.RandomState(0)
-    feed = {'img': rng.rand(batch, 1, 28, 28).astype('float32'),
-            'label': rng.randint(0, 10, (batch, 1)).astype('int64')}
-    with fluid.scope_guard(fluid.Scope()):
-        exe = fluid.Executor(fluid.XLAPlace(0))
-        exe.run(startup)
-        dt = _timed_steps(exe, main, feed, loss, steps)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 42
+        with fluid.program_guard(main, startup):
+            feeds, pred, loss, acc = models.lenet.build()
+            fluid.optimizer.Adam(1e-3).minimize(loss)
+        rng = np.random.RandomState(0)
+        feed = {'img': rng.rand(batch, 1, 28, 28).astype('float32'),
+                'label': rng.randint(0, 10,
+                                     (batch, 1)).astype('int64')}
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            dt = _timed_steps(exe, main, feed, loss, steps)
+    finally:
+        # never leak a degraded precision into later in-process callers
+        fluid.flags.set_flags({'FLAGS_conv_precision': prev_precision})
     return dict({'metric': 'lenet_mnist_images_per_sec_b%d' % batch,
                  'value': round(batch / dt, 1),
                  'unit': 'images/sec'}, **LAST_PERF)

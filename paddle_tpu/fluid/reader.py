@@ -164,7 +164,8 @@ class DataLoader(object):
                 mask_map=mask_map, drop_last=drop_last,
                 capacity=capacity, iterable=iterable,
                 ragged_fields=ragged_fields,
-                use_double_buffer=use_double_buffer)
+                use_double_buffer=use_double_buffer,
+                stage_exclude=stage_exclude)
         return GeneratorLoader(feed_list, capacity, iterable,
                                use_double_buffer=use_double_buffer,
                                stage_exclude=stage_exclude)
@@ -333,10 +334,11 @@ class BucketedGeneratorLoader(GeneratorLoader):
     def __init__(self, feed_list, bucket_boundaries, batch_size,
                  mask_map=None, drop_last=False, capacity=64,
                  iterable=True, ragged_fields=None,
-                 use_double_buffer=True):
+                 use_double_buffer=True, stage_exclude=None):
         super(BucketedGeneratorLoader, self).__init__(
             feed_list, capacity, iterable,
-            use_double_buffer=use_double_buffer)
+            use_double_buffer=use_double_buffer,
+            stage_exclude=stage_exclude)
         self.boundaries = sorted(int(b) for b in bucket_boundaries)
         self.batch_size = batch_size
         self.drop_last = drop_last

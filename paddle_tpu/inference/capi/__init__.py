@@ -1,0 +1,3 @@
+"""Package marker so the built C API artifacts (libpaddle_tpu_capi.so,
+c_api.h) ship in wheels via package_data; the module itself has no
+Python surface — consumers load the .so via ctypes/dlopen."""

@@ -60,6 +60,41 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None,
     qkv = layers.fc(x, size=3 * h, num_flatten_dims=2)
     q, k, v = layers.split(qkv, 3, dim=2)
 
+    if getattr(cfg, 'use_context_parallel', False):
+        # sequence/context parallelism: the ring_attention op shards T
+        # over the 'sp' mesh axis under CompiledProgram.with_mesh and
+        # runs the ppermute K/V ring (dense fallback on one device).
+        # The ring carries no attention bias yet — masked BERT inputs
+        # must keep the standard path.
+        if attn_bias is not None or key_bias is not None:
+            raise ValueError(
+                'use_context_parallel does not support attention '
+                'masks/biases yet: drop the input mask or disable '
+                'context parallelism')
+        if not is_test and getattr(cfg, 'attn_dropout', cfg.dropout):
+            # the ring never materializes the probs, so prob-dropout
+            # cannot be applied — refuse rather than silently train a
+            # different model (same policy as the flash path, which
+            # gates on attn_dropout == 0)
+            raise ValueError(
+                'use_context_parallel cannot apply attention-prob '
+                'dropout (the probs never materialize in the ring); '
+                'set attn_dropout=0 to opt in')
+        seq = x.shape[1]
+        t_dim = seq if seq and seq > 0 else -1
+        q3 = layers.reshape(q, [-1, t_dim, heads, d] if t_dim > 0
+                            else [0, 0, heads, d])
+        k3 = layers.reshape(k, [-1, t_dim, heads, d] if t_dim > 0
+                            else [0, 0, heads, d])
+        v3 = layers.reshape(v, [-1, t_dim, heads, d] if t_dim > 0
+                            else [0, 0, heads, d])
+        out = layers.context_parallel_attention(
+            q3, k3, v3, causal=causal,
+            use_flash=getattr(cfg, 'cp_use_flash', False),
+            axis=getattr(cfg, 'cp_axis', 'sp'))
+        ctx = layers.reshape(out, [0, 0, h])
+        return layers.fc(ctx, size=h, num_flatten_dims=2)
+
     seq_len = x.shape[1] if len(x.shape) >= 2 else 0
     use_flash = getattr(cfg, 'use_flash', False) and \
         (is_test or not getattr(cfg, 'attn_dropout', cfg.dropout)) and \

@@ -22,7 +22,9 @@ from . import bert as _bert
 class GptConfig(object):
     def __init__(self, vocab_size=50257, hidden=768, layers=12,
                  heads=12, intermediate=None, max_pos=1024,
-                 dropout=0.1, attn_dropout=None, use_flash=True):
+                 dropout=0.1, attn_dropout=None, use_flash=True,
+                 moe_experts=0, moe_hidden=None, moe_aux_weight=0.01,
+                 moe_capacity_factor=2.0, use_context_parallel=False):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -34,6 +36,17 @@ class GptConfig(object):
             attn_dropout
         self.use_flash = use_flash
         self.flash_min_len = 512
+        # MoE FFN blocks (GShard top-1, layers.moe): moe_experts > 0
+        # swaps the dense MLP for an expert-parallel MoE that shards
+        # over an 'ep' mesh axis under CompiledProgram.with_mesh
+        self.moe_experts = moe_experts
+        self.moe_hidden = moe_hidden or self.intermediate
+        self.moe_aux_weight = moe_aux_weight
+        self.moe_capacity_factor = moe_capacity_factor
+        # route attention through layers.context_parallel_attention
+        # (ring attention over the 'sp' axis on a mesh; dense fallback
+        # on one device)
+        self.use_context_parallel = use_context_parallel
 
 
 BASE = GptConfig()
@@ -41,8 +54,9 @@ TINY = GptConfig(vocab_size=97, hidden=64, layers=2, heads=4,
                  max_pos=128, dropout=0.0)
 
 
-def decoder_block(x, cfg, is_test):
-    """Pre-LN GPT-2 block."""
+def decoder_block(x, cfg, is_test, aux_losses=None):
+    """Pre-LN GPT-2 block; with cfg.moe_experts the MLP is a GShard
+    MoE FFN and its load-balance loss is appended to aux_losses."""
     a = layers.layer_norm(x, begin_norm_axis=2)
     a = _bert.multi_head_attention(a, None, cfg, is_test, causal=True)
     if not is_test and cfg.dropout:
@@ -50,16 +64,24 @@ def decoder_block(x, cfg, is_test):
                            dropout_implementation='upscale_in_train')
     x = layers.elementwise_add(x, a)
     m = layers.layer_norm(x, begin_norm_axis=2)
-    m = layers.fc(m, size=cfg.intermediate, num_flatten_dims=2,
-                  act='gelu')
-    m = layers.fc(m, size=cfg.hidden, num_flatten_dims=2)
+    if cfg.moe_experts:
+        m, aux = layers.moe(m, num_experts=cfg.moe_experts,
+                            hidden_size=cfg.moe_hidden,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            aux_weight=cfg.moe_aux_weight)
+        if aux_losses is not None:
+            aux_losses.append(aux)
+    else:
+        m = layers.fc(m, size=cfg.intermediate, num_flatten_dims=2,
+                      act='gelu')
+        m = layers.fc(m, size=cfg.hidden, num_flatten_dims=2)
     if not is_test and cfg.dropout:
         m = layers.dropout(m, cfg.dropout, is_test=is_test,
                            dropout_implementation='upscale_in_train')
     return layers.elementwise_add(x, m)
 
 
-def gpt_decoder(ids, pos_ids, cfg, is_test=False):
+def gpt_decoder(ids, pos_ids, cfg, is_test=False, aux_losses=None):
     tok = layers.embedding(ids, size=[cfg.vocab_size, cfg.hidden],
                            param_attr=fluid.ParamAttr(name='gpt_wte'))
     pos = layers.embedding(pos_ids, size=[cfg.max_pos, cfg.hidden])
@@ -68,7 +90,7 @@ def gpt_decoder(ids, pos_ids, cfg, is_test=False):
         x = layers.dropout(x, cfg.dropout, is_test=is_test,
                            dropout_implementation='upscale_in_train')
     for _ in range(cfg.layers):
-        x = decoder_block(x, cfg, is_test)
+        x = decoder_block(x, cfg, is_test, aux_losses=aux_losses)
     return layers.layer_norm(x, begin_norm_axis=2)
 
 
@@ -80,11 +102,18 @@ def build_lm(cfg=None, seq_len=128, is_test=False):
     ids = fluid.layers.data('ids', shape=[seq_len], dtype='int64')
     pos = fluid.layers.data('pos_ids', shape=[seq_len], dtype='int64')
     labels = fluid.layers.data('labels', shape=[seq_len], dtype='int64')
-    h = gpt_decoder(ids, pos, cfg, is_test)
+    aux_losses = []
+    h = gpt_decoder(ids, pos, cfg, is_test, aux_losses=aux_losses)
     logits = layers.fc(h, size=cfg.vocab_size, num_flatten_dims=2)
     loss = layers.softmax_with_cross_entropy(
         logits, layers.unsqueeze(labels, [2]), ignore_index=-1)
     loss = layers.mean(loss)
+    if not is_test:
+        # the load-balance term belongs in the TRAINING objective
+        # only; eval loss stays the bare LM cross-entropy so
+        # perplexities compare across dense/MoE models
+        for aux in aux_losses:
+            loss = layers.elementwise_add(loss, aux)
     feeds = {'ids': ids, 'pos_ids': pos, 'labels': labels}
     return feeds, logits, loss
 

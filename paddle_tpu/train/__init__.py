@@ -1,0 +1,3 @@
+"""C++ training entry demo (train/demo/demo_trainer.cc): drives a
+saved train program through the stable C API without Python at train
+time (reference fluid/train/demo analog)."""

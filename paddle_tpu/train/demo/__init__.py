@@ -1,0 +1,1 @@
+"""Package marker so the C++ demo sources ship in wheels."""

@@ -93,23 +93,39 @@ def scaled_step(loss_fn, params, opt_state, scale, *args):
     return loss / scale['s'], params, opt_state, {'s': s, 'good': good}
 
 
+def _sync(state):
+    """Close the async-dispatch window by fetching the SMALLEST state
+    leaf (a scalar: adam t / scale / step counter).  Fetching a big
+    leaf would pull it over the tunnel (~12 MB/s) and time the wire —
+    the first-draft bug that made every ceiling look 6x slow: syncing
+    on the [30522,768] embedding shipped 94 MB per sync."""
+    leaves = jax.tree.leaves(state)
+    np.asarray(min(leaves, key=lambda a: getattr(a, 'size', 1 << 60)))
+
+
 def timeit(step, state, steps, feed):
+    # device-resident feeds AND initial state, like bench._timed_steps:
+    # shipping numpy per call forces synchronous tunnel transfers and
+    # an avals-changed recompile on the numpy->Array transition
+    feed = tuple(jax.device_put(np.asarray(f)) for f in feed)
+    state = jax.tree.map(jax.device_put, state)
     state = step(state, *feed)  # warm/compile
-    np.asarray(jax.tree.leaves(state)[0]).ravel()[:1]
+    _sync(state)
     t0 = time.perf_counter()
     for _ in range(steps):
         state = step(state, *feed)
-    np.asarray(jax.tree.leaves(state)[0]).ravel()[:1]
+    _sync(state)
     return (time.perf_counter() - t0) / steps
 
 
 # ---------------------------------------------------------------- bert
 
-def run_bert(batch, seq, steps):
+def run_bert(batch, seq, steps, ablate=()):
     V, H, L, NH, FF, TV = 30522, 768, 12, 12, 3072, 2
     D = H // NH
-    drop = 0.1
-    attn_drop = 0.1 if seq < 512 else 0.0  # bench: flash path drops it
+    drop = 0.0 if 'dropout' in ablate else 0.1
+    attn_drop = (0.1 if seq < 512 else 0.0) if 'dropout' not in ablate \
+        else 0.0
     use_flash = seq >= 512
     rng = np.random.RandomState(0)
 
@@ -142,7 +158,9 @@ def run_bert(batch, seq, steps):
     nsp = rng.randint(0, 2, (batch,)).astype('int32')
 
     if use_flash:
-        sys.path.insert(0, '/root/repo')
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
     def attention(x, p, i, key):
@@ -174,12 +192,17 @@ def run_bert(batch, seq, steps):
             f = dense(f, p['l%d_f2' % i], p['l%d_f2_b' % i])
             f = dropout(f, drop, keys[3 * i + 2])
             x = layer_norm(x + f, p['l%d_ln2_g' % i], p['l%d_ln2_b' % i])
-        logits = dense(x, p['mlm_w'], p['mlm_b']).astype(jnp.float32)
-        lp = jax.nn.log_softmax(logits, -1)
-        tgt = jnp.maximum(mlm_label, 0)
-        nll = -jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
-        maskd = (mlm_label >= 0).astype(jnp.float32)
-        mlm_loss = jnp.sum(nll * maskd) / jnp.maximum(jnp.sum(maskd), 1)
+        if 'head' in ablate:
+            mlm_loss = jnp.mean(jnp.square(x.astype(jnp.float32)))
+        else:
+            logits = dense(x, p['mlm_w'],
+                           p['mlm_b']).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits, -1)
+            tgt = jnp.maximum(mlm_label, 0)
+            nll = -jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+            maskd = (mlm_label >= 0).astype(jnp.float32)
+            mlm_loss = jnp.sum(nll * maskd) / \
+                jnp.maximum(jnp.sum(maskd), 1)
         cls = x[:, 0, :]
         nl = dense(cls, p['nsp_w'], p['nsp_b']).astype(jnp.float32)
         nlp = jax.nn.log_softmax(nl, -1)
@@ -194,15 +217,22 @@ def run_bert(batch, seq, steps):
     def step(state, ids, sent_ids, mlm_label, nsp_label):
         params, opt, scale, it = state
         key = jax.random.fold_in(jax.random.PRNGKey(0), it)
-        loss, params, opt, scale = scaled_step(
-            loss_fn, params, opt, scale, ids, sent_ids, mlm_label,
-            nsp_label, key)
+        if 'scaling' in ablate:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, ids, sent_ids, mlm_label, nsp_label, key)
+            params, opt = adam_apply(params, grads, opt)
+        else:
+            loss, params, opt, scale = scaled_step(
+                loss_fn, params, opt, scale, ids, sent_ids, mlm_label,
+                nsp_label, key)
         return (params, opt, scale, it + 1)
 
     state = (params, opt, scale, jnp.zeros((), jnp.int32))
     dt = timeit(step, state, steps, (ids, sent, mlm, nsp))
-    print('bert ceiling b%d s%d: %.2f ms/step (%.1f seq/s)'
-          % (batch, seq, dt * 1e3, batch / dt))
+    print('bert ceiling b%d s%d%s: %.2f ms/step (%.1f seq/s)'
+          % (batch, seq,
+             (' -' + ','.join(sorted(ablate))) if ablate else '',
+             dt * 1e3, batch / dt))
 
 
 # ------------------------------------------------------------ wide&deep
@@ -376,9 +406,12 @@ def main():
     ap.add_argument('--batch', type=int, default=None)
     ap.add_argument('--seq', type=int, default=128)
     ap.add_argument('--steps', type=int, default=20)
+    ap.add_argument('--ablate', default='',
+                    help='comma list: dropout,head,scaling')
     args = ap.parse_args()
     if args.which == 'bert':
-        run_bert(args.batch or 32, args.seq, args.steps)
+        run_bert(args.batch or 32, args.seq, args.steps,
+                 ablate=tuple(a for a in args.ablate.split(',') if a))
     elif args.which == 'widedeep':
         run_widedeep(args.batch or 2048, args.steps)
     else:
