@@ -276,10 +276,25 @@ class AdamOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator('moment1', p)
             self._add_accumulator('moment2', p)
-            self._add_accumulator('beta1_pow_acc', p, fill_value=1.0,
-                                  shape=[1])
-            self._add_accumulator('beta2_pow_acc', p, fill_value=1.0,
-                                  shape=[1])
+        if parameters:
+            # ONE shared beta-pow pair for the whole optimizer: every
+            # dense param's pow follows the identical beta^t
+            # trajectory, so the reference's per-param copies (an
+            # artifact of its per-op design) only inflate the jit
+            # boundary — for Transformer-base they alone added ~400
+            # state arrays per step.  Exact math: each pow is read by
+            # all adam ops at step t and advanced ONCE in
+            # _finish_update.
+            self._shared_pow_param = parameters[0]
+            self._add_accumulator('beta1_pow_acc', parameters[0],
+                                  fill_value=1.0, shape=[1])
+            self._add_accumulator('beta2_pow_acc', parameters[0],
+                                  fill_value=1.0, shape=[1])
+
+    def _get_accumulator(self, name, param):
+        if name in ('beta1_pow_acc', 'beta2_pow_acc'):
+            param = self._shared_pow_param
+        return super(AdamOptimizer, self)._get_accumulator(name, param)
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -292,11 +307,27 @@ class AdamOptimizer(Optimizer):
             inputs={'Param': p, 'Grad': g, 'Moment1': m1, 'Moment2': m2,
                     'Beta1Pow': b1p, 'Beta2Pow': b2p,
                     'LearningRate': self._create_param_lr(param_and_grad)},
-            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2,
-                     'Beta1PowOut': b1p, 'Beta2PowOut': b2p},
+            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2},
             attrs={'beta1': self._beta1, 'beta2': self._beta2,
                    'epsilon': self._epsilon},
             infer_shape=False)
+
+    def _finish_update(self, block, params_grads):
+        if not params_grads:
+            return
+        b1p = self._get_accumulator('beta1_pow_acc',
+                                    params_grads[0][0])
+        b2p = self._get_accumulator('beta2_pow_acc',
+                                    params_grads[0][0])
+        for acc, beta in ((b1p, self._beta1), (b2p, self._beta2)):
+            # __optimizer_finish__ lets program rewrites that strip the
+            # per-param optimize ops (async-PS transpiler) drop these
+            # paired finish ops too, instead of leaving orphan updates
+            block.append_op('scale', inputs={'X': acc},
+                            outputs={'Out': acc},
+                            attrs={'scale': beta,
+                                   '__optimizer_finish__': True},
+                            infer_shape=False)
 
 
 class AdamWOptimizer(AdamOptimizer):
@@ -317,8 +348,7 @@ class AdamWOptimizer(AdamOptimizer):
             inputs={'Param': p, 'Grad': g, 'Moment1': m1, 'Moment2': m2,
                     'Beta1Pow': b1p, 'Beta2Pow': b2p,
                     'LearningRate': self._create_param_lr(param_and_grad)},
-            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2,
-                     'Beta1PowOut': b1p, 'Beta2PowOut': b2p},
+            outputs={'ParamOut': p, 'Moment1Out': m1, 'Moment2Out': m2},
             attrs={'beta1': self._beta1, 'beta2': self._beta2,
                    'epsilon': self._epsilon, 'coeff': self._coeff},
             infer_shape=False)
@@ -484,6 +514,24 @@ class LambOptimizer(AdamOptimizer):
                                             **kwargs)
         self._weight_decay = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    # Lamb keeps PER-PARAM beta pows (its op advances them in-place via
+    # Beta1PowOut, so sharing Adam's single pair would advance it once
+    # per param per step — N+1 total with the inherited finish hook)
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator('moment1', p)
+            self._add_accumulator('moment2', p)
+            self._add_accumulator('beta1_pow_acc', p, fill_value=1.0,
+                                  shape=[1])
+            self._add_accumulator('beta2_pow_acc', p, fill_value=1.0,
+                                  shape=[1])
+
+    def _get_accumulator(self, name, param):
+        return Optimizer._get_accumulator(self, name, param)
+
+    def _finish_update(self, block, params_grads):
+        pass
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad

@@ -156,6 +156,12 @@ class DistributeTranspiler(object):
         keep = []
         for op in block.ops:
             if op.attrs.get('__op_role__') == 'optimize' and \
+                    op.attrs.get('__optimizer_finish__'):
+                # paired finish op (shared beta-pow advance) of an
+                # optimizer whose per-param ops move server-side: drop
+                # it with them, or it would mutate orphan state
+                continue
+            if op.attrs.get('__op_role__') == 'optimize' and \
                     op.input('Param'):
                 if op.type not in ('sgd', 'momentum', 'adam'):
                     raise NotImplementedError(

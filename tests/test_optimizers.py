@@ -124,3 +124,86 @@ def test_global_norm_clip():
         0.1, grad_clip=fluid.clip.GradientClipByGlobalNorm(0.5))
     final = _train_quadratic(opt, steps=200)
     assert final < 0.1, final
+
+
+def test_adam_matches_hand_rollout_multi_param():
+    """Hand-rollout parity for the shared-beta-pow Adam (round 4): two
+    params, three steps, exact bias-corrected trajectory; the shared
+    pow advances once per STEP (not once per param)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    b1, b2, lr = 0.8, 0.95, 0.1
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        h = layers.fc(x, size=3, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name='w_a'))
+        p = layers.fc(h, size=1, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name='w_b'))
+        loss = layers.reduce_mean(layers.square_error_cost(p, y))
+        fluid.optimizer.Adam(lr, beta1=b1, beta2=b2).minimize(loss)
+    xd = np.asarray([[1., 2., -1., 0.5], [0.5, -1., 2., 1.]],
+                    dtype='float32')
+    yd = np.zeros((2, 1), 'float32')
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        wa = np.asarray(fluid.core.as_array(sc.find_var('w_a'))).copy()
+        wb = np.asarray(fluid.core.as_array(sc.find_var('w_b'))).copy()
+        ma = np.zeros_like(wa); va = np.zeros_like(wa)
+        mb = np.zeros_like(wb); vb = np.zeros_like(wb)
+        for t in range(1, 4):
+            exe.run(main, feed={'x': xd, 'y': yd}, fetch_list=[loss])
+            hidden = xd @ wa
+            pred = hidden @ wb
+            dpred = (2.0 / xd.shape[0]) * (pred - yd)
+            gb = hidden.T @ dpred
+            ga = xd.T @ (dpred @ wb.T)
+            lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+            for (w, m, v, g) in ((wa, ma, va, ga), (wb, mb, vb, gb)):
+                m *= b1; m += (1 - b1) * g
+                v *= b2; v += (1 - b2) * g * g
+                w -= lr_t * m / (np.sqrt(v) + 1e-8)
+        got_a = np.asarray(fluid.core.as_array(sc.find_var('w_a')))
+        got_b = np.asarray(fluid.core.as_array(sc.find_var('w_b')))
+        # the SHARED pow advanced exactly beta^3 (once per step)
+        pows = [float(np.asarray(fluid.core.as_array(v)).ravel()[0])
+                for n, v in sc._vars.items() if 'beta1_pow_acc' in n]
+    assert len(pows) == 1, pows  # ONE shared accumulator
+    assert abs(pows[0] - b1 ** 3) < 1e-6, pows
+    np.testing.assert_allclose(got_a, wa, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_b, wb, rtol=1e-4, atol=1e-5)
+
+
+def test_beta_pow_advances_once_per_step_adam_and_lamb():
+    """Regression for the shared-pow refactor: after ONE step with N
+    params, beta1_pow must equal beta1 exactly — for Adam (one shared
+    pow) AND Lamb (per-param pows advanced by its own op)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    for opt_cls, kw in ((fluid.optimizer.Adam, {}),
+                        (fluid.optimizer.Lamb,
+                         {'lamb_weight_decay': 0.0})):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[4], dtype='float32')
+            h = layers.fc(x, size=3)           # weight + bias
+            p = layers.fc(h, size=1)           # weight + bias
+            loss = layers.reduce_mean(p)
+            opt_cls(0.01, beta1=0.9, **kw).minimize(loss)
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                    fetch_list=[loss])
+            pows = [float(np.asarray(fluid.core.as_array(v)).ravel()[0])
+                    for n, v in sc._vars.items()
+                    if 'beta1_pow_acc' in n]
+        assert pows, opt_cls
+        for pw in pows:
+            assert abs(pw - 0.9) < 1e-6, (opt_cls.__name__, pows)

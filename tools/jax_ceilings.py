@@ -289,6 +289,10 @@ def run_widedeep(batch, steps):
 # ------------------------------------------------------------------ nmt
 
 def run_nmt(batch, steps, src_len=64, tgt_len=64):
+    # FAITHFUL to models/transformer.py + bench_transformer: fc biases
+    # everywhere, dropout on embeddings / attention probs / ffn mid
+    # (18+ sites), additive pad bias on encoder scores, post-LN, label
+    # smoothing, AMP + dynamic loss scaling, Adam
     V, H, NH, FF, L = 10000, 512, 8, 2048, 6
     D = H // NH
     drop = 0.1
@@ -298,19 +302,28 @@ def run_nmt(batch, steps, src_len=64, tgt_len=64):
     def w(*shape):
         return (rng.randn(*shape) * 0.02).astype(np.float32)
 
-    params = {'semb': w(V, H), 'temb': w(V, H), 'proj': w(H, V)}
+    def b(n):
+        return np.zeros(n, np.float32)
+
+    params = {'semb': w(V, H), 'temb': w(V, H), 'proj': w(H, V),
+              'proj_b': b(V)}
     for side, n in (('e', L), ('d', L)):
         for i in range(n):
             pre = '%s%d_' % (side, i)
-            params.update({pre + 'qkv': w(H, 3 * H), pre + 'o': w(H, H),
+            params.update({pre + 'qkv': w(H, 3 * H),
+                           pre + 'qkv_b': b(3 * H),
+                           pre + 'o': w(H, H), pre + 'o_b': b(H),
                            pre + 'ln1g': np.ones(H, np.float32),
                            pre + 'ln1b': np.zeros(H, np.float32),
-                           pre + 'f1': w(H, FF), pre + 'f2': w(FF, H),
+                           pre + 'f1': w(H, FF), pre + 'f1_b': b(FF),
+                           pre + 'f2': w(FF, H), pre + 'f2_b': b(H),
                            pre + 'ln2g': np.ones(H, np.float32),
                            pre + 'ln2b': np.zeros(H, np.float32)})
             if side == 'd':
-                params.update({pre + 'xq': w(H, H), pre + 'xk': w(H, H),
-                               pre + 'xv': w(H, H), pre + 'xo': w(H, H),
+                params.update({pre + 'xq': w(H, H), pre + 'xq_b': b(H),
+                               pre + 'xk': w(H, H), pre + 'xk_b': b(H),
+                               pre + 'xv': w(H, H), pre + 'xv_b': b(H),
+                               pre + 'xo': w(H, H), pre + 'xo_b': b(H),
                                pre + 'ln3g': np.ones(H, np.float32),
                                pre + 'ln3b': np.zeros(H, np.float32)})
 
@@ -325,15 +338,17 @@ def run_nmt(batch, steps, src_len=64, tgt_len=64):
         pe = np.where(i % 2 == 0, np.sin(ang), np.cos(ang))
         return jnp.asarray(pe, BF16)
 
-    def mha(q_in, kv_in, wqkv, wo, causal, xattn=None):
+
+    def mha(q_in, kv_in, wqkv, wo, causal, key, xattn=None,
+            bias=None):
         if xattn is None:
-            qkv = q_in @ wqkv.astype(q_in.dtype)
+            qkv = dense(q_in, wqkv[0], wqkv[1])
             q, k, v = jnp.split(qkv, 3, -1)
         else:
-            wq, wk, wv = xattn
-            q = q_in @ wq.astype(q_in.dtype)
-            k = kv_in @ wk.astype(q_in.dtype)
-            v = kv_in @ wv.astype(q_in.dtype)
+            (wq, bq_), (wk, bk_), (wv, bv_) = xattn
+            q = dense(q_in, wq, bq_)
+            k = dense(kv_in, wk, bk_)
+            v = dense(kv_in, wv, bv_)
         b, tq = q.shape[:2]
         tk = k.shape[1]
         q = q.reshape(b, tq, NH, D)
@@ -341,24 +356,31 @@ def run_nmt(batch, steps, src_len=64, tgt_len=64):
         v = v.reshape(b, tk, NH, D)
         s = jnp.einsum('bthd,bshd->bhts', q, k,
                        preferred_element_type=jnp.float32) / D ** 0.5
+        if bias is not None:
+            s = s + bias
         if causal:
             mask = jnp.tril(jnp.ones((tq, tk), bool))
             s = jnp.where(mask[None, None], s, -jnp.inf)
         p = jax.nn.softmax(s, -1).astype(q_in.dtype)
+        p = dropout(p, drop, key)
         ctx = jnp.einsum('bhts,bshd->bthd', p, v).reshape(b, tq, H)
-        return ctx @ wo.astype(q_in.dtype)
+        return dense(ctx, wo[0], wo[1])
 
-    def loss_fn(p, src, tgt, lab, key):
-        keys = jax.random.split(key, 4 * L + 2)
+    def loss_fn(p, src, tgt, lab, pad_bias, key):
+        keys = jax.random.split(key, 8 * L + 2)
+        kc = iter(range(8 * L))
         x = (p['semb'][src].astype(BF16) * (H ** 0.5) +
              posenc(src_len)[None])
         x = dropout(x, drop, keys[-1])
         for i in range(L):
             pre = 'e%d_' % i
-            a = mha(x, x, p[pre + 'qkv'], p[pre + 'o'], False)
+            a = mha(x, x, (p[pre + 'qkv'], p[pre + 'qkv_b']),
+                    (p[pre + 'o'], p[pre + 'o_b']), False,
+                    keys[next(kc)], bias=pad_bias)
             x = layer_norm(x + a, p[pre + 'ln1g'], p[pre + 'ln1b'])
-            f = jax.nn.relu(x @ p[pre + 'f1'].astype(x.dtype))
-            f = f @ p[pre + 'f2'].astype(x.dtype)
+            f = jax.nn.relu(dense(x, p[pre + 'f1'], p[pre + 'f1_b']))
+            f = dropout(f, drop, keys[next(kc)])
+            f = dense(f, p[pre + 'f2'], p[pre + 'f2_b'])
             x = layer_norm(x + f, p[pre + 'ln2g'], p[pre + 'ln2b'])
         mem = x
         y = (p['temb'][tgt].astype(BF16) * (H ** 0.5) +
@@ -366,16 +388,23 @@ def run_nmt(batch, steps, src_len=64, tgt_len=64):
         y = dropout(y, drop, keys[-2])
         for i in range(L):
             pre = 'd%d_' % i
-            a = mha(y, y, p[pre + 'qkv'], p[pre + 'o'], True)
+            a = mha(y, y, (p[pre + 'qkv'], p[pre + 'qkv_b']),
+                    (p[pre + 'o'], p[pre + 'o_b']), True,
+                    keys[next(kc)])
             y = layer_norm(y + a, p[pre + 'ln1g'], p[pre + 'ln1b'])
-            xa = mha(y, mem, None, p[pre + 'xo'], False,
-                     xattn=(p[pre + 'xq'], p[pre + 'xk'],
-                            p[pre + 'xv']))
+            xa = mha(y, mem, None,
+                     (p[pre + 'xo'], p[pre + 'xo_b']), False,
+                     keys[next(kc)],
+                     xattn=((p[pre + 'xq'], p[pre + 'xq_b']),
+                            (p[pre + 'xk'], p[pre + 'xk_b']),
+                            (p[pre + 'xv'], p[pre + 'xv_b'])),
+                     bias=pad_bias)
             y = layer_norm(y + xa, p[pre + 'ln3g'], p[pre + 'ln3b'])
-            f = jax.nn.relu(y @ p[pre + 'f1'].astype(y.dtype))
-            f = f @ p[pre + 'f2'].astype(y.dtype)
+            f = jax.nn.relu(dense(y, p[pre + 'f1'], p[pre + 'f1_b']))
+            f = dropout(f, drop, keys[next(kc)])
+            f = dense(f, p[pre + 'f2'], p[pre + 'f2_b'])
             y = layer_norm(y + f, p[pre + 'ln2g'], p[pre + 'ln2b'])
-        logits = (y @ p['proj'].astype(y.dtype)).astype(jnp.float32)
+        logits = dense(y, p['proj'], p['proj_b']).astype(jnp.float32)
         lp = jax.nn.log_softmax(logits, -1)
         smooth = (1 - eps_ls)
         nll = -jnp.take_along_axis(lp, lab[..., None], -1)[..., 0]
@@ -386,15 +415,19 @@ def run_nmt(batch, steps, src_len=64, tgt_len=64):
     scale = {'s': jnp.float32(32768.0), 'good': jnp.zeros((), jnp.int32)}
 
     @jax.jit
-    def step(state, src, tgt, lab):
+    def step(state, src, tgt, lab, pad_bias):
         params, opt, scale, it = state
         key = jax.random.fold_in(jax.random.PRNGKey(0), it)
         loss, params, opt, scale = scaled_step(
-            loss_fn, params, opt, scale, src, tgt, lab, key)
+            loss_fn, params, opt, scale, src, tgt, lab, pad_bias, key)
         return (params, opt, scale, it + 1)
 
     state = (params, opt, scale, jnp.zeros((), jnp.int32))
-    dt = timeit(step, state, steps, (src, tgt, lab))
+    # pad bias rides as a RUNTIME argument: a captured zeros constant
+    # would be algebraically deleted by XLA and the ceiling would not
+    # pay the add+broadcast the framework model pays
+    pad_bias_np = np.zeros((batch, 1, 1, src_len), np.float32)
+    dt = timeit(step, state, steps, (src, tgt, lab, pad_bias_np))
     print('nmt ceiling b%d %d/%d: %.2f ms/step (%.0f tok/s)'
           % (batch, src_len, tgt_len, dt * 1e3,
              batch * tgt_len / dt))
