@@ -331,6 +331,72 @@ def batch_norm(ctx, ins, attrs):
             'SavedMean': [saved_m], 'SavedVariance': [inv]}
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ln_core(x2, scale, bias, eps):
+    y, _, _, _ = _ln_fwd_math(x2, scale, bias, eps)
+    return y
+
+
+def _ln_row_stats(x2):
+    """Per-row (mean, var) in f32.  Half-precision inputs take the
+    fused one-pass E[x^2]-m^2 form (their own mantissa noise dwarfs
+    the cancellation); f32 inputs use the two-pass centered form —
+    E[x^2]-m^2 catastrophically cancels when |mean| >> std (same
+    policy as the batch_norm lowering)."""
+    xf = x2.astype(jnp.float32)
+    m = jnp.mean(xf, axis=1, keepdims=True)
+    if x2.dtype in (jnp.float32, jnp.float64):
+        v = jnp.mean(jnp.square(xf - m), axis=1, keepdims=True)
+    else:
+        v = jnp.maximum(
+            jnp.mean(xf * xf, axis=1, keepdims=True) - m * m, 0.0)
+    return xf, m, v
+
+
+def _ln_fwd_math(x2, scale, bias, eps):
+    xf, m, v = _ln_row_stats(x2)
+    rstd = jax.lax.rsqrt(v + eps)
+    xhat = (xf - m) * rstd
+    y = xhat
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)[None, :]
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)[None, :]
+    return y.astype(x2.dtype), xhat, m, v
+
+
+def _ln_fwd_rule(x2, scale, bias, eps):
+    y, xhat, m, v = _ln_fwd_math(x2, scale, bias, eps)
+    # residuals: xhat in the INPUT dtype (bf16 under AMP) + per-row
+    # rstd — the lean saved set the analytic backward needs.  Letting
+    # jax.vjp differentiate mean/var instead keeps several full f32
+    # activation tensors alive per LN: on BERT-large-context that was
+    # +2.7 GB/layer of HBM traffic (BENCHMARKS.md round 4).
+    rstd = jax.lax.rsqrt(v + eps)
+    return y, (xhat.astype(x2.dtype), rstd, scale, bias)
+
+
+def _ln_bwd_rule(eps, res, g):
+    xhat_s, rstd, scale, bias = res
+    xdt = xhat_s.dtype  # xhat saved in the input dtype
+    gf = g.astype(jnp.float32)
+    xh = xhat_s.astype(jnp.float32)
+    dbias = None if bias is None else jnp.sum(gf, axis=0).astype(
+        bias.dtype)
+    dscale = None if scale is None else jnp.sum(gf * xh, axis=0).astype(
+        scale.dtype)
+    gs = gf if scale is None else gf * scale.astype(jnp.float32)[None]
+    dx = rstd * (gs - jnp.mean(gs, axis=1, keepdims=True) -
+                 xh * jnp.mean(gs * xh, axis=1, keepdims=True))
+    return dx.astype(xdt), dscale, dbias
+
+
+_ln_core.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
 @register('layer_norm', no_grad_out_slots=('Mean', 'Variance'))
 def layer_norm(ctx, ins, attrs):
     x = ins['X'][0]
@@ -338,16 +404,14 @@ def layer_norm(ctx, ins, attrs):
     begin = attrs.get('begin_norm_axis', 1)
     shape = x.shape
     lead = int(np.prod(shape[:begin]))
-    x2 = x.reshape(lead, -1).astype(jnp.float32)
-    m = jnp.mean(x2, axis=1, keepdims=True)
-    v = jnp.var(x2, axis=1, keepdims=True)
-    y = (x2 - m) * jax.lax.rsqrt(v + eps)
-    y = y.reshape(shape)
-    if 'Scale' in ins and ins['Scale']:
-        y = y * ins['Scale'][0].reshape((1,) * begin + shape[begin:])
-    if 'Bias' in ins and ins['Bias']:
-        y = y + ins['Bias'][0].reshape((1,) * begin + shape[begin:])
-    return {'Y': [y.astype(x.dtype)],
+    x2 = x.reshape(lead, -1)
+    scale = ins['Scale'][0].reshape(-1) if ins.get('Scale') else None
+    bias = ins['Bias'][0].reshape(-1) if ins.get('Bias') else None
+    y = _ln_core(x2, scale, bias, float(eps))
+    # Mean/Variance side outputs (no-grad): recomputed outside the
+    # custom-vjp core; XLA CSE merges them with the core's own stats
+    _, m, v = _ln_row_stats(x2)
+    return {'Y': [y.reshape(shape)],
             'Mean': [m.reshape(lead)], 'Variance': [v.reshape(lead)]}
 
 

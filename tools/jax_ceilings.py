@@ -120,8 +120,8 @@ def timeit(step, state, steps, feed):
 
 # ---------------------------------------------------------------- bert
 
-def run_bert(batch, seq, steps, ablate=()):
-    V, H, L, NH, FF, TV = 30522, 768, 12, 12, 3072, 2
+def run_bert(batch, seq, steps, ablate=(), n_layers=12):
+    V, H, L, NH, FF, TV = 30522, 768, n_layers, 12, 3072, 2
     D = H // NH
     drop = 0.0 if 'dropout' in ablate else 0.1
     attn_drop = (0.1 if seq < 512 else 0.0) if 'dropout' not in ablate \
@@ -163,29 +163,36 @@ def run_bert(batch, seq, steps, ablate=()):
             os.path.abspath(__file__))))
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
-    def attention(x, p, i, key):
+    def attention(x, p, i, key, key_bias):
         qkv = dense(x, p['l%d_qkv' % i], p['l%d_qkv_b' % i])
         q, k, v = jnp.split(qkv, 3, -1)
         q, k, v = [a.reshape(batch, seq, NH, D) for a in (q, k, v)]
         if use_flash:
-            ctx = flash_attention(q, k, v, min_seq=0)
+            # the framework's bench passes the input mask as the flash
+            # key bias; ride it as a runtime arg so the ceiling pays
+            # the same per-block bias add + dbias backward
+            ctx = flash_attention(q, k, v, min_seq=0,
+                                  key_bias=key_bias)
         else:
             s = jnp.einsum('bthd,bshd->bhts', q, k,
                            preferred_element_type=jnp.float32) / D ** 0.5
+            # the framework's naive chain adds the input-mask bias too
+            s = s + key_bias[:, None, None, :]
             pr = jax.nn.softmax(s, -1).astype(x.dtype)
             pr = dropout(pr, attn_drop, key)
             ctx = jnp.einsum('bhts,bshd->bthd', pr, v)
         return dense(ctx.reshape(batch, seq, H), p['l%d_o' % i],
                      p['l%d_o_b' % i])
 
-    def loss_fn(p, ids, sent_ids, mlm_label, nsp_label, step_key):
+    def loss_fn(p, ids, sent_ids, mlm_label, nsp_label, key_bias,
+                step_key):
         x = (p['emb'][ids] + p['pos'][None, :, :] +
              p['sent'][sent_ids]).astype(BF16)
         x = layer_norm(x, p['ln0_g'], p['ln0_b'])
         keys = jax.random.split(step_key, 3 * L)
         for i in range(L):
-            a = dropout(attention(x, p, i, keys[3 * i]), drop,
-                        keys[3 * i + 1])
+            a = dropout(attention(x, p, i, keys[3 * i], key_bias),
+                        drop, keys[3 * i + 1])
             x = layer_norm(x + a, p['l%d_ln1_g' % i], p['l%d_ln1_b' % i])
             f = dense(x, p['l%d_f1' % i], p['l%d_f1_b' % i])
             f = jax.nn.gelu(f, approximate=False)
@@ -214,21 +221,23 @@ def run_bert(batch, seq, steps, ablate=()):
     scale = {'s': jnp.float32(32768.0), 'good': jnp.zeros((), jnp.int32)}
 
     @jax.jit
-    def step(state, ids, sent_ids, mlm_label, nsp_label):
+    def step(state, ids, sent_ids, mlm_label, nsp_label, key_bias):
         params, opt, scale, it = state
         key = jax.random.fold_in(jax.random.PRNGKey(0), it)
         if 'scaling' in ablate:
             loss, grads = jax.value_and_grad(loss_fn)(
-                params, ids, sent_ids, mlm_label, nsp_label, key)
+                params, ids, sent_ids, mlm_label, nsp_label, key_bias,
+                key)
             params, opt = adam_apply(params, grads, opt)
         else:
             loss, params, opt, scale = scaled_step(
                 loss_fn, params, opt, scale, ids, sent_ids, mlm_label,
-                nsp_label, key)
+                nsp_label, key_bias, key)
         return (params, opt, scale, it + 1)
 
     state = (params, opt, scale, jnp.zeros((), jnp.int32))
-    dt = timeit(step, state, steps, (ids, sent, mlm, nsp))
+    key_bias = np.zeros((batch, seq), np.float32)  # used on flash path
+    dt = timeit(step, state, steps, (ids, sent, mlm, nsp, key_bias))
     print('bert ceiling b%d s%d%s: %.2f ms/step (%.1f seq/s)'
           % (batch, seq,
              (' -' + ','.join(sorted(ablate))) if ablate else '',
@@ -441,10 +450,12 @@ def main():
     ap.add_argument('--steps', type=int, default=20)
     ap.add_argument('--ablate', default='',
                     help='comma list: dropout,head,scaling')
+    ap.add_argument('--layers', type=int, default=12)
     args = ap.parse_args()
     if args.which == 'bert':
         run_bert(args.batch or 32, args.seq, args.steps,
-                 ablate=tuple(a for a in args.ablate.split(',') if a))
+                 ablate=tuple(a for a in args.ablate.split(',') if a),
+                 n_layers=args.layers)
     elif args.which == 'widedeep':
         run_widedeep(args.batch or 2048, args.steps)
     else:
