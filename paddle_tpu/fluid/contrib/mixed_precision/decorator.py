@@ -18,10 +18,34 @@ from .fp16_lists import AutoMixedPrecisionLists
 
 
 def _mark_amp_ops(program, amp_lists):
+    """White ops run their MXU dots in bf16 ('__amp__'); gray ops FOLLOW
+    a low-precision input by casting their f32 inputs down
+    ('__amp_gray__', applied in OpDef.run) — the reference
+    fp16_utils._insert_cast_op rule.  Without the gray mark, jnp type
+    promotion casts the bf16 matmul output back UP at every f32
+    master-param bias add, and the whole downstream activation stream
+    (residuals, attention operands) silently runs f32 at double HBM
+    traffic.  Black ops cast up to f32 ('__amp_black__') for numerics
+    (softmax/CE/reductions)."""
+    # norm ops keep their f32 params (the reference rewrite also never
+    # casts BN/LN Scale/Bias/stats): their lowerings already compute
+    # stats in f32 and emit outputs in the input dtype, so the follow
+    # rule is theirs for free without degrading the parameters
+    no_harmonize = {'batch_norm', 'layer_norm', 'instance_norm',
+                    'group_norm', 'sync_batch_norm',
+                    # computes in f32 internally with an analytic vjp
+                    # whose residual is the logits AS THEY ARRIVED —
+                    # black-casting bf16 logits up would turn that
+                    # free residual into a 2x-sized f32 buffer
+                    'softmax_with_cross_entropy'}
     for block in program.blocks:
         for op in block.ops:
             if op.type in amp_lists.white_list:
                 op.attrs['__amp__'] = True
+            elif op.type in amp_lists.gray_list - no_harmonize:
+                op.attrs['__amp_gray__'] = True
+            elif op.type in amp_lists.black_list - no_harmonize:
+                op.attrs['__amp_black__'] = True
     program._bump_version()
 
 

@@ -179,7 +179,7 @@ class Tracer(object):
         opdef = registry.get(op_type)
         ins_vals = {s: [v.value for v in vs] for s, vs in inputs.items()}
         ctx = registry.LowerCtx(self._step, attrs['__op_seed__'])
-        outs_vals = opdef.fn(ctx, ins_vals, attrs)
+        outs_vals = opdef.run(ctx, ins_vals, attrs)
         outputs = {s: [VarBase(v) for v in vs]
                    for s, vs in outs_vals.items()}
         if self._capture is not None:

@@ -94,7 +94,7 @@ def _lower_ops(ops, env, step, prefer_test):
             # the scope name flows into XLA op metadata so Perfetto
             # traces and HLO dumps read as fluid op names
             with jax.named_scope(op.type):
-                outs = opdef.fn(ctx, ins, op.attrs)
+                outs = opdef.run(ctx, ins, op.attrs)
         except Exception as e:
             # enforce-style error context (reference: PADDLE_ENFORCE +
             # op_callstack, platform/enforce.h, framework/op_call_stack.h)
@@ -161,7 +161,13 @@ def _lower_while(op, env, step, prefer_test):
         local = dict(env)
         local.update(carry)
         _lower_ops(sub.ops, local, step, prefer_test)
-        return {n: local[n] for n in carry_names}
+        # carries must be dtype-stable across iterations: AMP-marked ops
+        # inside the body may emit bf16 from an f32 entry carry (the
+        # __amp__/__amp_gray__ lowerings), which lax.while_loop rejects
+        # as a carry-aval mismatch — pin to the entry dtype, the same
+        # rule _while_scan and conditional_block already apply
+        return {n: jnp.asarray(local[n]).astype(
+            jnp.asarray(carry[n]).dtype) for n in carry_names}
 
     init = {n: env[n] for n in carry_names}
     final = jax.lax.while_loop(cond_fn, body_fn, init)

@@ -7,6 +7,8 @@ lax.conv_general_dilated / reduce_window so XLA tiles them onto the MXU.
 Gradients come from jax.vjp over these lowerings (registry.grad_op_def).
 """
 
+import functools as _functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -331,9 +333,6 @@ def batch_norm(ctx, ins, attrs):
             'SavedMean': [saved_m], 'SavedVariance': [inv]}
 
 
-import functools as _functools
-
-
 @_functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _ln_core(x2, scale, bias, eps):
     y, _, _, _ = _ln_fwd_math(x2, scale, bias, eps)
@@ -474,6 +473,64 @@ def dropout(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _swce_core(logits, lab, ax, ignore_index):
+    """Hard-label softmax-CE along axis `ax` with an ANALYTIC backward.
+    The jax.vjp-synthesized gradient keeps the full f32 log-prob tensor
+    as a residual — at BERT's MLM head that is a ~1 GB [B, T, V] f32
+    buffer written+read per step.  The lean saved set is (logits as
+    they arrived — usually bf16 under AMP, a buffer that is ALIVE
+    anyway as the fc output — plus the per-row f32 logsumexp), and
+    backward recomputes the softmax from them:
+    dLogits = g_loss * (softmax - onehot) on valid rows, plus the
+    softmax-jacobian term for the (normally unused, zero-cotangent)
+    Softmax output.  Works on the NATIVE logits shape — flattening to
+    [rows, classes] would pin the tensor to the 2-D matmul layout and
+    buy a full layout-change copy.  Mirrors the reference's fused
+    softmax_with_cross_entropy_grad kernel
+    (operators/softmax_with_cross_entropy_op.cu).
+
+    `lab` has the logits rank with a size-1 dim at `ax`."""
+    y, _ = _swce_fwd_math(logits, lab, ax, ignore_index)
+    return y
+
+
+def _swce_fwd_math(logits, lab, ax, ignore_index):
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=ax, keepdims=True)
+    lab_safe = jnp.where(lab == ignore_index, 0, lab).astype(jnp.int32)
+    picked = jnp.take_along_axis(lf, lab_safe, axis=ax) - lse
+    valid = lab != ignore_index
+    loss = jnp.where(valid, -picked, 0.0)
+    softmax = jnp.exp(lf - lse)
+    return ((softmax.astype(logits.dtype),
+             loss.astype(logits.dtype)), lse)
+
+
+def _swce_fwd_rule(logits, lab, ax, ignore_index):
+    y, lse = _swce_fwd_math(logits, lab, ax, ignore_index)
+    return y, (logits, lse, lab)
+
+
+def _swce_bwd_rule(ax, ignore_index, res, cts):
+    logits, lse, lab = res
+    g_s, g_l = cts
+    p = jnp.exp(logits.astype(jnp.float32) - lse)
+    gs = g_s.astype(jnp.float32)
+    gl = g_l.astype(jnp.float32)
+    lab_safe = jnp.where(lab == ignore_index, 0, lab).astype(jnp.int32)
+    iota = jax.lax.broadcasted_iota(jnp.int32, p.shape, ax)
+    onehot = (iota == lab_safe).astype(jnp.float32)
+    d = jnp.where(lab != ignore_index, gl, 0.0) * (p - onehot)
+    # Softmax-output term: normally a zero cotangent (the residual is
+    # only consumed by the grad op), and XLA folds the constant away
+    d = d + p * (gs - jnp.sum(gs * p, axis=ax, keepdims=True))
+    return d.astype(logits.dtype), None
+
+
+_swce_core.defvjp(_swce_fwd_rule, _swce_bwd_rule)
+
+
 @register('softmax_with_cross_entropy')
 def softmax_with_cross_entropy(ctx, ins, attrs):
     logits = ins['Logits'][0]
@@ -481,21 +538,16 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     axis = attrs.get('axis', -1)
     soft_label = attrs.get('soft_label', False)
     ignore_index = attrs.get('ignore_index', -100)
+    if not soft_label:
+        ax = axis % logits.ndim
+        lab = label
+        if lab.ndim != logits.ndim:
+            lab = jnp.expand_dims(lab, ax)
+        softmax, loss = _swce_core(logits, lab, ax, int(ignore_index))
+        return {'Softmax': [softmax], 'Loss': [loss]}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     softmax = jnp.exp(logp)
-    if soft_label:
-        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
-    else:
-        lab = label
-        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
-            lab = jnp.squeeze(lab, axis)
-        lab_safe = jnp.where(lab == ignore_index, 0, lab)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(lab_safe, axis).astype(jnp.int32),
-            axis=axis)
-        loss = -picked
-        loss = jnp.where(jnp.expand_dims(lab, axis) == ignore_index,
-                         jnp.zeros_like(loss), loss)
+    loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
     return {'Softmax': [softmax.astype(logits.dtype)],
             'Loss': [loss.astype(logits.dtype)]}
 

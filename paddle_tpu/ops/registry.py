@@ -53,6 +53,50 @@ class OpDef(object):
         self.no_grad_out_slots = tuple(no_grad_out_slots)
         self.host_only = host_only
 
+    def run(self, ctx, ins, attrs):
+        """Invoke the lowering with AMP gray/black dtype harmonization
+        (reference fp16_utils._insert_cast_op: gray ops FOLLOW a
+        low-precision input by casting the f32 side DOWN — without this,
+        jnp type promotion silently casts a bf16 activation UP at every
+        f32 master-param bias add, and everything downstream — residual
+        stream, flash-attention operands — runs f32 at double HBM
+        traffic; black ops cast up to f32).  Grad ops skip the top-level
+        pass: their synthesized fn replays the forward through run(), so
+        the casts sit INSIDE the vjp and master-param gradients come
+        back f32, the reference's backward cast op."""
+        if not self.type.endswith("_grad"):
+            ins = _amp_harmonize(ins, attrs)
+        return self.fn(ctx, ins, attrs)
+
+
+def _amp_harmonize(ins, attrs):
+    if attrs.get("__amp_black__"):
+        def up(v):
+            dt = getattr(v, "dtype", None)
+            if dt is not None and (dt == jnp.bfloat16 or dt == jnp.float16):
+                return jnp.asarray(v, jnp.float32)
+            return v
+        return {s: [up(v) for v in vs] for s, vs in ins.items()}
+    if attrs.get("__amp_gray__"):
+        low = None
+        for vs in ins.values():
+            for v in vs:
+                dt = getattr(v, "dtype", None)
+                if dt is not None and (dt == jnp.bfloat16
+                                       or dt == jnp.float16):
+                    low = dt
+                    break
+            if low is not None:
+                break
+        if low is None:
+            return ins
+        def down(v):
+            if getattr(v, "dtype", None) == jnp.float32:
+                return jnp.asarray(v, low)
+            return v
+        return {s: [down(v) for v in vs] for s, vs in ins.items()}
+    return ins
+
 
 _REGISTRY = {}
 # Op types executed by the host runtime, never traced into XLA.
@@ -125,7 +169,7 @@ def grad_op_def(fwd):
         primals = {s: ins[s] for s in primal_slots}
 
         def f(p):
-            outs = fwd.fn(ctx, p, attrs)
+            outs = fwd.run(ctx, p, attrs)
             # Only float outputs participate in differentiation.
             return {
                 s: [v for v in vs]
@@ -193,7 +237,7 @@ def infer_shapes(op_type, in_specs, attrs, prefer_test=True):
                    prefer_test=True)
 
     def f(ins):
-        return opdef.fn(ctx, ins, attrs)
+        return opdef.run(ctx, ins, attrs)
 
     out = jax.eval_shape(f, abstract)
     result = {}
@@ -202,6 +246,17 @@ def infer_shapes(op_type, in_specs, attrs, prefer_test=True):
         for v in vs:
             shape = tuple(v.shape)
             if has_dyn:
+                # only dims EQUAL to the sentinel map back to -1.
+                # Products of it (layer_norm's Mean row count, a
+                # beam-expanded batch) deliberately stay literal: they
+                # re-enter later infer_shapes calls as input specs, and
+                # keeping the concrete product is what lets downstream
+                # size arithmetic (reshape -1 inference across a
+                # beam-width fold, etc.) stay consistent — mapping them
+                # to -1 would re-substitute the bare sentinel and lose
+                # the multiplier.  The cost is cosmetic: declared
+                # shapes can show sentinel-scaled dims where the true
+                # value is batch-dependent.
                 shape = tuple(-1 if d == _DYN_SENTINEL else d for d in shape)
             row.append((shape, v.dtype))
         result[slot] = row

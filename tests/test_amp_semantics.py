@@ -117,3 +117,81 @@ def test_amp_training_converges_with_bf16_compute():
     # still be near-converged and track the f32 run
     assert amp < 0.25, amp
     assert abs(amp - ref) < 0.15, (amp, ref)
+
+
+def test_gray_ops_follow_bf16_not_promote_f32():
+    """The fp16_utils follow rule: a gray op (bias add) fed a bf16
+    white-op output and an f32 master param casts the PARAM down, so
+    the activation stream stays bf16 — jnp promotion casting the whole
+    downstream f32 (double HBM traffic; round-4 BERT-long root cause)
+    is the bug this pins.  Master params and their gradients stay f32."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        h = layers.fc(x, 16, act='relu')     # mul + add + relu
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(0.01), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    add_outs = [op.output('Out')[0] for op in main.global_block().ops
+                if op.type == 'elementwise_add'
+                and op.attrs.get('__amp_gray__')]
+    assert add_outs, 'no gray-marked bias adds found'
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        fetched = exe.run(main, feed={
+            'x': rng.randn(4, 8).astype('float32'),
+            'y': rng.randn(4, 1).astype('float32')},
+            fetch_list=[add_outs[0], loss], return_numpy=False)
+        import jax.numpy as jnp
+        # the bias-add OUTPUT rides bf16 (the follow rule)
+        assert fetched[0].dtype == jnp.bfloat16, fetched[0].dtype
+        # master weights stay f32 in the scope
+        import paddle_tpu.fluid.core as core
+        params = [v.name for v in main.global_block().all_parameters()]
+        assert params, 'no parameters found'
+        for p in params:
+            v = core.as_array(scope.find_var(p))
+            assert v.dtype == jnp.float32, (p, v.dtype)
+
+
+def test_amp_while_loop_carry_dtype_stable():
+    """A while loop whose body runs AMP-marked matmuls must keep its
+    f32 carry dtype across iterations (lax.while_loop rejects carry
+    aval changes): the executor pins body outputs to the entry dtype,
+    the same boundary where the reference would re-insert cast ops."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4, 4], dtype='float32',
+                        append_batch_size=False)
+        w = layers.create_parameter([4, 4], 'float32', name='loop_w')
+        i = layers.fill_constant([1], 'int64', 0)
+        n = layers.fill_constant([1], 'int64', 3)
+        cond = layers.less_than(i, n)
+        wl = layers.While(cond)
+        with wl.block():
+            nx = layers.matmul(x, w)
+            layers.assign(nx, x)
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+        out = layers.reduce_sum(x)
+        # mark the program the way decorate() would
+        from paddle_tpu.fluid.contrib.mixed_precision.decorator import \
+            _mark_amp_ops
+        from paddle_tpu.fluid.contrib.mixed_precision.fp16_lists import \
+            AutoMixedPrecisionLists
+        _mark_amp_ops(main, AutoMixedPrecisionLists())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        val, = exe.run(main, feed={'x': np.eye(4, dtype='float32')},
+                       fetch_list=[out])
+    assert np.isfinite(np.asarray(val)).all()

@@ -215,9 +215,17 @@ MULTI = {
     'prelu': (lambda: {'X': away(rng.randn(2, 3, 2, 2), [0.0]),
                        'Alpha': rng.rand(1) + 0.1},
               {'mode': 'all'}, 'Out', {}),
+    # bilinear sampling's Grid-gradient has kinks where the sample
+    # point crosses an integer pixel coordinate (for a 4-wide input,
+    # normalized coords -1/3 and 1/3): the numeric gradient straddling
+    # a kink is garbage, and whether the shared rng lands near one
+    # depends on which tests ran before (pytest -k flake) — keep the
+    # draws away from the kinks
     'grid_sampler': (lambda: {'X': rng.randn(1, 2, 4, 4),
-                              'Grid': rng.uniform(-0.7, 0.7,
-                                                  (1, 3, 3, 2))},
+                              'Grid': away(rng.uniform(-0.7, 0.7,
+                                                       (1, 3, 3, 2)),
+                                           [-1.0 / 3, 1.0 / 3],
+                                           margin=0.04)},
                      {}, 'Output', {}),
     'kron': None,
     'dist': None,

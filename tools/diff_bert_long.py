@@ -1,0 +1,183 @@
+"""Diagnose the framework-vs-ceiling gap on long-context BERT (s2048).
+
+Builds BOTH programs in one process, prints XLA cost analysis
+(flops/bytes) for each, times them interleaved (A/B/A/B...) so tunnel
+drift cannot masquerade as a framework gap, and dumps both optimized
+HLOs under /tmp/bert_long_hlo/ for side-by-side inspection.
+
+Usage: python tools/diff_bert_long.py [--steps 6] [--rounds 3]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_framework(batch, seq):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    cfg = models.bert.BertConfig(max_pos=seq, attn_dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, enc, loss = models.bert.build_pretrain(cfg, seq)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(1e-4), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    import jax
+    rng = np.random.RandomState(0)
+    batch_data = models.bert.synthetic_batch(cfg, batch, seq, rng)
+    batch_data = {k: jax.device_put(v) for k, v in batch_data.items()}
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        cost = exe.program_cost(main, batch_data, fetch_list=[loss])
+        print('framework cost: %.1f GFLOP  %.2f GB/step'
+              % (cost['flops'] / 1e9, cost['bytes'] / 1e9))
+
+    def run_steps(n):
+        with fluid.scope_guard(scope):
+            for _ in range(n - 1):
+                exe.run(main, feed=batch_data, fetch_list=[])
+            out = exe.run(main, feed=batch_data, fetch_list=[loss])
+            np.asarray(out[0])
+    return run_steps
+
+
+def build_framework_direct(batch, seq):
+    """The SAME fluid program, but the compiled train segment driven in
+    a bare jitted loop (state threaded by hand, donation on) — isolates
+    the executor's per-step host path from the compiled program."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu.fluid.executor import _Segment, _make_segment_fn
+    from paddle_tpu.fluid import core
+    cfg = models.bert.BertConfig(max_pos=seq, attn_dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, enc, loss = models.bert.build_pretrain(cfg, seq)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(1e-4), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    batch_data = models.bert.synthetic_batch(cfg, batch, seq, rng)
+    batch_data = {k: jax.device_put(v) for k, v in batch_data.items()}
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        plan = exe._build_plan(main, tuple(sorted(batch_data.keys())),
+                               ())
+        segs = [it for it in plan if isinstance(it, _Segment)]
+        assert len(segs) == 1, [len(s.ops) for s in segs]
+        seg = segs[0]
+        fn = jax.jit(_make_segment_fn(seg), donate_argnums=(1,))
+        state = {n: core.as_array(scope.find_var(n))
+                 for n in seg.state_names}
+        data = {n: batch_data.get(
+                    n, core.as_array(scope.find_var(n)))
+                for n in seg.input_names}
+        out_state_names = [n for n in seg.output_names if n in state]
+        holder = {'state': state, 'step': 0}
+
+    def run_steps(n):
+        st = holder['state']
+        for _ in range(n):
+            outs = fn(holder['step'], st, data)
+            holder['step'] += 1
+            st = dict(st)
+            st.update({k: outs[k] for k in out_state_names})
+        holder['state'] = st
+        smallest = min(st.values(),
+                       key=lambda a: getattr(a, 'size', 1 << 60))
+        np.asarray(smallest)
+    return run_steps
+
+
+def build_ceiling(batch, seq):
+    import jax
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax_ceilings as jc
+    # replicate run_bert's setup but return a step closure + state
+    # (run_bert only prints; we need the jitted fn to time interleaved)
+    import jax.numpy as jnp
+    V, H, L, NH, FF, TV = 30522, 768, 12, 12, 3072, 2
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, (batch, seq)).astype('int32')
+    sent = np.zeros((batch, seq), 'int32')
+    mlm = np.where(rng.rand(batch, seq) < 0.15,
+                   rng.randint(0, V, (batch, seq)), -1).astype('int32')
+    nsp = rng.randint(0, 2, (batch,)).astype('int32')
+    key_bias = np.zeros((batch, seq), np.float32)
+
+    holder = {}
+    real_timeit = jc.timeit
+
+    def capture(step, state, steps, feed):
+        holder['step'] = step
+        holder['state'] = jax.tree.map(jax.numpy.asarray, state)
+        holder['feed'] = feed
+        return 1.0  # skip run_bert's own timing loop
+
+    jc.timeit = capture
+    try:
+        jc.run_bert(batch, seq, 1)
+    finally:
+        jc.timeit = real_timeit
+    step, state, feed = holder['step'], holder['state'], holder['feed']
+    lowered = step.lower(state, *feed)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    print('ceiling   cost: %.1f GFLOP  %.2f GB/step'
+          % (ca.get('flops', 0) / 1e9,
+             ca.get('bytes accessed', 0) / 1e9))
+    os.makedirs('/tmp/bert_long_hlo', exist_ok=True)
+    with open('/tmp/bert_long_hlo/ceiling.txt', 'w') as f:
+        f.write(compiled.as_text())
+
+    st = [state]
+
+    def run_steps(n):
+        for _ in range(n):
+            st[0] = step(st[0], *feed)
+        st[0][3].block_until_ready()  # the scalar step counter
+
+    return run_steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=6)
+    ap.add_argument('--rounds', type=int, default=3)
+    ap.add_argument('--batch', type=int, default=4)
+    ap.add_argument('--seq', type=int, default=2048)
+    args = ap.parse_args()
+
+    fw = build_framework(args.batch, args.seq)
+    fd = build_framework_direct(args.batch, args.seq)
+    ce = build_ceiling(args.batch, args.seq)
+    # warm all
+    fw(2)
+    fd(2)
+    ce(2)
+    for r in range(args.rounds):
+        for name, fn in (('framework', fw), ('fw-direct', fd),
+                         ('ceiling  ', ce)):
+            t0 = time.time()
+            fn(args.steps)
+            dt = (time.time() - t0) / args.steps * 1e3
+            print('round %d %s: %.1f ms/step' % (r, name, dt))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,102 @@
+"""Dump the optimized HLO of the BERT-long (s2048, flash) train segment
+so large-tensor traffic can be diffed against the hand-JAX ceiling
+(/tmp/bert_long_hlo/ceiling.txt from tools/diff_bert_long.py).
+
+Writes /tmp/bert_long_hlo/framework.txt and prints a tally of the
+big-shape (>=256 MB) tensors appearing in each.
+"""
+
+import os
+import re
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def big_shape_tally(path, min_mb=256):
+    nbytes = {'f32': 4, 'bf16': 2, 'f16': 2, 's32': 4, 'u32': 4,
+              's64': 8, 'u8': 1, 'pred': 1}
+    tally = Counter()
+    pat = re.compile(r'(f32|bf16|f16|s32|u32|s64|u8|pred)\[([0-9,]+)\]')
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            # count each op once by its OUTPUT shape (start of line
+            # after the assignment name)
+            m = re.match(r'%?[\w.-]+ = (\(?)(.*)', line)
+            if not m:
+                continue
+            first = pat.search(line.split('=', 1)[1][:120])
+            if not first:
+                continue
+            dt, dims = first.groups()
+            size = nbytes[dt]
+            for d in dims.split(','):
+                size *= int(d)
+            if size >= min_mb * 1024 * 1024:
+                tally['%s[%s] (%d MB)' % (dt, dims, size >> 20)] += 1
+    return tally
+
+
+def main():
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu.fluid.executor import _Segment, _make_segment_fn
+
+    batch, seq = 4, 2048
+    cfg = models.bert.BertConfig(max_pos=seq, attn_dropout=0.0)
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main_p, startup):
+        feeds, enc, loss = models.bert.build_pretrain(cfg, seq)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(1e-4), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    batch_data = models.bert.synthetic_batch(cfg, batch, seq, rng)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        plan = exe._build_plan(main_p,
+                               tuple(sorted(batch_data.keys())),
+                               (loss.name,))
+        os.makedirs('/tmp/bert_long_hlo', exist_ok=True)
+        for i, item in enumerate(plan):
+            if not isinstance(item, _Segment):
+                continue
+            fn = _make_segment_fn(item, item.prefer_test)
+            state = {n: fluid.core.as_array(scope.find_var(n))
+                     for n in item.state_names}
+            data = {n: batch_data.get(
+                        n, scope.find_var(n) and
+                        fluid.core.as_array(scope.find_var(n)))
+                    for n in item.input_names}
+            compiled = jax.jit(fn, donate_argnums=(1,)).lower(
+                0, state, data).compile()
+            out = '/tmp/bert_long_hlo/framework_%d.txt' % i
+            with open(out, 'w') as f:
+                f.write(compiled.as_text())
+            print('segment %d (%d ops) -> %s' % (i, len(item.ops), out))
+            ma = compiled.memory_analysis()
+            if ma:
+                print('  temp %d MB  output %d MB  argument %d MB'
+                      % (ma.temp_size_in_bytes >> 20,
+                         ma.output_size_in_bytes >> 20,
+                         ma.argument_size_in_bytes >> 20))
+
+    for path in sorted(os.listdir('/tmp/bert_long_hlo')):
+        full = os.path.join('/tmp/bert_long_hlo', path)
+        print('\n== %s big tensors ==' % path)
+        for k, v in sorted(big_shape_tally(full).items(),
+                           key=lambda kv: -kv[1]):
+            print('  %3dx %s' % (v, k))
+
+
+if __name__ == '__main__':
+    main()
