@@ -33,11 +33,11 @@ def _mark_amp_ops(program, amp_lists):
     # rule is theirs for free without degrading the parameters
     no_harmonize = {'batch_norm', 'layer_norm', 'instance_norm',
                     'group_norm', 'sync_batch_norm',
-                    # computes in f32 internally with an analytic vjp
-                    # whose residual is the logits AS THEY ARRIVED —
-                    # black-casting bf16 logits up would turn that
-                    # free residual into a 2x-sized f32 buffer
-                    'softmax_with_cross_entropy'}
+                    # compute in f32 internally; black-casting their
+                    # bf16 inputs up would only double the buffer
+                    # (SWCE's analytic-vjp residual is the logits AS
+                    # THEY ARRIVED; softmax emits its input dtype)
+                    'softmax_with_cross_entropy', 'softmax'}
     for block in program.blocks:
         for op in block.ops:
             if op.type in amp_lists.white_list:

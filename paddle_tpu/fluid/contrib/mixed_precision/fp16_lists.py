@@ -35,3 +35,9 @@ class AutoMixedPrecisionLists(object):
         if custom_black_list:
             self.black_list |= set(custom_black_list)
             self.white_list -= set(custom_black_list)
+        # a custom placement overrides gray membership too (the
+        # reference's _update_list does the same removal): without
+        # this, _mark_amp_ops's gray check shadows an op the user
+        # explicitly black/white-listed
+        self.gray_list -= set(custom_white_list or ())
+        self.gray_list -= set(custom_black_list or ())

@@ -136,8 +136,16 @@ register('softshrink')(soft_shrink)
 
 @register('softmax')
 def softmax(ctx, ins, attrs):
-    return {'Out': [jax.nn.softmax(ins['X'][0],
-                                   axis=attrs.get('axis', -1))]}
+    """Stats in f32, output in the input dtype: the same contract as
+    the Pallas flash kernel (bf16 operands, f32 inner softmax), so the
+    naive and flash attention paths match numerically — and the AMP
+    activation stream stays bf16 instead of black-casting the probs
+    tensor up (softmax sits in the reference black list purely for the
+    f32 COMPUTE, which this does internally)."""
+    x = ins['X'][0]
+    out = jax.nn.softmax(x.astype(jnp.float32),
+                         axis=attrs.get('axis', -1))
+    return {'Out': [out.astype(x.dtype)]}
 
 
 @register('log_softmax')

@@ -416,36 +416,47 @@ def layer_norm(ctx, ins, attrs):
 
 @register('instance_norm', no_grad_out_slots=('SavedMean', 'SavedVariance'))
 def instance_norm(ctx, ins, attrs):
+    # stats in f32, output in the input dtype (the layer_norm /
+    # batch_norm policy): a bf16 input must not promote the downstream
+    # stream to f32 through the f32 Scale param, and bf16 variance is
+    # too coarse
     x = ins['X'][0]
     eps = attrs.get('epsilon', 1e-5)
     red = tuple(range(2, x.ndim))
-    m = jnp.mean(x, axis=red, keepdims=True)
-    v = jnp.var(x, axis=red, keepdims=True)
-    y = (x - m) * jax.lax.rsqrt(v + eps)
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=red, keepdims=True)
+    v = jnp.var(xf, axis=red, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
     if 'Scale' in ins and ins['Scale']:
         c = x.shape[1]
-        y = y * ins['Scale'][0].reshape(1, c, *([1] * (x.ndim - 2)))
-        y = y + ins['Bias'][0].reshape(1, c, *([1] * (x.ndim - 2)))
-    return {'Y': [y], 'SavedMean': [m.reshape(x.shape[0], x.shape[1])],
+        y = y * ins['Scale'][0].astype(jnp.float32).reshape(
+            1, c, *([1] * (x.ndim - 2)))
+        y = y + ins['Bias'][0].astype(jnp.float32).reshape(
+            1, c, *([1] * (x.ndim - 2)))
+    return {'Y': [y.astype(x.dtype)],
+            'SavedMean': [m.reshape(x.shape[0], x.shape[1])],
             'SavedVariance': [v.reshape(x.shape[0], x.shape[1])]}
 
 
 @register('group_norm', no_grad_out_slots=('Mean', 'Variance'))
 def group_norm(ctx, ins, attrs):
+    # stats in f32, output in the input dtype (see instance_norm)
     x = ins['X'][0]
     g = attrs['groups']
     eps = attrs.get('epsilon', 1e-5)
     n, c = x.shape[0], x.shape[1]
-    xs = x.reshape(n, g, c // g, *x.shape[2:])
+    xs = x.astype(jnp.float32).reshape(n, g, c // g, *x.shape[2:])
     red = tuple(range(2, xs.ndim))
     m = jnp.mean(xs, axis=red, keepdims=True)
     v = jnp.var(xs, axis=red, keepdims=True)
     y = ((xs - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
     if 'Scale' in ins and ins['Scale']:
-        y = y * ins['Scale'][0].reshape(1, c, *([1] * (x.ndim - 2)))
+        y = y * ins['Scale'][0].astype(jnp.float32).reshape(
+            1, c, *([1] * (x.ndim - 2)))
     if 'Bias' in ins and ins['Bias']:
-        y = y + ins['Bias'][0].reshape(1, c, *([1] * (x.ndim - 2)))
-    return {'Y': [y], 'Mean': [m.reshape(n, g)],
+        y = y + ins['Bias'][0].astype(jnp.float32).reshape(
+            1, c, *([1] * (x.ndim - 2)))
+    return {'Y': [y.astype(x.dtype)], 'Mean': [m.reshape(n, g)],
             'Variance': [v.reshape(n, g)]}
 
 

@@ -1,0 +1,60 @@
+"""Shared BERT-long benchmark program builder for the ceiling-diff
+tools (diff_bert_long, dump_bert_long_hlo, profile_bert_long_pair,
+boundary_cost): ONE definition of the model/optimizer/seed so every
+tool compares the exact same program."""
+
+import numpy as np
+
+
+def build_bert_long_program(batch, seq):
+    """Returns (main, startup, loss, batch_data) — the bench_bert_long
+    configuration: BERT-base, attn_dropout=0 (flash path), bf16 AMP +
+    dynamic loss scaling, Adam, seed 42, device-resident feeds."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    cfg = models.bert.BertConfig(max_pos=seq, attn_dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, enc, loss = models.bert.build_pretrain(cfg, seq)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(1e-4), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    batch_data = models.bert.synthetic_batch(cfg, batch, seq, rng)
+    batch_data = {k: jax.device_put(v) for k, v in batch_data.items()}
+    return main, startup, loss, batch_data
+
+
+def build_train_segment(batch, seq, fetch=()):
+    """Shared segment plumbing for the diagnostic tools: build the
+    program, run startup, extract the (single) device train segment,
+    and assemble its state/data dicts the way the executor's run path
+    does.  Returns a dict with main/startup/loss/batch_data/scope/exe/
+    seg/fn (unjitted segment callable)/state/data/out_state_names."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import _Segment, _make_segment_fn
+    from paddle_tpu.fluid import core
+    main, startup, loss, batch_data = build_bert_long_program(batch, seq)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        plan = exe._build_plan(main, tuple(sorted(batch_data.keys())),
+                               tuple(fetch))
+        segs = [it for it in plan if isinstance(it, _Segment)]
+        assert len(segs) == 1, [len(s.ops) for s in segs]
+        seg = segs[0]
+        state = {n: core.as_array(scope.find_var(n))
+                 for n in seg.state_names}
+        data = {n: batch_data.get(
+                    n, scope.find_var(n) and
+                    core.as_array(scope.find_var(n)))
+                for n in seg.input_names}
+    return {'main': main, 'startup': startup, 'loss': loss,
+            'batch_data': batch_data, 'scope': scope, 'exe': exe,
+            'seg': seg, 'fn': _make_segment_fn(seg, seg.prefer_test),
+            'state': state, 'data': data,
+            'out_state_names': [n for n in seg.output_names
+                                if n in state]}

@@ -1,0 +1,58 @@
+"""Measure the pure jit-boundary cost of the BERT-long train step's
+state pytree: a donated identity jit over the SAME ~800-array state
+dict, timed like the step.  If identity costs ~0 ms the 10% gap vs the
+hand-JAX ceiling is in the compiled program (kernel scheduling); if it
+costs milliseconds, the boundary (argument/donation processing per
+array) is the lever and state-packing is the fix.
+
+Usage: python tools/boundary_cost.py [--batch 4 --seq 2048 --steps 20]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--batch', type=int, default=4)
+    ap.add_argument('--seq', type=int, default=2048)
+    ap.add_argument('--steps', type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    from bert_long_common import build_train_segment
+    state = build_train_segment(args.batch, args.seq)['state']
+    n_arrays = len(state)
+    n_bytes = sum(getattr(v, 'nbytes', 0) for v in state.values())
+    print('state: %d arrays, %.1f MB' % (n_arrays, n_bytes / 1e6))
+
+    @jax.jit
+    def ident(state):
+        return {k: v for k, v in state.items()}
+
+    ident_d = jax.jit(lambda s: {k: v for k, v in s.items()},
+                      donate_argnums=(0,))
+
+    for name, fn in (('identity        ', ident),
+                     ('identity+donate ', ident_d)):
+        st = jax.tree.map(jax.device_put, state)
+        st = fn(st)  # warm
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            st = fn(st)
+        jax.block_until_ready(st)
+        dt = (time.perf_counter() - t0) / args.steps * 1e3
+        print('%s: %.2f ms/call' % (name, dt))
+
+
+if __name__ == '__main__':
+    main()

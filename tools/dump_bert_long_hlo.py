@@ -2,7 +2,7 @@
 so large-tensor traffic can be diffed against the hand-JAX ceiling
 (/tmp/bert_long_hlo/ceiling.txt from tools/diff_bert_long.py).
 
-Writes /tmp/bert_long_hlo/framework.txt and prints a tally of the
+Writes /tmp/bert_long_hlo/framework_<i>.txt and prints a tally of the
 big-shape (>=256 MB) tensors appearing in each.
 """
 
@@ -13,87 +13,106 @@ from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+_NBYTES = {'f32': 4, 'bf16': 2, 'f16': 2, 's32': 4, 'u32': 4,
+           's64': 8, 'u8': 1, 'pred': 1}
+_SHAPE = re.compile(r'(f32|bf16|f16|s32|u32|s64|u8|pred)\[([0-9,]+)\]')
+
+
+def _shape_bytes(dt, dims):
+    size = _NBYTES[dt]
+    for d in dims.split(','):
+        size *= int(d)
+    return size
+
 
 def big_shape_tally(path, min_mb=256):
-    nbytes = {'f32': 4, 'bf16': 2, 'f16': 2, 's32': 4, 'u32': 4,
-              's64': 8, 'u8': 1, 'pred': 1}
+    """Count big tensor shapes per HLO line (fusion-internal lines
+    included — use entry_tally for materialized buffers).  ROOT lines
+    and tuple-typed results count EVERY big element of the result
+    type, not just the first match."""
     tally = Counter()
-    pat = re.compile(r'(f32|bf16|f16|s32|u32|s64|u8|pred)\[([0-9,]+)\]')
     with open(path) as f:
         for line in f:
             line = line.strip()
-            # count each op once by its OUTPUT shape (start of line
-            # after the assignment name)
-            m = re.match(r'%?[\w.-]+ = (\(?)(.*)', line)
-            if not m:
+            if not re.match(r'(ROOT )?%?[\w.-]+ = ', line):
                 continue
-            first = pat.search(line.split('=', 1)[1][:120])
-            if not first:
+            rhs = line.split('=', 1)[1]
+            # result type = everything before the op name '(...', which
+            # for tuples spans '(shape, shape, ...)'
+            head = rhs.split(') ', 1)[0] if rhs.lstrip().startswith('(') \
+                else rhs.split(' ', 2)[1] if rhs.startswith(' ') else rhs
+            for dt, dims in _SHAPE.findall(head):
+                size = _shape_bytes(dt, dims)
+                if size >= min_mb * 1024 * 1024:
+                    tally['%s[%s] (%d MB)'
+                          % (dt, dims, size >> 20)] += 1
+    return tally
+
+
+def entry_tally(path, min_mb=64):
+    """Count big result buffers of top-level (ENTRY) instructions only:
+    each is an actual HBM materialization in the optimized module."""
+    tally = Counter()
+    in_entry = False
+    with open(path) as f:
+        for line in f:
+            if line.startswith('ENTRY'):
+                in_entry = True
                 continue
-            dt, dims = first.groups()
-            size = nbytes[dt]
-            for d in dims.split(','):
-                size *= int(d)
-            if size >= min_mb * 1024 * 1024:
-                tally['%s[%s] (%d MB)' % (dt, dims, size >> 20)] += 1
+            if in_entry and line.startswith('}'):
+                in_entry = False
+            if not in_entry:
+                continue
+            s = line.strip()
+            if not re.match(r'(ROOT )?%?[\w.-]+ = ', s):
+                continue
+            rhs = s.split('=', 1)[1].lstrip()
+            if rhs.startswith('('):
+                # tuple result: every element before the closing
+                # ') ' — a bare ')' would cut inside the first
+                # element's tiled-layout annotation 'T(8,128)...'
+                head = rhs.split(') ', 1)[0]
+                matches = _SHAPE.findall(head)
+            else:
+                # single result: ONLY the leading type token — scanning
+                # further would count an operand of a scalar-result op
+                # (f32[] never matches the shape regex) as a buffer
+                m = _SHAPE.match(rhs)
+                matches = [m.groups()] if m else []
+            for dt, dims in matches:
+                size = _shape_bytes(dt, dims)
+                if size >= min_mb * 1024 * 1024:
+                    tally['%s[%s]' % (dt, dims)] += 1
     return tally
 
 
 def main():
     import jax
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu import models
-    from paddle_tpu.fluid.executor import _Segment, _make_segment_fn
+    from bert_long_common import build_train_segment
 
-    batch, seq = 4, 2048
-    cfg = models.bert.BertConfig(max_pos=seq, attn_dropout=0.0)
-    main_p, startup = fluid.Program(), fluid.Program()
-    main_p.random_seed = startup.random_seed = 42
-    with fluid.program_guard(main_p, startup):
-        feeds, enc, loss = models.bert.build_pretrain(cfg, seq)
-        opt = fluid.contrib.mixed_precision.decorate(
-            fluid.optimizer.Adam(1e-4), use_dynamic_loss_scaling=True)
-        opt.minimize(loss)
-    rng = np.random.RandomState(0)
-    batch_data = models.bert.synthetic_batch(cfg, batch, seq, rng)
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor(fluid.XLAPlace(0))
-        exe.run(startup)
-        plan = exe._build_plan(main_p,
-                               tuple(sorted(batch_data.keys())),
-                               (loss.name,))
-        os.makedirs('/tmp/bert_long_hlo', exist_ok=True)
-        for i, item in enumerate(plan):
-            if not isinstance(item, _Segment):
-                continue
-            fn = _make_segment_fn(item, item.prefer_test)
-            state = {n: fluid.core.as_array(scope.find_var(n))
-                     for n in item.state_names}
-            data = {n: batch_data.get(
-                        n, scope.find_var(n) and
-                        fluid.core.as_array(scope.find_var(n)))
-                    for n in item.input_names}
-            compiled = jax.jit(fn, donate_argnums=(1,)).lower(
-                0, state, data).compile()
-            out = '/tmp/bert_long_hlo/framework_%d.txt' % i
-            with open(out, 'w') as f:
-                f.write(compiled.as_text())
-            print('segment %d (%d ops) -> %s' % (i, len(item.ops), out))
-            ma = compiled.memory_analysis()
-            if ma:
-                print('  temp %d MB  output %d MB  argument %d MB'
-                      % (ma.temp_size_in_bytes >> 20,
-                         ma.output_size_in_bytes >> 20,
-                         ma.argument_size_in_bytes >> 20))
+    parts = build_train_segment(4, 2048, fetch=())
+    os.makedirs('/tmp/bert_long_hlo', exist_ok=True)
+    compiled = jax.jit(parts['fn'], donate_argnums=(1,)).lower(
+        0, parts['state'], parts['data']).compile()
+    out = '/tmp/bert_long_hlo/framework_0.txt'
+    with open(out, 'w') as f:
+        f.write(compiled.as_text())
+    print('segment 0 (%d ops) -> %s' % (len(parts['seg'].ops), out))
+    ma = compiled.memory_analysis()
+    if ma:
+        print('  temp %d MB  output %d MB  argument %d MB'
+              % (ma.temp_size_in_bytes >> 20,
+                 ma.output_size_in_bytes >> 20,
+                 ma.argument_size_in_bytes >> 20))
 
     for path in sorted(os.listdir('/tmp/bert_long_hlo')):
         full = os.path.join('/tmp/bert_long_hlo', path)
-        print('\n== %s big tensors ==' % path)
-        for k, v in sorted(big_shape_tally(full).items(),
+        print('\n== %s ENTRY-materialized big buffers ==' % path)
+        for k, v in sorted(entry_tally(full).items(),
                            key=lambda kv: -kv[1]):
             print('  %3dx %s' % (v, k))
 

@@ -1,0 +1,41 @@
+"""Capture device traces of the framework BERT-long step AND the
+hand-JAX ceiling in ONE process (two trace dirs), print both kernel
+rollups side by side.  The per-category diff is the map to the last
+~10% framework-vs-ceiling gap (bytes and FLOPs are already at parity —
+tools/diff_bert_long.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import diff_bert_long as D
+    from profile_resnet import analyze
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    fw = D.build_framework_direct(4, 2048)
+    ce = D.build_ceiling(4, 2048)
+    fw(2)
+    ce(2)
+    for name, fn in (('framework', fw), ('ceiling', ce)):
+        logdir = '/tmp/pt_prof_%s' % name
+        os.system('rm -rf %s' % logdir)
+        jax.profiler.start_trace(logdir)
+        try:
+            fn(steps)
+        finally:
+            jax.profiler.stop_trace()
+        print('\n================ %s ================' % name)
+        analyze(logdir, steps)
+
+
+if __name__ == '__main__':
+    main()
