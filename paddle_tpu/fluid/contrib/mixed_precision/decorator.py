@@ -38,6 +38,9 @@ def _mark_amp_ops(program, amp_lists):
                     # (SWCE's analytic-vjp residual is the logits AS
                     # THEY ARRIVED; softmax emits its input dtype)
                     'softmax_with_cross_entropy', 'softmax'}
+    # an EXPLICIT custom placement overrides the exemption — the user
+    # asked for the cast
+    no_harmonize -= getattr(amp_lists, 'custom_placed', set())
     for block in program.blocks:
         for op in block.ops:
             if op.type in amp_lists.white_list:

@@ -41,3 +41,7 @@ class AutoMixedPrecisionLists(object):
         # explicitly black/white-listed
         self.gray_list -= set(custom_white_list or ())
         self.gray_list -= set(custom_black_list or ())
+        # remembered so _mark_amp_ops can honor an explicit placement
+        # even for ops it would normally exempt from harmonization
+        self.custom_placed = set(custom_white_list or ()) | \
+            set(custom_black_list or ())

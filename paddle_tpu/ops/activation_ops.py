@@ -143,8 +143,8 @@ def softmax(ctx, ins, attrs):
     tensor up (softmax sits in the reference black list purely for the
     f32 COMPUTE, which this does internally)."""
     x = ins['X'][0]
-    out = jax.nn.softmax(x.astype(jnp.float32),
-                         axis=attrs.get('axis', -1))
+    xf = x if x.dtype == jnp.float64 else x.astype(jnp.float32)
+    out = jax.nn.softmax(xf, axis=attrs.get('axis', -1))
     return {'Out': [out.astype(x.dtype)]}
 
 

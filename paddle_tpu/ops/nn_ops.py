@@ -423,15 +423,15 @@ def instance_norm(ctx, ins, attrs):
     x = ins['X'][0]
     eps = attrs.get('epsilon', 1e-5)
     red = tuple(range(2, x.ndim))
-    xf = x.astype(jnp.float32)
+    xf = x if x.dtype == jnp.float64 else x.astype(jnp.float32)
     m = jnp.mean(xf, axis=red, keepdims=True)
     v = jnp.var(xf, axis=red, keepdims=True)
     y = (xf - m) * jax.lax.rsqrt(v + eps)
     if 'Scale' in ins and ins['Scale']:
         c = x.shape[1]
-        y = y * ins['Scale'][0].astype(jnp.float32).reshape(
+        y = y * ins['Scale'][0].astype(xf.dtype).reshape(
             1, c, *([1] * (x.ndim - 2)))
-        y = y + ins['Bias'][0].astype(jnp.float32).reshape(
+        y = y + ins['Bias'][0].astype(xf.dtype).reshape(
             1, c, *([1] * (x.ndim - 2)))
     return {'Y': [y.astype(x.dtype)],
             'SavedMean': [m.reshape(x.shape[0], x.shape[1])],
@@ -445,16 +445,17 @@ def group_norm(ctx, ins, attrs):
     g = attrs['groups']
     eps = attrs.get('epsilon', 1e-5)
     n, c = x.shape[0], x.shape[1]
-    xs = x.astype(jnp.float32).reshape(n, g, c // g, *x.shape[2:])
+    xf = x if x.dtype == jnp.float64 else x.astype(jnp.float32)
+    xs = xf.reshape(n, g, c // g, *x.shape[2:])
     red = tuple(range(2, xs.ndim))
     m = jnp.mean(xs, axis=red, keepdims=True)
     v = jnp.var(xs, axis=red, keepdims=True)
     y = ((xs - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
     if 'Scale' in ins and ins['Scale']:
-        y = y * ins['Scale'][0].astype(jnp.float32).reshape(
+        y = y * ins['Scale'][0].astype(xf.dtype).reshape(
             1, c, *([1] * (x.ndim - 2)))
     if 'Bias' in ins and ins['Bias']:
-        y = y + ins['Bias'][0].astype(jnp.float32).reshape(
+        y = y + ins['Bias'][0].astype(xf.dtype).reshape(
             1, c, *([1] * (x.ndim - 2)))
     return {'Y': [y.astype(x.dtype)], 'Mean': [m.reshape(n, g)],
             'Variance': [v.reshape(n, g)]}

@@ -71,6 +71,12 @@ def entry_tally(path, min_mb=64):
             if not re.match(r'(ROOT )?%?[\w.-]+ = ', s):
                 continue
             rhs = s.split('=', 1)[1].lstrip()
+            # alias-only ops reference an existing buffer: counting
+            # them (and the gtes of an already-counted tuple fusion)
+            # would double-tally one materialization
+            if re.search(r'\b(get-tuple-element|bitcast|parameter)\(',
+                         rhs):
+                continue
             if rhs.startswith('('):
                 # tuple result: every element before the closing
                 # ') ' — a bare ')' would cut inside the first
@@ -96,6 +102,11 @@ def main():
 
     parts = build_train_segment(4, 2048, fetch=())
     os.makedirs('/tmp/bert_long_hlo', exist_ok=True)
+    for old in os.listdir('/tmp/bert_long_hlo'):
+        # stale framework dumps from earlier runs must not be tallied
+        # as this run's results (ceiling.txt is diff_bert_long's)
+        if old.startswith('framework_'):
+            os.unlink(os.path.join('/tmp/bert_long_hlo', old))
     compiled = jax.jit(parts['fn'], donate_argnums=(1,)).lower(
         0, parts['state'], parts['data']).compile()
     out = '/tmp/bert_long_hlo/framework_0.txt'
