@@ -124,6 +124,28 @@ CASES = {
         lambda: {'X': R(34).randn(1, 2, 3, 3)},
         {'kernels': [2, 2], 'strides': [1, 1],
          'paddings': [0, 0, 0, 0]}, 'Out', {'grad_slots': ['X']}),
+    'affine_grid': (
+        lambda: {'Theta': R(35).randn(2, 2, 3) * 0.5},
+        {'output_shape': [2, 1, 3, 3]}, 'Output',
+        {'grad_slots': ['Theta']}),
+    # indices as max_pool2d_with_index would emit them: one source
+    # position per pooled cell, distinct within each (n, c) plane
+    'unpool': (
+        lambda: {'X': R(36).randn(1, 2, 2, 2),
+                 'Indices': np.array(
+                     [[[[0, 3], [9, 10]],
+                       [[5, 6], [12, 15]]]], 'int64')},
+        {'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]},
+        'Out', {'grad_slots': ['X']}),
+    'sequence_expand': (
+        lambda: {'X': R(37).randn(2, 3),
+                 'Y': R(38).randn(2, 4, 3)},
+        {}, 'Out', {'grad_slots': ['X']}),
+    'sequence_slice': (
+        lambda: {'X': R(39).randn(2, 5, 3),
+                 'Offset': np.array([1, 0], 'int64'),
+                 'Length': np.array([2, 3], 'int64')},
+        {}, 'Out', {'grad_slots': ['X']}),
 }
 
 
