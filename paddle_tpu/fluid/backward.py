@@ -35,6 +35,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     program = loss.block.program
     block = program.global_block()
     no_grad_set = set(no_grad_set or [])
+    # recorded for the whole-program-grad executor mode: jax.vjp over
+    # the forward region must treat these names as constants exactly
+    # like this pruning pass does (executor._wpg_partition)
+    program._backward_no_grad_names = set(getattr(
+        program, '_backward_no_grad_names', ())) | no_grad_set
     with program._role_guard('backward'):
         return _append_backward_impl(loss, program, block, parameter_list,
                                      no_grad_set, callbacks, checkpoints)

@@ -402,9 +402,151 @@ def _feed_mismatch_note(program, feed):
     return None
 
 
-def _make_segment_fn(segment, prefer_test=False):
+def _wpg_partition(segment):
+    """Whole-program-grad eligibility + partition for a train segment
+    (FLAGS_whole_program_grad): instead of lowering each synthesized
+    *_grad op — whose per-op jax.vjp replays give XLA hundreds of
+    small vjp islands to fuse — lower ONLY the forward/optimizer ops
+    and take one jax.vjp over the whole forward region.  Same math
+    (the per-op grads ARE vjp of the same lowerings, and stochastic
+    ops key their RNG on (op_seed, step) so replay and whole-trace
+    see identical masks), but XLA schedules the backward as one graph
+    — the hand-written-JAX shape.  Measured motivation: BERT-s2048 at
+    byte/FLOP parity with its hand-JAX ceiling still ran ~10% slower
+    on a diffuse small-fusion tail (BENCHMARKS.md round 4).
+
+    Returns None when the segment is ineligible (no backward region,
+    control flow, multi-seed, or a needed gradient whose primal is
+    not a segment boundary input)."""
+    ops = segment.ops
+    CF = ('while', 'conditional_block', 'while_grad',
+          'conditional_block_grad')
+    if any(op.type in CF for op in ops):
+        return None
+    roles = [op.attrs.get('__op_role__', 'forward') for op in ops]
+    if 'backward' not in roles:
+        return None
+    first_bwd = roles.index('backward')
+    pre = ops[:first_bwd]
+    bwd = [op for op in ops[first_bwd:]
+           if op.attrs.get('__op_role__') == 'backward']
+    post = [op for op in ops[first_bwd:]
+            if op.attrs.get('__op_role__') != 'backward']
+    program = ops[0].block.program
+    gmap = getattr(program, '_grad_name_map', {})
+    rev = {g: p for p, g in gmap.items()}
+    # the autodiff seed: backward starts from a fill of the root
+    # var's gradient (append_backward's fill_constant of loss@GRAD)
+    seeds = []
+    for op in bwd:
+        if op.type in ('fill_constant', 'fill_any_like'):
+            for n in _op_writes(op):
+                if n in rev:
+                    seeds.append((rev[n], n,
+                                  float(op.attrs.get('value', 1.0))))
+    if len(seeds) != 1:
+        return None
+    seed_primal, _, seed_val = seeds[0]
+    pre_writes = set()
+    for op in pre:
+        pre_writes.update(_op_writes(op))
+    if seed_primal not in pre_writes:
+        # the forward region lives in an EARLIER segment (a host op —
+        # print/save — split the plan between forward and backward):
+        # this segment cannot re-derive the loss, keep the per-op path
+        return None
+    bwd_writes = set()
+    for op in bwd:
+        bwd_writes.update(_op_writes(op))
+    later_reads = set()
+    for op in post:
+        later_reads.update(_op_dep_reads(op))
+    needed = sorted(bwd_writes & (later_reads |
+                                  set(segment.output_names)))
+    boundary = set(segment.state_names) | set(segment.input_names)
+    grad_to_primal = {}
+    for g in needed:
+        p = rev.get(g)
+        if p is None or p not in boundary:
+            # a consumed gradient of an intermediate value: the per-op
+            # path must carry it (rare — e.g. feeding an activation
+            # grad to a fetch); fall back
+            return None
+        grad_to_primal[g] = p
+    # stop_gradient vars and the no_grad_set recorded by
+    # append_backward: the pruning pass treated them as constants, so
+    # the vjp must too — lax.stop_gradient is applied at WRITE time
+    # inside the traced forward (see _make_segment_fn), before any
+    # consumer reads them
+    block = ops[0].block
+    no_grad = set(getattr(program, '_backward_no_grad_names', ()))
+    stop_names = []
+    for op in pre:
+        for n in _op_writes(op):
+            if n in no_grad:
+                stop_names.append(n)
+                continue
+            v = block._find_var_recursive(n)
+            if v is not None and v.stop_gradient and n != seed_primal:
+                stop_names.append(n)
+    # post (optimizer-role) ops run after the whole forward+vjp, same
+    # as their original program position after the backward block —
+    # in-place param writes (sgd ParamOut = Param) are ordinary env
+    # rebinds, exactly as in the per-op path.  A forward-role op
+    # INTERLEAVED into the backward block would land in `post` and is
+    # also safe: nothing in `pre` or the vjp reads its output (program
+    # order), and its own reads resolve against the completed env.
+    return {'pre': pre, 'post': post, 'seed_primal': seed_primal,
+            'seed_val': seed_val, 'grad_to_primal': grad_to_primal,
+            'stop_names': set(stop_names)}
+
+
+def _make_segment_fn(segment, prefer_test=False, whole_program_grad=False):
     ops = segment.ops
     output_names = list(segment.output_names)
+
+    wpg = _wpg_partition(segment) if whole_program_grad else None
+
+    if wpg is not None:
+        import jax.numpy as jnp
+        pre, post = wpg['pre'], wpg['post']
+        g2p = wpg['grad_to_primal']
+        wrt_names = sorted(set(g2p.values()))
+        seed_primal, seed_val = wpg['seed_primal'], wpg['seed_val']
+        stop_names = wpg['stop_names']
+
+        def fn(step, state, data):
+            env0 = {}
+            env0.update(data)
+            env0.update(state)
+            wrt = {n: env0[n] for n in wrt_names}
+            others = {n: v for n, v in env0.items()
+                      if n not in wrt}
+
+            def fwd(wrt_vals):
+                env = dict(others)
+                env.update(wrt_vals)
+                for op in pre:
+                    _lower_ops([op], env, step, prefer_test)
+                    # stop_gradient / no_grad_set vars are constants
+                    # to the pruning pass — pin them for the vjp at
+                    # write time, before any consumer reads them
+                    for n in _op_writes(op):
+                        if n in stop_names and n in env:
+                            env[n] = jax.lax.stop_gradient(env[n])
+                return env[seed_primal], env
+
+            root, vjp_fn, env = jax.vjp(fwd, wrt, has_aux=True)
+            ct = jnp.full_like(jnp.asarray(root), seed_val)
+            d_wrt, = vjp_fn(ct)
+            for g, p in g2p.items():
+                env[g] = d_wrt[p]
+            _lower_ops(post, env, step, prefer_test)
+            return {n: env[n] for n in output_names}
+
+        fn.__name__ = 'segment_wpg_%s_x%d' % (
+            ops[0].type if ops else 'empty', len(ops))
+        return fn
 
     def fn(step, state, data):
         env = {}
@@ -420,7 +562,7 @@ def _make_segment_fn(segment, prefer_test=False):
     return fn
 
 
-def _jit_segment(segment, auto_layout=False):
+def _jit_segment(segment, auto_layout=False, whole_program_grad=False):
     """jit a segment for the executor's own run loop.  With
     FLAGS_segment_auto_layout, state/data boundary layouts are chosen
     by XLA (jax.experimental.layout AUTO): the persistent state —
@@ -428,7 +570,8 @@ def _jit_segment(segment, auto_layout=False):
     compute wants across steps, so the per-step relayout copies at the
     jit boundary disappear (the steady state feeds each step's outputs
     straight back in as inputs with matching layouts)."""
-    fn = _make_segment_fn(segment, segment.prefer_test)
+    fn = _make_segment_fn(segment, segment.prefer_test,
+                          whole_program_grad=whole_program_grad)
     if auto_layout:
         from jax.experimental.layout import Format, Layout
         auto = Format(Layout.AUTO)
@@ -600,9 +743,12 @@ class Executor(object):
             raise ValueError(
                 'feed names %r are not read by the program (inputs: '
                 '%r)' % (bogus, sorted(known)))
-        return CompiledStep(_make_segment_fn(seg, prefer_test),
-                            seg.input_names, seg.state_names,
-                            seg.output_names)
+        from .flags import get_flag
+        return CompiledStep(
+            _make_segment_fn(seg, prefer_test,
+                             whole_program_grad=bool(
+                                 get_flag('FLAGS_whole_program_grad'))),
+            seg.input_names, seg.state_names, seg.output_names)
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -639,6 +785,7 @@ class Executor(object):
         Segments are lowered/compiled AOT here; the XLA compile caches
         (service + persistent) dedupe against the run-path executables.
         """
+        from .flags import get_flag
         scope = scope or core.global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -659,7 +806,10 @@ class Executor(object):
                     self._run_bucket_count(item[1], feed, scope,
                                            device, prefer_test)
                 continue
-            fn = _make_segment_fn(item, item.prefer_test)
+            fn = _make_segment_fn(
+                item, item.prefer_test,
+                whole_program_grad=bool(
+                    get_flag('FLAGS_whole_program_grad')))
             state = {n: self._lookup_input(n, feed, scope)
                      for n in item.state_names}
             data = {n: self._lookup_input(n, feed, scope)
@@ -940,11 +1090,13 @@ class Executor(object):
         # flags that change the LOWERING must key the executable cache,
         # or toggling them after first compile is silently ignored
         prec = str(get_flag('FLAGS_conv_precision', 'highest'))
-        key = (auto, prec) + tuple(op.attrs.get('max_trip_count')
+        wpg = bool(get_flag('FLAGS_whole_program_grad'))
+        key = (auto, prec, wpg) + tuple(op.attrs.get('max_trip_count')
                               for op in seg.bucket_ops)
         compiled = seg.compiled.get(key)
         if compiled is None:
-            compiled = seg.compiled[key] = _jit_segment(seg, auto)
+            compiled = seg.compiled[key] = _jit_segment(
+                seg, auto, whole_program_grad=wpg)
 
         state = {}
         for n in seg.state_names:

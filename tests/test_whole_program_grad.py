@@ -1,0 +1,156 @@
+"""FLAGS_whole_program_grad: eligible train segments lower as forward
+ops + ONE jax.vjp over the whole forward region instead of per-op
+synthesized grad replay (executor._wpg_partition).  Parity: the same
+program must train to the same losses with the flag on and off —
+including under AMP dynamic loss scaling (the vjp seed rides the
+scaled-loss fill) and with dropout (RNG keyed on (op_seed, step) makes
+replay and whole-trace masks identical)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _train(wpg, amp, dropout, steps=6):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        h = layers.fc(x, 16, act='relu')
+        if dropout:
+            h = layers.dropout(h, 0.3,
+                               dropout_implementation='upscale_in_train')
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.Adam(0.02)
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(
+                opt, use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    rng = np.random.RandomState(4)
+    w = rng.randn(8, 1).astype('float32')
+    feeds = []
+    for _ in range(steps):
+        xb = rng.randn(32, 8).astype('float32')
+        feeds.append({'x': xb, 'y': (xb @ w).astype('float32')})
+    fluid.set_flags({'FLAGS_whole_program_grad': wpg})
+    try:
+        losses = []
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            for fd in feeds:
+                l, = exe.run(main, feed=fd, fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+    finally:
+        fluid.set_flags({'FLAGS_whole_program_grad': False})
+    return losses
+
+
+@pytest.mark.parametrize('amp,dropout', [(False, False), (False, True),
+                                         (True, False), (True, True)])
+def test_wpg_loss_parity(amp, dropout):
+    a = _train(False, amp, dropout)
+    b = _train(True, amp, dropout)
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
+                               err_msg='amp=%s dropout=%s' % (amp,
+                                                              dropout))
+
+
+def test_wpg_partition_shape():
+    """The partition recognizes the standard train segment and routes
+    every optimizer-consumed gradient to a boundary primal."""
+    from paddle_tpu.fluid.executor import _Segment, _wpg_partition
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        plan = exe._build_plan(main, ('x', 'y'), (loss.name,))
+    segs = [it for it in plan if isinstance(it, _Segment)]
+    assert len(segs) == 1
+    part = _wpg_partition(segs[0])
+    assert part is not None
+    assert part['seed_val'] == 1.0
+    assert all(p in segs[0].state_names or p in segs[0].input_names
+               for p in part['grad_to_primal'].values())
+    # param grads are among the routed gradients
+    gnames = set(part['grad_to_primal'])
+    assert any('w_0' in g for g in gnames), gnames
+
+
+def test_wpg_stop_gradient_parity():
+    """stop_gradient on an intermediate of a value-dependent loss path:
+    the vjp must treat it as a constant exactly like append_backward's
+    pruning does (write-time lax.stop_gradient pin)."""
+    def train(wpg, steps=4):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[6], dtype='float32')
+            y = fluid.layers.data('y', shape=[1], dtype='float32')
+            h = layers.fc(x, 8, act='tanh')
+            frozen = layers.scale(h, scale=2.0)
+            frozen.stop_gradient = True       # detach()-style branch
+            pred = layers.fc(layers.elementwise_add(h, frozen), 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(0.05).minimize(loss)
+        rng = np.random.RandomState(8)
+        feeds = [{'x': rng.randn(16, 6).astype('float32'),
+                  'y': rng.randn(16, 1).astype('float32')}
+                 for _ in range(steps)]
+        fluid.set_flags({'FLAGS_whole_program_grad': wpg})
+        try:
+            out = []
+            with fluid.scope_guard(fluid.Scope()):
+                exe = fluid.Executor(fluid.XLAPlace(0))
+                exe.run(startup)
+                for fd in feeds:
+                    l, = exe.run(main, feed=fd, fetch_list=[loss])
+                    out.append(float(np.asarray(l).ravel()[0]))
+        finally:
+            fluid.set_flags({'FLAGS_whole_program_grad': False})
+        return out
+
+    np.testing.assert_allclose(train(False), train(True),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_wpg_host_op_split_falls_back():
+    """A host op (Print) between forward and backward splits the plan;
+    the backward segment cannot re-derive the loss, so the partition
+    must decline and the per-op path must run — not crash."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(x, 1), y))
+        layers.Print(loss, message='wpg-split')
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(2)
+    fd = {'x': rng.randn(8, 4).astype('float32'),
+          'y': rng.randn(8, 1).astype('float32')}
+    fluid.set_flags({'FLAGS_whole_program_grad': True})
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            l1, = exe.run(main, feed=fd, fetch_list=[loss])
+            l2, = exe.run(main, feed=fd, fetch_list=[loss])
+        assert float(np.asarray(l2).ravel()[0]) < \
+            float(np.asarray(l1).ravel()[0])
+    finally:
+        fluid.set_flags({'FLAGS_whole_program_grad': False})
