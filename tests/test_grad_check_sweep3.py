@@ -146,6 +146,13 @@ CASES = {
                  'Offset': np.array([1, 0], 'int64'),
                  'Length': np.array([2, 3], 'int64')},
         {}, 'Out', {'grad_slots': ['X']}),
+    # X/Y/Weight grads are sweep2's; only the Bias slot is new here
+    'bilinear_tensor_product': (
+        lambda: {'X': R(42).randn(2, 3) * 0.5,
+                 'Y': R(43).randn(2, 4) * 0.5,
+                 'Weight': R(44).randn(2, 3, 4) * 0.5,
+                 'Bias': R(45).randn(2) * 0.5},
+        {}, 'Out', {'grad_slots': ['Bias']}),
 }
 
 
