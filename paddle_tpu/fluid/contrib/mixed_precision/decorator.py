@@ -49,6 +49,12 @@ def _mark_amp_ops(program, amp_lists):
                 op.attrs['__amp_gray__'] = True
             elif op.type in amp_lists.black_list - no_harmonize:
                 op.attrs['__amp_black__'] = True
+            elif op.type in amp_lists.black_list:
+                # exempt from the input cast-up (f32-internal
+                # lowerings), but the black rule's f32-OUTPUT contract
+                # still applies to tiny per-row outputs: reported loss
+                # keeps f32 precision (ADVICE r4)
+                op.attrs['__amp_black_out__'] = True
     program._bump_version()
 
 

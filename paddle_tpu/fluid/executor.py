@@ -415,14 +415,15 @@ def _wpg_partition(segment):
     byte/FLOP parity with its hand-JAX ceiling still ran ~10% slower
     on a diffuse small-fusion tail (BENCHMARKS.md round 4).
 
-    Returns None when the segment is ineligible (no backward region,
-    control flow, multi-seed, or a needed gradient whose primal is
-    not a segment boundary input)."""
+    Eligible programs may contain control flow (while/conditional_block
+    lower to differentiable masked scans / lax.cond when they carry
+    gradients) and multiple losses (one seed fill each).  Returns None
+    when the segment is ineligible: no backward region, a backward
+    region holding ops the single vjp does NOT reproduce (e.g.
+    RecomputeOptimizer's re-emitted forward spans, whose whole point —
+    freeing activations — the vjp would silently defeat), or a needed
+    gradient whose primal is not a segment boundary input."""
     ops = segment.ops
-    CF = ('while', 'conditional_block', 'while_grad',
-          'conditional_block_grad')
-    if any(op.type in CF for op in ops):
-        return None
     roles = [op.attrs.get('__op_role__', 'forward') for op in ops]
     if 'backward' not in roles:
         return None
@@ -435,44 +436,85 @@ def _wpg_partition(segment):
     program = ops[0].block.program
     gmap = getattr(program, '_grad_name_map', {})
     rev = {g: p for p, g in gmap.items()}
-    # the autodiff seed: backward starts from a fill of the root
-    # var's gradient (append_backward's fill_constant of loss@GRAD)
+    # The backward region must consist ONLY of ops the one jax.vjp
+    # replaces: synthesized *_grad ops, the autodiff seed fills
+    # (append_backward's fill_constant of loss@GRAD — one per loss),
+    # zero-cotangent placeholders, and grad-accumulation sums.  Any
+    # other backward-role op has semantics the vjp does not reproduce
+    # — notably RecomputeOptimizer's re-emitted forward spans and
+    # recompute_barrier ops (backward.py _RecomputePlan), which exist
+    # to FREE activation memory: replacing them with a vjp that keeps
+    # every activation as a residual would silently defeat recompute.
     seeds = []
     for op in bwd:
-        if op.type in ('fill_constant', 'fill_any_like'):
-            for n in _op_writes(op):
-                if n in rev:
-                    seeds.append((rev[n], n,
-                                  float(op.attrs.get('value', 1.0))))
-    if len(seeds) != 1:
+        t = op.type
+        if t.endswith('_grad') or t == 'fill_zeros_like':
+            continue
+        ws = _op_writes(op)
+        if t == 'sum' and ws and all(n in rev for n in ws):
+            continue  # gradient aggregation: the vjp sums contributions
+        if t in ('fill_constant', 'fill_any_like') and len(ws) == 1 \
+                and ws[0] in rev:
+            seeds.append((rev[ws[0]], ws[0],
+                          float(op.attrs.get('value', 1.0))))
+            continue
         return None
-    seed_primal, _, seed_val = seeds[0]
+    if not seeds:
+        return None
+    if len(set(p for p, _, _ in seeds)) != len(seeds):
+        return None  # two seeds of one root: ambiguous, keep per-op
     pre_writes = set()
+    pre_reads = set()
     for op in pre:
         pre_writes.update(_op_writes(op))
-    if seed_primal not in pre_writes:
-        # the forward region lives in an EARLIER segment (a host op —
-        # print/save — split the plan between forward and backward):
-        # this segment cannot re-derive the loss, keep the per-op path
+        pre_reads.update(_op_dep_reads(op))
+    if any(p not in pre_writes for p, _, _ in seeds):
+        # a loss whose forward region is not in this segment (e.g. a
+        # second loss built AFTER the first backward): this segment
+        # cannot re-derive it, keep the per-op path
         return None
+    # Each grad name belongs to ONE loss's backward walk (multi-loss
+    # programs append one fill + walk per append_backward call, in
+    # program order): record the seed region that (last) writes it, so
+    # the vjp can deliver THAT loss's gradient — not the total over
+    # all seeds, which is what a single cotangent bundle would give
+    # and which per-op semantics only matches for single-loss programs.
     bwd_writes = set()
+    region_of = {}
+    region = -1
+    seed_fill_names = set(g for _, g, _ in seeds)
     for op in bwd:
-        bwd_writes.update(_op_writes(op))
+        ws = _op_writes(op)
+        if op.type in ('fill_constant', 'fill_any_like') and ws and \
+                ws[0] in seed_fill_names:
+            region += 1
+        bwd_writes.update(ws)
+        for n in ws:
+            region_of[n] = max(region, 0)
     later_reads = set()
     for op in post:
         later_reads.update(_op_dep_reads(op))
     needed = sorted(bwd_writes & (later_reads |
                                   set(segment.output_names)))
     boundary = set(segment.state_names) | set(segment.input_names)
+    seed_gnames = {g: (p, v) for p, g, v in seeds}
     grad_to_primal = {}
     for g in needed:
+        if g in seed_gnames:
+            continue  # d(loss)=seed_val: filled directly, no vjp slot
         p = rev.get(g)
         if p is None or p not in boundary:
             # a consumed gradient of an intermediate value: the per-op
             # path must carry it (rare — e.g. feeding an activation
             # grad to a fetch); fall back
             return None
-        grad_to_primal[g] = p
+        if p not in pre_reads:
+            # the primal never flows into THIS segment's forward (its
+            # chain was cut into an earlier segment, e.g. by an
+            # auto-bucket split): the vjp would return a zero gradient
+            # where the per-op grad chain crosses the cut — fall back
+            return None
+        grad_to_primal[g] = (p, region_of.get(g, 0))
     # stop_gradient vars and the no_grad_set recorded by
     # append_backward: the pruning pass treated them as constants, so
     # the vjp must too — lax.stop_gradient is applied at WRITE time
@@ -480,6 +522,7 @@ def _wpg_partition(segment):
     # consumer reads them
     block = ops[0].block
     no_grad = set(getattr(program, '_backward_no_grad_names', ()))
+    seed_primals = set(p for p, _, _ in seeds)
     stop_names = []
     for op in pre:
         for n in _op_writes(op):
@@ -487,7 +530,8 @@ def _wpg_partition(segment):
                 stop_names.append(n)
                 continue
             v = block._find_var_recursive(n)
-            if v is not None and v.stop_gradient and n != seed_primal:
+            if v is not None and v.stop_gradient and \
+                    n not in seed_primals:
                 stop_names.append(n)
     # post (optimizer-role) ops run after the whole forward+vjp, same
     # as their original program position after the backward block —
@@ -496,8 +540,9 @@ def _wpg_partition(segment):
     # INTERLEAVED into the backward block would land in `post` and is
     # also safe: nothing in `pre` or the vjp reads its output (program
     # order), and its own reads resolve against the completed env.
-    return {'pre': pre, 'post': post, 'seed_primal': seed_primal,
-            'seed_val': seed_val, 'grad_to_primal': grad_to_primal,
+    return {'pre': pre, 'post': post, 'seeds': seeds,
+            'seed_gnames': seed_gnames,
+            'grad_to_primal': grad_to_primal,
             'stop_names': set(stop_names)}
 
 
@@ -511,9 +556,11 @@ def _make_segment_fn(segment, prefer_test=False, whole_program_grad=False):
         import jax.numpy as jnp
         pre, post = wpg['pre'], wpg['post']
         g2p = wpg['grad_to_primal']
-        wrt_names = sorted(set(g2p.values()))
-        seed_primal, seed_val = wpg['seed_primal'], wpg['seed_val']
+        wrt_names = sorted(set(p for p, _ in g2p.values()))
+        seeds = wpg['seeds']
+        seed_gnames = wpg['seed_gnames']
         stop_names = wpg['stop_names']
+        CF_FWD = ('while', 'conditional_block')
 
         def fn(step, state, data):
             env0 = {}
@@ -527,6 +574,28 @@ def _make_segment_fn(segment, prefer_test=False, whole_program_grad=False):
                 env = dict(others)
                 env.update(wrt_vals)
                 for op in pre:
+                    if op.type in CF_FWD and \
+                            not op.attrs.get('__needs_grad__'):
+                        # the backward pass gave this loop/branch no
+                        # gradient (no cotangent reaches its outputs),
+                        # but a raw lax.while_loop cannot sit on a
+                        # differentiated path under jax.vjp — lower it
+                        # against a shadow env whose reads are
+                        # gradient-stopped, exactly the per-op
+                        # semantics (no grads flow through it)
+                        shadow = dict(env)
+                        wrapped = {}
+                        for n in set(_op_dep_reads(op)):
+                            if n in shadow:
+                                v = jax.lax.stop_gradient(shadow[n])
+                                shadow[n] = wrapped[n] = v
+                        _lower_ops([op], shadow, step, prefer_test)
+                        for n, v in shadow.items():
+                            if n in wrapped and v is wrapped[n]:
+                                continue  # an unmodified pinned read
+                            if n not in env or env[n] is not v:
+                                env[n] = v
+                        continue
                     _lower_ops([op], env, step, prefer_test)
                     # stop_gradient / no_grad_set vars are constants
                     # to the pruning pass — pin them for the vjp at
@@ -534,13 +603,26 @@ def _make_segment_fn(segment, prefer_test=False, whole_program_grad=False):
                     for n in _op_writes(op):
                         if n in stop_names and n in env:
                             env[n] = jax.lax.stop_gradient(env[n])
-                return env[seed_primal], env
+                return {p: env[p] for p, _, _ in seeds}, env
 
-            root, vjp_fn, env = jax.vjp(fwd, wrt, has_aux=True)
-            ct = jnp.full_like(jnp.asarray(root), seed_val)
-            d_wrt, = vjp_fn(ct)
-            for g, p in g2p.items():
-                env[g] = d_wrt[p]
+            roots, vjp_fn, env = jax.vjp(fwd, wrt, has_aux=True)
+            # one backward pass per loss (usually one): cotangent only
+            # on that loss's root, zeros elsewhere — per-op grad names
+            # carry PER-LOSS contributions, not the total over seeds
+            regions_used = sorted(set(r for _, r in g2p.values())) \
+                or [0]
+            d_by_region = {}
+            for r in regions_used:
+                cts = {p: jnp.full_like(jnp.asarray(roots[p]),
+                                        v if i == r else 0.0)
+                       for i, (p, _, v) in enumerate(seeds)}
+                d_by_region[r], = vjp_fn(cts)
+            for g, (p, r) in g2p.items():
+                env[g] = d_by_region[r][p]
+            for g, (p, v) in seed_gnames.items():
+                # d(loss) itself: the seed value, materialized only if
+                # something downstream reads it
+                env[g] = jnp.full_like(jnp.asarray(env[p]), v)
             _lower_ops(post, env, step, prefer_test)
             return {n: env[n] for n in output_names}
 
@@ -867,6 +949,42 @@ class Executor(object):
             program._exec_cache[key] = plan
         return plan
 
+    # host ops with no program-state writes (print/save write stdout /
+    # files, never scope vars): deferring one past later device ops is
+    # observably identical when nothing later rewrites what it reads
+    _DEFERRABLE_HOST_OPS = ('print', 'save', 'save_combine')
+
+    def _defer_readonly_host_ops(self, ops):
+        """Reorder a block's op list so deferrable host ops run after
+        the device ops that follow them, when no later op rewrites
+        their reads.  Without this, a print/save between forward and
+        backward cuts the plan into two segments — the program can no
+        longer compile to one pure step (Executor.compile) and the
+        whole-program-grad partition cannot see the forward region.
+        The reference interleaves host ops freely because its executor
+        is op-by-op (framework/executor.cc:449); a segment compiler
+        buys the fused program back by commuting read-only host ops
+        with the pure ops they don't depend on."""
+        deferred = []  # (op, read names) pending placement
+        out = []
+        for op in ops:
+            writes = set(_op_writes(op))
+            if writes and deferred:
+                # flush every deferred op whose read is about to be
+                # rewritten — and any deferred BEFORE it, so host side
+                # effects keep their relative program order
+                last = max((i for i, (_, reads) in enumerate(deferred)
+                            if reads & writes), default=-1)
+                if last >= 0:
+                    out.extend(d for d, _ in deferred[:last + 1])
+                    deferred = deferred[last + 1:]
+            if op.type in self._DEFERRABLE_HOST_OPS:
+                deferred.append((op, set(_op_reads(op))))
+            else:
+                out.append(op)
+        out.extend(d for d, _ in deferred)
+        return out
+
     def _build_plan(self, program, feed_names, fetch_names,
                     per_op=False):
         block = program.global_block()
@@ -874,7 +992,7 @@ class Executor(object):
         cur = []
         CONTROL_FLOW = ('while', 'conditional_block', 'while_grad',
                         'conditional_block_grad')
-        for op in block.ops:
+        for op in self._defer_readonly_host_ops(block.ops):
             if op.type in CONTROL_FLOW:
                 if op.type == 'while' and \
                         op.attrs.get('__auto_bucket__'):
@@ -1201,5 +1319,32 @@ def _train_from_dataset(self, program=None, dataset=None, scope=None,
         fetch_info, print_period)
 
 
+def _infer_from_dataset(self, program=None, dataset=None, scope=None,
+                        thread=0, debug=False, fetch_list=None,
+                        fetch_info=None, print_period=100):
+    """Inference-only dataset sweep: like train_from_dataset but the
+    program MUST NOT update parameters (the reference keeps separate
+    entry points, python/paddle/fluid/executor.py:1115 region).  Handed
+    a training program, the optimizer/backward ops are pruned to a
+    cached inference clone rather than silently applied."""
+    program = program or framework.default_main_program()
+    has_update = any(
+        op.attrs.get('__op_role__') in ('optimize', 'backward')
+        for op in program.global_block().ops)
+    if has_update:
+        # cache keyed on the program version: a mutation after the
+        # first call (more layers, re-minimize) must re-clone, not
+        # silently run the stale pre-mutation graph
+        ver = getattr(program, '_version', 0)
+        cached = getattr(program, '_infer_clone', None)
+        if cached is None or cached[0] != ver:
+            cached = (ver, program.clone(for_test=True))
+            program._infer_clone = cached
+        program = cached[1]
+    return _train_or_infer_from_dataset(
+        self, program, dataset, scope, thread, debug, fetch_list,
+        fetch_info, print_period)
+
+
 Executor.train_from_dataset = _train_from_dataset
-Executor.infer_from_dataset = _train_from_dataset
+Executor.infer_from_dataset = _infer_from_dataset

@@ -31,15 +31,16 @@ _DEFAULTS = {
     # program) and AUTO-layout executables break when reloaded from the
     # persistent XLA compile cache on this backend (see BENCHMARKS.md)
     'FLAGS_segment_auto_layout': False,
-    # EXPERIMENTAL: lower eligible train segments as forward ops + ONE
-    # jax.vjp over the whole forward region instead of per-op
-    # synthesized grad replay (executor._wpg_partition).  Identical
-    # math — the per-op grads are vjp of the same lowerings and
-    # stochastic lowerings key RNG on (op_seed, step) — but XLA
-    # schedules the backward as one graph, the hand-written-JAX shape.
-    # Ineligible segments (control flow, multi-loss, consumed
-    # intermediate grads) silently keep the per-op path.
-    'FLAGS_whole_program_grad': False,
+    # Lower eligible train segments as forward ops + ONE jax.vjp over
+    # the whole forward region instead of per-op synthesized grad
+    # replay (executor._wpg_partition).  Identical math — the per-op
+    # grads are vjp of the same lowerings and stochastic lowerings key
+    # RNG on (op_seed, step) — but XLA schedules the backward as one
+    # graph, the hand-written-JAX shape (BERT-long 144.7 -> 119.8
+    # ms/step, BENCHMARKS.md round 4).  DEFAULT ON since round 5;
+    # ineligible segments (recompute programs, consumed intermediate
+    # grads, split forwards) automatically keep the per-op path.
+    'FLAGS_whole_program_grad': True,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

@@ -79,6 +79,12 @@ def mul(ctx, ins, attrs):
         out = jax.lax.dot_general(x.astype(jnp.bfloat16),
                                   y3.astype(jnp.bfloat16), dims)
     else:
+        if x.dtype != y3.dtype:
+            # dot_general rejects mixed operand dtypes; preserve jnp
+            # promotion semantics for e.g. a bf16 activation times an
+            # f32 weight with AMP off (ADVICE r4)
+            ct = jnp.promote_types(x.dtype, y3.dtype)
+            x, y3 = x.astype(ct), y3.astype(ct)
         out = jax.lax.dot_general(
             x, y3, dims, precision=jax.lax.Precision.HIGHEST
             if x.dtype == jnp.float32 else None)

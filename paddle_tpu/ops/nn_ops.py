@@ -485,8 +485,8 @@ def dropout(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _swce_core(logits, lab, ax, ignore_index):
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _swce_core(logits, lab, ax, ignore_index, loss_f32=False):
     """Hard-label softmax-CE along axis `ax` with an ANALYTIC backward.
     The jax.vjp-synthesized gradient keeps the full f32 log-prob tensor
     as a residual — at BERT's MLM head that is a ~1 GB [B, T, V] f32
@@ -502,12 +502,17 @@ def _swce_core(logits, lab, ax, ignore_index):
     softmax_with_cross_entropy_grad kernel
     (operators/softmax_with_cross_entropy_op.cu).
 
-    `lab` has the logits rank with a size-1 dim at `ax`."""
-    y, _ = _swce_fwd_math(logits, lab, ax, ignore_index)
+    `lab` has the logits rank with a size-1 dim at `ax`.
+
+    loss_f32 keeps the Loss output in f32 even for low-precision
+    logits (AMP black-list contract): the cast must happen HERE,
+    before any dtype round-trip, or the 'f32' loss is a bf16-precision
+    value stored in an f32 array."""
+    y, _ = _swce_fwd_math(logits, lab, ax, ignore_index, loss_f32)
     return y
 
 
-def _swce_fwd_math(logits, lab, ax, ignore_index):
+def _swce_fwd_math(logits, lab, ax, ignore_index, loss_f32=False):
     lf = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(lf, axis=ax, keepdims=True)
     lab_safe = jnp.where(lab == ignore_index, 0, lab).astype(jnp.int32)
@@ -516,15 +521,15 @@ def _swce_fwd_math(logits, lab, ax, ignore_index):
     loss = jnp.where(valid, -picked, 0.0)
     softmax = jnp.exp(lf - lse)
     return ((softmax.astype(logits.dtype),
-             loss.astype(logits.dtype)), lse)
+             loss if loss_f32 else loss.astype(logits.dtype)), lse)
 
 
-def _swce_fwd_rule(logits, lab, ax, ignore_index):
-    y, lse = _swce_fwd_math(logits, lab, ax, ignore_index)
+def _swce_fwd_rule(logits, lab, ax, ignore_index, loss_f32=False):
+    y, lse = _swce_fwd_math(logits, lab, ax, ignore_index, loss_f32)
     return y, (logits, lse, lab)
 
 
-def _swce_bwd_rule(ax, ignore_index, res, cts):
+def _swce_bwd_rule(ax, ignore_index, loss_f32, res, cts):
     logits, lse, lab = res
     g_s, g_l = cts
     p = jnp.exp(logits.astype(jnp.float32) - lse)
@@ -550,18 +555,26 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     axis = attrs.get('axis', -1)
     soft_label = attrs.get('soft_label', False)
     ignore_index = attrs.get('ignore_index', -100)
+    # AMP black-list parity (ADVICE r4): the reference's black rule
+    # yields an f32 Loss even from low-precision logits — a tiny
+    # per-row tensor, so reported/fetched losses keep f32 precision
+    # while the activation-sized Softmax stays in the input dtype
+    loss_up = (attrs.get('__amp_black__') or
+               attrs.get('__amp_black_out__')) and \
+        logits.dtype in (jnp.bfloat16, jnp.float16)
     if not soft_label:
         ax = axis % logits.ndim
         lab = label
         if lab.ndim != logits.ndim:
             lab = jnp.expand_dims(lab, ax)
-        softmax, loss = _swce_core(logits, lab, ax, int(ignore_index))
+        softmax, loss = _swce_core(logits, lab, ax, int(ignore_index),
+                                   bool(loss_up))
         return {'Softmax': [softmax], 'Loss': [loss]}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     softmax = jnp.exp(logp)
     loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
     return {'Softmax': [softmax.astype(logits.dtype)],
-            'Loss': [loss.astype(logits.dtype)]}
+            'Loss': [loss if loss_up else loss.astype(logits.dtype)]}
 
 
 @register('cross_entropy')

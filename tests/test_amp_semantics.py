@@ -195,3 +195,49 @@ def test_amp_while_loop_carry_dtype_stable():
         val, = exe.run(main, feed={'x': np.eye(4, dtype='float32')},
                        fetch_list=[out])
     assert np.isfinite(np.asarray(val)).all()
+
+
+def test_amp_loss_output_is_f32():
+    """Reference AMP black-list rule: f32 Loss even from bf16 logits
+    (ADVICE r4) — fetched losses keep f32 precision while the
+    activation-sized Softmax stays low-precision."""
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, 16, act='relu')
+        logits = fluid.layers.fc(h, 4)
+        loss_v = fluid.layers.softmax_with_cross_entropy(logits, y)
+        loss = fluid.layers.mean(loss_v)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(0.1), use_dynamic_loss_scaling=False)
+        opt.minimize(loss)
+    swce = [op for op in main.global_block().ops
+            if op.type == 'softmax_with_cross_entropy']
+    assert swce and swce[0].attrs.get('__amp_black_out__')
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(4, 8).astype('float32'),
+            'y': rng.randint(0, 4, (4, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        out, = exe.run(main, feed=feed, fetch_list=[loss_v],
+                       return_numpy=False)
+    import jax.numpy as jnp
+    assert jnp.asarray(out).dtype == jnp.float32
+
+
+def test_mul_mixed_dtype_promotes():
+    """mul with AMP off and mixed operand dtypes promotes like jnp
+    instead of erroring in dot_general (ADVICE r4)."""
+    from paddle_tpu.ops import registry
+    import jax.numpy as jnp
+    x = jnp.ones((2, 3), jnp.bfloat16)
+    w = jnp.ones((3, 4), jnp.float32)
+    out = registry.get('mul').fn(
+        registry.LowerCtx(0), {'X': [x], 'Y': [w]},
+        {'x_num_col_dims': 1, 'y_num_col_dims': 1})['Out'][0]
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.full((2, 4), 3.0))

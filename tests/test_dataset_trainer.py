@@ -80,3 +80,85 @@ def test_train_from_dataset(tmp_path):
                                        fetch_list=[loss],
                                        print_period=5)
     assert steps == 10, steps
+
+
+def test_infer_from_dataset_does_not_update_params(tmp_path):
+    """Reference keeps separate entry points (executor.py:1115 region):
+    infer_from_dataset over a TRAINING program must not touch the
+    parameters (round-5 fix: the optimizer/backward ops are pruned)."""
+    rng = np.random.RandomState(7)
+    path = str(tmp_path / 'infer.txt')
+    _write_ctr_file(path, 128, rng)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = fluid.layers.data('dense', shape=[4], dtype='float32')
+        ids = fluid.layers.data('ids', shape=[3], dtype='int64')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        emb = fluid.layers.reshape(emb, [0, 24])
+        h = fluid.layers.fc(fluid.layers.concat([dense, emb], axis=1),
+                            16, act='relu')
+        logit = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                logit, fluid.layers.cast(label, 'float32')))
+        fluid.optimizer.SGD(1.0).minimize(loss)  # lr=1: would move fast
+
+    dataset = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    dataset.set_batch_size(64)
+    dataset.set_filelist([path])
+    dataset.set_use_var([dense, ids, label])
+    dataset.load_into_memory()
+
+    pnames = [p.name for p in main.all_parameters()]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        before = {n: np.array(np.asarray(scope.find_var(n)))
+                  for n in pnames}
+        steps = exe.infer_from_dataset(main, dataset, fetch_list=[loss],
+                                       print_period=1)
+        after = {n: np.asarray(scope.find_var(n)) for n in pnames}
+    assert steps == 2, steps
+    for n in pnames:
+        np.testing.assert_array_equal(before[n], after[n])
+
+
+def test_infer_from_dataset_reclones_after_mutation(tmp_path):
+    """The cached inference clone is keyed on the program version: a
+    mutation after the first infer (re-minimize, new layers) must
+    re-clone, not run the stale pre-mutation graph."""
+    rng = np.random.RandomState(9)
+    path = str(tmp_path / 'reclone.txt')
+    _write_ctr_file(path, 64, rng)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dense = fluid.layers.data('dense', shape=[4], dtype='float32')
+        ids = fluid.layers.data('ids', shape=[3], dtype='int64')
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        logit = fluid.layers.fc(dense, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                logit, fluid.layers.cast(label, 'float32')))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+
+    dataset = fluid.DatasetFactory().create_dataset('InMemoryDataset')
+    dataset.set_batch_size(64)
+    dataset.set_filelist([path])
+    dataset.set_use_var([dense, ids, label])
+    dataset.load_into_memory()
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        exe.infer_from_dataset(main, dataset)
+        v1 = main._infer_clone
+        # mutate: add a scaled fetch head (bumps the program version)
+        with fluid.program_guard(main, startup):
+            fluid.layers.scale(loss, scale=2.0)
+        exe.infer_from_dataset(main, dataset)
+        v2 = main._infer_clone
+    assert v1[0] != v2[0] and v1[1] is not v2[1]
