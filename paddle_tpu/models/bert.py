@@ -25,9 +25,9 @@ class BertConfig(object):
         self.max_pos = max_pos
         self.type_vocab = type_vocab
         self.dropout = dropout
-        # dropout on the attention probabilities: incompatible with the
-        # flash kernel (the probs never materialize) — set to 0 to take
-        # the flash path in training
+        # dropout on the attention probabilities (reference default:
+        # dropout inside attention) — runs IN the flash kernels via a
+        # counter-hash mask, so the flash path takes it natively
         self.attn_dropout = dropout if attn_dropout is None \
             else attn_dropout
         self.use_flash = use_flash
@@ -97,13 +97,14 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None,
 
     seq_len = x.shape[1] if len(x.shape) >= 2 else 0
     use_flash = getattr(cfg, 'use_flash', False) and \
-        (is_test or not getattr(cfg, 'attn_dropout', cfg.dropout)) and \
         (seq_len is None or seq_len < 0 or
          seq_len >= getattr(cfg, 'flash_min_len', 1024)) and \
         (attn_bias is None or key_bias is not None)
     # the flash kernel consumes the [B, T] key_bias form only: with a
     # general attn_bias and no key_bias we must keep the naive chain
-    # rather than silently dropping the mask
+    # rather than silently dropping the mask.  Attention-prob dropout
+    # (the reference BERT default) runs INSIDE the kernels since round
+    # 5 — no [T, T] probs ever materialize.
     if use_flash:
         from ..fluid.layer_helper import LayerHelper
 
@@ -116,9 +117,12 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None,
         inputs = {'Q': q3, 'K': k3, 'V': v3}
         if key_bias is not None:
             inputs['KeyBias'] = key_bias
+        adrop = 0.0 if is_test else float(
+            getattr(cfg, 'attn_dropout', cfg.dropout) or 0.0)
         helper.append_op('fused_multihead_attention', inputs=inputs,
                          outputs={'Out': out},
-                         attrs={'causal': bool(causal)},
+                         attrs={'causal': bool(causal),
+                                'dropout_rate': adrop},
                          infer_shape=False)
         out.shape = tuple(q3.shape)
         ctx = layers.reshape(out, [0, 0, h])

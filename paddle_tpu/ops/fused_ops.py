@@ -16,15 +16,31 @@ from .registry import register
 def fused_multihead_attention(ctx, ins, attrs):
     """Q,K,V: [B, T, H, D] (+ optional KeyBias [B, T] additive score
     bias, e.g. a padding mask) -> Out [B, T, H, D] via the Pallas flash
-    attention kernels, forward and backward (interpret mode off-TPU)."""
+    attention kernels, forward and backward (interpret mode off-TPU).
+
+    attrs['dropout_rate'] > 0 applies attention-probability dropout
+    INSIDE the kernels (reference default: dropout around softmax,
+    python/paddle/fluid/layers/nn.py + operators/dropout_op.cu) with a
+    mask keyed on (op seed, step) so per-op replay and whole-program
+    vjp regenerate it; skipped in test-mode lowering like the dropout
+    op."""
     from .pallas.flash_attention import flash_attention
     q = ins['Q'][0]
     k = ins['K'][0]
     v = ins['V'][0]
     bias = ins['KeyBias'][0] if ins.get('KeyBias') else None
+    rate = float(attrs.get('dropout_rate', 0.0) or 0.0)
+    seed = None
+    if rate and not ctx.prefer_test:
+        seed = (jnp.uint32(ctx.op_seed * 2654435761 % (1 << 32)) ^
+                jnp.asarray(ctx.step, jnp.uint32) *
+                jnp.uint32(0x9E3779B9))
+    else:
+        rate = 0.0
     return {'Out': [flash_attention(q, k, v,
                                     causal=attrs.get('causal', False),
-                                    key_bias=bias)]}
+                                    key_bias=bias, dropout_rate=rate,
+                                    dropout_seed=seed)]}
 
 
 @register('fused_elemwise_activation')

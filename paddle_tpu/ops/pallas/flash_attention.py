@@ -47,12 +47,35 @@ FLASH_MIN_SEQ = 512
 VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 
 
+def _dropout_keep(seed, g, qpos, kpos, keep_threshold):
+    """Deterministic per-(head, q, k) keep mask from a counter hash
+    (murmur3-finalizer mix): the same element draws the same bit in the
+    forward kernel, both backward kernels, the dense path, and any
+    replay (per-op grad or whole-program vjp) — the (op_seed, step)
+    keying discipline the dropout op uses, in-kernel.  Integer ops
+    only, so Mosaic and interpret mode agree bit-for-bit."""
+    h = (qpos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)) ^ \
+        (kpos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)) ^ \
+        (jnp.asarray(g, jnp.uint32) * jnp.uint32(0xC2B2AE3D)) ^ seed
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> jnp.uint32(16))
+    return (h >> jnp.uint32(8)) < jnp.uint32(keep_threshold)
+
+
+def _keep_threshold(rate):
+    """24-bit integer threshold for keep-probability (1 - rate)."""
+    return int(round((1.0 - float(rate)) * (1 << 24)))
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                      block_k, has_bias):
-    if has_bias:
-        bias_ref, o_ref, lse_ref = rest
-    else:
-        bias_ref, (o_ref, lse_ref) = None, rest
+                      block_k, has_bias, rate):
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    seed_ref = rest.pop(0) if rate else None
+    o_ref, lse_ref = rest
     # q_ref: [1, bq, d]; k/v_ref: [1, T, d]; bias_ref: [1, 1, T];
     # o_ref: [1, bq, d]; lse_ref: [1, 1, bq]  (the singleton middle dim
     # satisfies the TPU block-shape rule for 1-D-per-row operands)
@@ -64,6 +87,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     bq, d = q.shape
     t = k_ref.shape[1]
     q_off = pl.program_id(1) * bq
+    g_id = pl.program_id(0)
 
     nk = t // block_k
 
@@ -90,7 +114,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         p = jnp.exp(s - m_safe[:, None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        # dropout applies AFTER softmax (reference: dropout around the
+        # probs, python/paddle/fluid/layers/nn.py): the normalizer l
+        # accumulates the UNDROPPED p, only the V-weighting is masked
         l_new = l * corr + jnp.sum(p, axis=1)
+        if rate:
+            qpos_d = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos_d = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            keep = _dropout_keep(seed_ref[0, 0], g_id,
+                                 qpos_d, kpos_d, _keep_threshold(rate))
+            p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -114,9 +149,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                         block_k, has_bias, has_glse):
+                         block_k, has_bias, has_glse, rate):
     rest = list(rest)
     bias_ref = rest.pop(0) if has_bias else None
+    seed_ref = rest.pop(0) if rate else None
     do_ref, lse_ref, delta_ref = rest[0], rest[1], rest[2]
     glse_ref = rest[3] if has_glse else None
     dq_ref = rest[-1]
@@ -132,6 +168,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     bq, d = q.shape
     t = k_ref.shape[1]
     q_off = pl.program_id(1) * bq
+    g_id = pl.program_id(0)
     nk = t // block_k
 
     def body(i, dq):
@@ -154,6 +191,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
                       jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if rate:
+            # softmax vjp with post-softmax dropout u: dS = p*(u*dp -
+            # delta); delta = rowsum(dO*O) already sees the dropout
+            # because O was computed WITH it
+            qpos_d = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos_d = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            keep = _dropout_keep(seed_ref[0, 0], g_id,
+                                 qpos_d, kpos_d, _keep_threshold(rate))
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         dd = dp - delta[:, None]
         if has_glse:
             dd = dd + glse[:, None]
@@ -173,9 +221,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
-                          block_q, has_bias, has_glse):
+                          block_q, has_bias, has_glse, rate):
     rest = list(rest)
     bias_ref = rest.pop(0) if has_bias else None
+    seed_ref = rest.pop(0) if rate else None
     do_ref, lse_ref, delta_ref = rest[0], rest[1], rest[2]
     glse_ref = rest[3] if has_glse else None
     dk_ref, dv_ref = rest[-3:-1] if has_bias else rest[-2:]
@@ -189,6 +238,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
     bk, d = k.shape
     t = q_ref.shape[1]
     k_off = pl.program_id(1) * bk
+    g_id = pl.program_id(0)
     nq = t // block_q
 
     def body(j, carry):
@@ -214,11 +264,23 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s),
                       jnp.exp(s - lse[:, None]), 0.0)
+        if rate:
+            qpos_d = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            kpos_d = k_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            keep = _dropout_keep(seed_ref[0, 0], g_id,
+                                 qpos_d, kpos_d, _keep_threshold(rate))
+            pu = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+        else:
+            keep, pu = None, p
         dv = dv + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            pu.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if rate:
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         dd = dp - delta[:, None]
         if has_glse:
             dd = dd + glse[:, None]
@@ -295,9 +357,10 @@ def _block_sizes(t, block_q, block_k, d=64, itemsize=2):
     return block_q, block_k
 
 
-def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D], bias: [B, T] or None
-    -> (o [BH,T,D], lse [BH,T])."""
+def _flash_fwd(q, k, v, bias, seed, h, causal, block_q, block_k,
+               interpret, rate=0.0):
+    """q,k,v: [BH, T, D], bias: [B, T] or None, seed: uint32 scalar
+    (required when rate>0) -> (o [BH,T,D], lse [BH,T])."""
     bh, t, d = q.shape
     block_q, block_k = _block_sizes(t, block_q, block_k, d,
                                     q.dtype.itemsize)
@@ -305,7 +368,7 @@ def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
     has_bias = bias is not None
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, block_k=block_k,
-                               has_bias=has_bias)
+                               has_bias=has_bias, rate=rate)
     grid = (bh, t // block_q)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -317,6 +380,9 @@ def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
         in_specs.append(pl.BlockSpec((1, 1, t),
                                      lambda i, j: (i // h, 0, 0)))
         operands.append(bias[:, None, :])
+    if rate:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0)))
+        operands.append(jnp.asarray(seed, jnp.uint32).reshape(1, 1))
     o, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
@@ -334,8 +400,8 @@ def _flash_fwd(q, k, v, bias, h, causal, block_q, block_k, interpret):
     return o, lse3[:, 0, :]
 
 
-def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
-               block_k, interpret):
+def _flash_bwd(q, k, v, bias, seed, o, lse, do, g_lse, h, causal,
+               block_q, block_k, interpret, rate=0.0):
     bh, t, d = q.shape
     block_q, block_k = _block_sizes(t, block_q, block_k, d,
                                     q.dtype.itemsize)
@@ -348,10 +414,13 @@ def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
     glse3 = g_lse.astype(jnp.float32)[:, None, :] if has_glse else None
+    seed2 = jnp.asarray(seed, jnp.uint32).reshape(1, 1) if rate else None
+    seed_spec = pl.BlockSpec((1, 1), lambda i, j: (0, 0))
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
                                   causal=causal, block_k=block_k,
-                                  has_bias=has_bias, has_glse=has_glse)
+                                  has_bias=has_bias, has_glse=has_glse,
+                                  rate=rate)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
@@ -362,6 +431,9 @@ def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
         dq_specs.append(pl.BlockSpec((1, 1, t),
                                      lambda i, j: (i // h, 0, 0)))
         dq_operands.append(bias[:, None, :])
+    if rate:
+        dq_specs.append(seed_spec)
+        dq_operands.append(seed2)
     dq_specs += [
         pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
@@ -384,7 +456,7 @@ def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                                    causal=causal, block_q=block_q,
                                    has_bias=has_bias,
-                                   has_glse=has_glse)
+                                   has_glse=has_glse, rate=rate)
     dkv_specs = [
         pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -395,6 +467,9 @@ def _flash_bwd(q, k, v, bias, o, lse, do, g_lse, h, causal, block_q,
         dkv_specs.append(pl.BlockSpec((1, 1, block_k),
                                       lambda i, j: (i // h, 0, j)))
         dkv_operands.append(bias[:, None, :])
+    if rate:
+        dkv_specs.append(seed_spec)
+        dkv_operands.append(seed2)
     dkv_specs += [
         pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
@@ -449,88 +524,113 @@ def _dense_reference(q, k, v, causal):
         q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_lse(q, k, v, bias, h, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_lse(q, k, v, bias, seed, h, causal, rate):
     """(o, lse): lse is a first-class differentiable output so ring
     attention can merge per-block flash results (parallel/
     ring_attention.py ring_flash_attention)."""
     interpret = not _on_tpu()
-    return _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
-                      DEFAULT_BLOCK_K, interpret)
+    return _flash_fwd(q, k, v, bias, seed, h, causal, DEFAULT_BLOCK_Q,
+                      DEFAULT_BLOCK_K, interpret, rate)
 
 
-def _flash_lse_fwd_rule(q, k, v, bias, h, causal):
+def _flash_lse_fwd_rule(q, k, v, bias, seed, h, causal, rate):
     interpret = not _on_tpu()
-    o, lse = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
-                        DEFAULT_BLOCK_K, interpret)
-    return (o, lse), (q, k, v, bias, o, lse)
+    o, lse = _flash_fwd(q, k, v, bias, seed, h, causal,
+                        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+                        rate)
+    return (o, lse), (q, k, v, bias, seed, o, lse)
 
 
-def _flash_lse_bwd_rule(h, causal, res, gs):
-    q, k, v, bias, o, lse = res
+def _flash_lse_bwd_rule(h, causal, rate, res, gs):
+    q, k, v, bias, seed, o, lse = res
     g, g_lse = gs
     interpret = not _on_tpu()
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g, g_lse, h,
-                                   causal, DEFAULT_BLOCK_Q,
-                                   DEFAULT_BLOCK_K, interpret)
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, seed, o, lse, g,
+                                   g_lse, h, causal, DEFAULT_BLOCK_Q,
+                                   DEFAULT_BLOCK_K, interpret, rate)
     return dq, dk, dv, (None if bias is None
-                        else dbias.astype(bias.dtype))
+                        else dbias.astype(bias.dtype)), None
 
 
 _flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, bias, h, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, bias, seed, h, causal, rate):
     # o-only primitive with its OWN vjp so the common (non-ring) path
     # never ships a zeros g_lse operand into the backward kernels
     interpret = not _on_tpu()
-    o, _ = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
-                      DEFAULT_BLOCK_K, interpret)
+    o, _ = _flash_fwd(q, k, v, bias, seed, h, causal, DEFAULT_BLOCK_Q,
+                      DEFAULT_BLOCK_K, interpret, rate)
     return o
 
 
-def _flash_fwd_rule(q, k, v, bias, h, causal):
+def _flash_fwd_rule(q, k, v, bias, seed, h, causal, rate):
     interpret = not _on_tpu()
-    o, lse = _flash_fwd(q, k, v, bias, h, causal, DEFAULT_BLOCK_Q,
-                        DEFAULT_BLOCK_K, interpret)
-    return o, (q, k, v, bias, o, lse)
+    o, lse = _flash_fwd(q, k, v, bias, seed, h, causal,
+                        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret,
+                        rate)
+    return o, (q, k, v, bias, seed, o, lse)
 
 
-def _flash_bwd_rule(h, causal, res, g):
-    q, k, v, bias, o, lse = res
+def _flash_bwd_rule(h, causal, rate, res, g):
+    q, k, v, bias, seed, o, lse = res
     interpret = not _on_tpu()
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, o, lse, g, None, h,
-                                   causal, DEFAULT_BLOCK_Q,
-                                   DEFAULT_BLOCK_K, interpret)
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, seed, o, lse, g,
+                                   None, h, causal, DEFAULT_BLOCK_Q,
+                                   DEFAULT_BLOCK_K, interpret, rate)
     return dq, dk, dv, (None if bias is None
-                        else dbias.astype(bias.dtype))
+                        else dbias.astype(bias.dtype)), None
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _dense_path(q, k, v, causal, key_bias):
+def _dense_path(q, k, v, causal, key_bias, dropout_rate=0.0,
+                dropout_seed=None):
     """Fused-by-XLA dense chain on [B, T, H, D] (bf16 dots, f32
     softmax) — the measured winner below FLASH_MIN_SEQ, where the
-    whole chain fits VMEM outright.  Differentiable via XLA autodiff."""
-    d = q.shape[-1]
+    whole chain fits VMEM outright.  Differentiable via XLA autodiff.
+    Dropout draws the SAME counter-hash mask as the Pallas kernels, so
+    the two dispatch arms are bit-identical stochastic functions of
+    (seed, element position)."""
+    b, t, h, d = q.shape
     s = jnp.einsum('bthd,bshd->bhts', q, k,
                    preferred_element_type=jnp.float32) / (d ** 0.5)
     if key_bias is not None:
         s = s + key_bias.astype(jnp.float32)[:, None, None, :]
     if causal:
-        t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate:
+        # SAME hash as the kernels (_dropout_keep takes the per-element
+        # head index as an array here, the grid program_id there)
+        g = (jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 0) * h +
+             jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 1))
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 2)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 3)
+        keep = _dropout_keep(jnp.asarray(dropout_seed, jnp.uint32), g,
+                             qpos, kpos, _keep_threshold(dropout_rate))
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+    p = p.astype(q.dtype)
     return jnp.einsum('bhts,bshd->bthd', p, v)
 
 
 def flash_attention(q, k, v, causal=False, key_bias=None,
-                    min_seq=None):
+                    min_seq=None, dropout_rate=0.0, dropout_seed=None):
     """q,k,v: [B, T, H, D]; key_bias: optional [B, T] additive score
     bias (e.g. padding mask as 0 / -10000) -> [B, T, H, D].
+
+    dropout_rate > 0 applies dropout to the attention probabilities
+    INSIDE the kernels (reference default: dropout around softmax,
+    operators/dropout_op.cu used by layers/nn.py) — the [T, T] probs
+    still never materialize.  The mask is a counter hash of
+    (dropout_seed, head, q, k): forward, both backward kernels, and
+    any replay regenerate it bit-for-bit, so per-op grad replay and
+    whole-program vjp see the same network.  dropout_seed must be a
+    uint32 scalar (fold the op seed with the step).
 
     Auto-dispatch: sequences shorter than `min_seq` (default
     FLASH_MIN_SEQ, the measured crossover) run the dense XLA chain —
@@ -539,31 +639,44 @@ def flash_attention(q, k, v, causal=False, key_bias=None,
     b, t, h, d = q.shape
     if min_seq is None:
         min_seq = FLASH_MIN_SEQ
+    rate = float(dropout_rate or 0.0)
+    if rate and dropout_seed is None:
+        raise ValueError('dropout_rate > 0 needs a dropout_seed')
     if t < min_seq:
-        return _dense_path(q, k, v, causal, key_bias)
+        return _dense_path(q, k, v, causal, key_bias, rate,
+                           dropout_seed)
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
     if key_bias is not None:
         key_bias = key_bias.astype(jnp.float32)
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), key_bias, h, causal)
+    seed = jnp.asarray(dropout_seed, jnp.uint32) if rate else None
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), key_bias, seed, h,
+                 causal, rate)
     return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
 
 
-def flash_attention_with_lse(q, k, v, causal=False, key_bias=None):
+def flash_attention_with_lse(q, k, v, causal=False, key_bias=None,
+                             dropout_rate=0.0, dropout_seed=None):
     """Like flash_attention but also returns the per-row log-sum-exp
     [B, H, T] — the merge state for blockwise/ring composition.  Both
     outputs are differentiable (the lse cotangent folds into dS inside
-    the backward kernels)."""
+    the backward kernels).  lse is computed from the UNDROPPED probs
+    (dropout scales only the V-weighting), so ring merges stay exact
+    under dropout."""
     b, t, h, d = q.shape
+    rate = float(dropout_rate or 0.0)
+    if rate and dropout_seed is None:
+        raise ValueError('dropout_rate > 0 needs a dropout_seed')
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
     if key_bias is not None:
         key_bias = key_bias.astype(jnp.float32)
-    o, lse = _flash_lse(to_bh(q), to_bh(k), to_bh(v), key_bias, h,
-                        causal)
+    seed = jnp.asarray(dropout_seed, jnp.uint32) if rate else None
+    o, lse = _flash_lse(to_bh(q), to_bh(k), to_bh(v), key_bias, seed,
+                        h, causal, rate)
     o = jnp.transpose(o.reshape(b, h, t, d), (0, 2, 1, 3))
     return o, lse.reshape(b, h, t)
