@@ -7,19 +7,27 @@ DisableProfiler, which print a per-op time table sorted by
 
 TPU-native split, mirroring the reference's two profilers:
 
-- The PER-OP TABLE (this module's state): while profiling is enabled
-  the executor compiles each device op as its OWN one-op segment and
-  host-times it to completion (block_until_ready).  That is the
-  reference's host-side RecordEvent semantics — per-op serialization
-  is the documented price of op-granular timing there too (the CUDA
-  profiler also serializes streams per event).  stop_profiler prints
-  the sorted table; summary_records()/summary_string() expose it
-  programmatically.
-- The DEVICE TRACE: jax.profiler capture (Perfetto/TensorBoard) via
-  start_trace()/tools/timeline.py, for fused steady-state kernels with
-  fluid op names in the metadata (executor runs every lowering under
-  jax.named_scope).  Use this for production perf work; the per-op
-  table is for "which op is slow" triage, like the reference's.
+- tracer_option='Serial': while profiling is enabled the executor
+  compiles each device op as its OWN one-op segment and host-times it
+  to completion (block_until_ready).  That is the reference's
+  host-side RecordEvent semantics — per-op serialization is the
+  documented price of op-granular timing there too (the CUDA profiler
+  also serializes streams per event).  NOTE the measured program is a
+  different (unfused) compilation of the same ops.
+- tracer_option='Default' (round 5): the PRODUCTION program runs
+  untouched under a jax.profiler device-trace capture; on exit the
+  trace's per-kernel events are attributed back to fluid op types
+  through the named_scope metadata every lowering runs under
+  (executor._lower_ops -> XLA op_metadata -> trace `tf_op` args) and
+  summed into the same sorted table.  This is the reference's
+  DeviceTracer leg (platform/device_tracer.h: CUPTI kernels correlated
+  back to op RecordEvents) — per-op attribution of the REAL fused run.
+  Device-kernel metadata is only emitted by the TPU backend; on CPU
+  hosts the table falls back to unattributed HLO thunk names.
+
+stop_profiler prints the sorted table; summary_records() /
+summary_string() expose it programmatically.  start_trace()/
+stop_trace() + tools/timeline.py remain the raw Perfetto capture.
 """
 
 import contextlib
@@ -30,12 +38,16 @@ import jax
 _SORT_KEYS = ('calls', 'total', 'max', 'min', 'ave')
 
 _enabled = False
+_mode = 'Serial'         # 'Serial' | 'Default' (trace-derived)
 _records = {}  # op type -> [calls, total, max, min]
 _trace_path = None
+_prof_trace_dir = None   # capture dir while a 'Default' profile runs
 
 
 def is_enabled():
-    return _enabled
+    """True when the executor must split per-op ('Serial' mode only:
+    the trace-derived mode measures the production program)."""
+    return _enabled and _mode == 'Serial'
 
 
 def record_op(op_type, seconds):
@@ -83,13 +95,92 @@ def summary_string(sorted_key='total'):
     return '\n'.join(lines)
 
 
-def start_profiler(state='All'):
-    """Enable per-op timing (reference EnableProfiler).  `state` kept
-    for API parity; on TPU there is no CPU/GPU split to select."""
-    global _enabled
+def _registered_op_types():
+    from ..ops import registry
+    return set(registry._REGISTRY)
+
+
+def attribute_trace_events(events, op_types=None):
+    """Map device-trace kernel events back to fluid op types.
+
+    `events` are chrome-trace events (trace.json 'traceEvents').  Each
+    TPU kernel event carries args['tf_op'] — the XLA op_metadata
+    op_name, i.e. the jax.named_scope path the executor wrapped the
+    lowering in ('jit_segment_x/relu/max' or, under whole-program
+    autodiff, 'jit_.../transpose(jvp(...))/relu/...').  Attribution:
+    the first path component that names a registered op type; kernels
+    with no such component (copies, infeed, grad-only glue) land under
+    'unattributed/<hlo name>'.  Returns {name: [calls, total_s, max_s,
+    min_s]}."""
+    op_types = op_types or _registered_op_types()
+    recs = {}
+    cache = {}
+    for e in events:
+        if e.get('ph') != 'X':
+            continue
+        args = e.get('args') or {}
+        tf_op = args.get('tf_op')
+        if not tf_op:
+            continue
+        name = cache.get(tf_op)
+        if name is None:
+            name = None
+            for comp in tf_op.split('/'):
+                # strip transform wrappers: transpose(jvp(relu)) etc.
+                base = comp
+                while '(' in base and base.endswith(')'):
+                    base = base[base.index('(') + 1:-1]
+                if comp in op_types:
+                    name = comp
+                    break
+                if base in op_types:
+                    name = base
+                    break
+            if name is None:
+                name = 'unattributed/' + e.get('name', '?').split('.')[0]
+            cache[tf_op] = name
+        sec = float(e.get('dur', 0)) * 1e-6
+        rec = recs.get(name)
+        if rec is None:
+            recs[name] = [1, sec, sec, sec]
+        else:
+            rec[0] += 1
+            rec[1] += sec
+            rec[2] = max(rec[2], sec)
+            rec[3] = min(rec[3], sec)
+    return recs
+
+
+def _load_trace_events(logdir):
+    import glob
+    import gzip
+    import json
+    paths = glob.glob(os.path.join(logdir, '**', '*.trace.json.gz'),
+                      recursive=True)
+    if not paths:
+        return []
+    with gzip.open(sorted(paths)[-1], 'rt') as f:
+        return json.load(f).get('traceEvents', [])
+
+
+def start_profiler(state='All', tracer_option='Serial'):
+    """Enable profiling (reference EnableProfiler).  `state` kept for
+    API parity; on TPU there is no CPU/GPU split to select.
+    tracer_option='Serial' re-segments per op and host-times each;
+    'Default' captures a device trace of the PRODUCTION program and
+    attributes kernels back to ops on stop (reference DeviceTracer)."""
+    global _enabled, _mode, _prof_trace_dir
     if state not in ('CPU', 'GPU', 'All'):
         raise ValueError("state must be 'CPU', 'GPU' or 'All'")
+    if tracer_option not in ('Serial', 'Default', 'OpDetail',
+                             'AllOpDetail'):
+        raise ValueError('unknown tracer_option %r' % (tracer_option,))
     reset_profiler()
+    _mode = 'Serial' if tracer_option == 'Serial' else 'Default'
+    if _mode == 'Default':
+        import tempfile
+        _prof_trace_dir = tempfile.mkdtemp(prefix='pt_prof_')
+        jax.profiler.start_trace(_prof_trace_dir)
     _enabled = True
 
 
@@ -97,8 +188,15 @@ def stop_profiler(sorted_key='total', profile_path=None):
     """Disable profiling and print the sorted per-op table (reference
     DisableProfiler).  profile_path, when given, receives the table as
     a text file."""
-    global _enabled
+    global _enabled, _prof_trace_dir
     _enabled = False
+    if _mode == 'Default' and _prof_trace_dir is not None:
+        import shutil
+        jax.profiler.stop_trace()
+        events = _load_trace_events(_prof_trace_dir)
+        _records.update(attribute_trace_events(events))
+        shutil.rmtree(_prof_trace_dir, ignore_errors=True)
+        _prof_trace_dir = None
     table = summary_string(sorted_key)
     print(table)
     if profile_path:
@@ -117,11 +215,13 @@ def stop_profiler(sorted_key='total', profile_path=None):
 
 @contextlib.contextmanager
 def profiler(state='All', sorted_key='total',
-             profile_path='/tmp/profile.txt', tracer_option=None):
-    """Per-op profiling scope: ops inside run one-per-segment and
-    host-timed; on exit the sorted table prints (and lands in
-    profile_path)."""
-    start_profiler(state)
+             profile_path='/tmp/profile.txt', tracer_option='Serial'):
+    """Profiling scope.  tracer_option='Serial': ops run
+    one-per-segment and host-timed (op-granular, but an unfused
+    program).  'Default': the production program runs untouched under
+    a device-trace capture, kernels attributed back to ops.  On exit
+    the sorted table prints (and lands in profile_path)."""
+    start_profiler(state, tracer_option=tracer_option or 'Serial')
     try:
         yield
     finally:

