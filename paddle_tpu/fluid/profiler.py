@@ -124,7 +124,6 @@ def attribute_trace_events(events, op_types=None):
             continue
         name = cache.get(tf_op)
         if name is None:
-            name = None
             for comp in tf_op.split('/'):
                 # strip transform wrappers: transpose(jvp(relu)) etc.
                 base = comp
@@ -136,9 +135,12 @@ def attribute_trace_events(events, op_types=None):
                 if base in op_types:
                     name = base
                     break
-            if name is None:
-                name = 'unattributed/' + e.get('name', '?').split('.')[0]
-            cache[tf_op] = name
+            if name is not None:
+                cache[tf_op] = name
+        if name is None:
+            # per-HLO-name bucket; NOT cached on tf_op — distinct
+            # kernels can share a scope path
+            name = 'unattributed/' + e.get('name', '?').split('.')[0]
         sec = float(e.get('dur', 0)) * 1e-6
         rec = recs.get(name)
         if rec is None:
@@ -176,6 +178,14 @@ def start_profiler(state='All', tracer_option='Serial'):
                              'AllOpDetail'):
         raise ValueError('unknown tracer_option %r' % (tracer_option,))
     reset_profiler()
+    if _prof_trace_dir is not None:
+        # a 'Default' capture is still active (start called twice /
+        # mode switch without stop): close it or the device trace runs
+        # forever and the next start_trace raises
+        import shutil
+        jax.profiler.stop_trace()
+        shutil.rmtree(_prof_trace_dir, ignore_errors=True)
+        _prof_trace_dir = None
     _mode = 'Serial' if tracer_option == 'Serial' else 'Default'
     if _mode == 'Default':
         import tempfile

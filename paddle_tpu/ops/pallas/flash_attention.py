@@ -307,6 +307,195 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         dbias_ref[0, 0] = dbias.astype(dbias_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
+                            block_q, block_k, has_bias, has_glse,
+                            rate):
+    """Single-pass backward: grid (BH,) only.  The two-pass scheme
+    (dq grid over Q blocks, dk/dv grid over K blocks) recomputes the
+    score block s AND the prob-cotangent dp = dO V^T in BOTH kernels —
+    9 MXU dots per (q,k) tile-pair step instead of 7.  When the whole
+    per-head working set fits VMEM (q/k/v/do rows + an f32 dq
+    accumulator — true for the long-context shapes this kernel
+    exists for), one kernel can walk k-blocks x q-blocks computing s
+    and dp ONCE and accumulating all three gradients: dk/dv stream out
+    per k-block, dq rides a VMEM carry.  Measured motivation: the
+    round-5 traced per-op table put the flash kernels at 41% of the
+    BERT-s2048 step with 2/9 of their dot FLOPs being these
+    recomputes."""
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    seed_ref = rest.pop(0) if rate else None
+    do_ref, lse_ref, delta_ref = rest[0], rest[1], rest[2]
+    glse_ref = rest[3] if has_glse else None
+    acc_ref = rest[-1]          # f32 VMEM scratch for the dq carry
+    if has_bias:
+        dq_ref, dk_ref, dv_ref, dbias_ref = rest[-5], rest[-4], \
+            rest[-3], rest[-2]
+    else:
+        dq_ref, dk_ref, dv_ref = rest[-4], rest[-3], rest[-2]
+        dbias_ref = None
+    t, d = q_ref.shape[1], q_ref.shape[2]
+    g_id = pl.program_id(0)
+    nq, nk = t // block_q, t // block_k
+
+    def k_step(i, _):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :]
+        bias = bias_ref[0, 0, pl.dslice(i * block_k, block_k)].astype(
+            jnp.float32) if has_bias else None
+
+        def q_step(j, carry):
+            dk, dv, dbias = carry
+            q = q_ref[0, pl.dslice(j * block_q, block_q), :]
+            do = do_ref[0, pl.dslice(j * block_q, block_q), :]
+            lse = lse_ref[0, 0, pl.dslice(j * block_q,
+                                          block_q)].astype(jnp.float32)
+            delta = delta_ref[0, 0, pl.dslice(j * block_q,
+                                              block_q)].astype(
+                jnp.float32)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            if has_bias:
+                s = s + bias[None, :]
+            if causal:
+                qpos = j * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos = i * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            p = jnp.where(jnp.isfinite(s),
+                          jnp.exp(s - lse[:, None]), 0.0)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            if rate:
+                qpos_d = j * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                kpos_d = i * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                keep = _dropout_keep(seed_ref[0, 0], g_id, qpos_d,
+                                     kpos_d, _keep_threshold(rate))
+                pu = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+                dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
+            else:
+                pu = p
+            dv = dv + jax.lax.dot_general(
+                pu.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dd = dp - delta[:, None]
+            if has_glse:
+                glse = glse_ref[0, 0, pl.dslice(j * block_q,
+                                                block_q)].astype(
+                    jnp.float32)
+                dd = dd + glse[:, None]
+            ds_raw = p * dd
+            dk = dk + jax.lax.dot_general(
+                ds_raw.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                dbias = dbias + jnp.sum(ds_raw, axis=0)
+            dq_blk = jax.lax.dot_general(
+                ds_raw.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            # dq accumulates across k-blocks in the f32 VMEM scratch
+            # (read-modify-write through the ref: Mosaic supports
+            # dynamic slicing on refs, not on carried values)
+            cur = acc_ref[pl.dslice(j * block_q, block_q), :]
+            acc_ref[pl.dslice(j * block_q, block_q), :] = cur + dq_blk
+            return dk, dv, dbias
+
+        if causal:
+            j0 = (i * block_k) // block_q
+        else:
+            j0 = 0
+        dk0 = jnp.zeros((block_k, d), jnp.float32)
+        dv0 = jnp.zeros((block_k, d), jnp.float32)
+        db0 = jnp.zeros((block_k,), jnp.float32)
+        dk, dv, dbias = jax.lax.fori_loop(
+            j0, nq, q_step, (dk0, dv0, db0))
+        dk_ref[0, pl.dslice(i * block_k, block_k), :] = \
+            dk.astype(dk_ref.dtype)
+        dv_ref[0, pl.dslice(i * block_k, block_k), :] = \
+            dv.astype(dv_ref.dtype)
+        if has_bias:
+            dbias_ref[0, 0, pl.dslice(i * block_k, block_k)] = \
+                dbias.astype(dbias_ref.dtype)
+        return 0
+
+    acc_ref[...] = jnp.zeros((t, d), jnp.float32)
+    jax.lax.fori_loop(0, nk, k_step, 0)
+    dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, bias, seed2, do, lse3, delta3, glse3, h,
+                     causal, block_q, block_k, interpret, rate):
+    """pallas_call plumbing for the one-pass backward (grid (BH,))."""
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    has_bias = bias is not None
+    has_glse = glse3 is not None
+    kernel = functools.partial(
+        _flash_bwd_fused_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, has_bias=has_bias,
+        has_glse=has_glse, rate=rate)
+    row = pl.BlockSpec((1, t, d), lambda i: (i, 0, 0))
+    vec = pl.BlockSpec((1, 1, t), lambda i: (i, 0, 0))
+    in_specs = [row, row, row]
+    operands = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, t),
+                                     lambda i: (i // h, 0, 0)))
+        operands.append(bias[:, None, :])
+    if rate:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+        operands.append(seed2)
+    in_specs += [row, vec, vec]
+    operands += [do, lse3, delta3]
+    if has_glse:
+        in_specs.append(vec)
+        operands.append(glse3)
+    out_specs = [row, row, row]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype),
+                 jax.ShapeDtypeStruct(k.shape, k.dtype),
+                 jax.ShapeDtypeStruct(v.shape, v.dtype)]
+    if has_bias:
+        out_specs.append(vec)
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, t), jnp.float32))
+    from jax.experimental.pallas import tpu as pltpu
+    res = pl.pallas_call(
+        kernel,
+        grid=(bh,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((t, d), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+    if has_bias:
+        dq, dk, dv, dbias_bh = res
+        b = bh // h
+        dbias = dbias_bh[:, 0, :].reshape(b, h, t).sum(axis=1)
+    else:
+        dq, dk, dv = res
+        dbias = None
+    return dq, dk, dv, dbias
+
+
+# The fused one-pass backward engages when the per-head VMEM residency
+# fits; False forces the two-pass scheme (sweeps / A-B measurement).
+FUSED_BWD = True
+
+
+def _fused_bwd_vmem(t, d, block_q, block_k, itemsize):
+    """Resident bytes for the fused backward: q/k/v/do full rows, the
+    f32 dq accumulator + dk/dv/score f32 blocks (x2 slack for compiler
+    temporaries)."""
+    rows = 4 * t * d * itemsize
+    dq_acc = t * d * 4
+    blocks = 2 * block_k * d * 4 + 3 * block_q * block_k * 4
+    return rows + dq_acc + 2 * blocks + (1 << 19)
+
+
 def _on_tpu():
     try:
         return jax.devices()[0].platform.startswith('tpu') or \
@@ -416,6 +605,17 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, do, g_lse, h, causal,
     glse3 = g_lse.astype(jnp.float32)[:, None, :] if has_glse else None
     seed2 = jnp.asarray(seed, jnp.uint32).reshape(1, 1) if rate else None
     seed_spec = pl.BlockSpec((1, 1), lambda i, j: (0, 0))
+
+    fq, fk = min(block_q, 512), min(block_k, 512)
+    while t % fq:
+        fq //= 2
+    while t % fk:
+        fk //= 2
+    if FUSED_BWD and _fused_bwd_vmem(t, d, fq, fk, q.dtype.itemsize) \
+            <= VMEM_BUDGET_BYTES:
+        return _flash_bwd_fused(q, k, v, bias, seed2, do, lse3, delta3,
+                                glse3, h, causal, fq, fk, interpret,
+                                rate)
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
                                   causal=causal, block_k=block_k,

@@ -244,6 +244,7 @@ def main():
         print('STALE WAIVERS (now FD-checked, remove from WAIVERS):')
         for o in stale:
             print('  %s' % o)
+        sys.exit(1)
     if uncovered:
         print('ops with NEITHER an FD grad check NOR a waiver (%d):'
               % len(uncovered))
