@@ -97,6 +97,80 @@ class UniformPruneStrategy(object):
             ratios=[self.target_ratio] * len(params))
 
 
+class SensitivePruneStrategy(object):
+    """Sensitivity-driven magnitude pruning (reference
+    prune_strategy.py:36 SensitivePruneStrategy): sweep each target
+    parameter's prune ratio, measure the eval metric, pick the LARGEST
+    ratio whose metric drop stays within `max_drop` of the unpruned
+    baseline, then apply all chosen ratios together.
+
+    eval_fn() -> float metric where HIGHER IS BETTER (accuracy); for a
+    loss metric pass higher_is_better=False.
+
+        strat = SensitivePruneStrategy(eval_fn=evaluate, max_drop=0.02)
+        chosen = strat.prune(program, scope)   # {param: ratio}
+    """
+
+    def __init__(self, pruner=None, eval_fn=None, max_drop=0.01,
+                 ratios=(0.1, 0.3, 0.5, 0.7, 0.9), params=None,
+                 higher_is_better=True):
+        self.pruner = pruner or MagnitudePruner()
+        self.eval_fn = eval_fn
+        self.max_drop = float(max_drop)
+        self.ratios = tuple(sorted(float(r) for r in ratios))
+        self.params = params
+        self.higher_is_better = higher_is_better
+
+    def compute_sensitivities(self, program, scope=None):
+        """{param: {ratio: metric}} — one isolated sweep per param
+        (weights restored between sweeps)."""
+        scope = scope or core.global_scope()
+        params = self.params or [p.name for p in
+                                 program.all_parameters()]
+        return {name: sensitivity(program, scope, name, self.eval_fn,
+                                  self.ratios, self.pruner)
+                for name in params}
+
+    def prune(self, program, scope=None):
+        """Run the sweep, choose per-param ratios within the budget,
+        apply them TOGETHER, then verify the COMBINED metric: isolated
+        sensitivities compound, so while the joint drop exceeds
+        max_drop the largest chosen ratio is backed off one notch and
+        the weights re-pruned from the saved originals (the reference
+        strategy converges the same way — iterative prune/eval).
+        Returns {param: chosen_ratio} (0.0 = untouched)."""
+        scope = scope or core.global_scope()
+        baseline = float(self.eval_fn())
+        sens = self.compute_sensitivities(program, scope)
+        chosen = {}
+        for name, table in sens.items():
+            best = 0.0
+            for r in self.ratios:
+                metric = table[r]
+                drop = (baseline - metric) if self.higher_is_better \
+                    else (metric - baseline)
+                if drop <= self.max_drop:
+                    best = r
+            chosen[name] = best
+        originals = {n: np.asarray(core.as_array(
+            scope.find_var(n))).copy() for n in chosen}
+        levels = (0.0,) + self.ratios
+        while True:
+            for n, arr in originals.items():
+                scope.set_var(n, arr.copy())
+            apply_names = [n for n, r in chosen.items() if r > 0]
+            if apply_names:
+                self.pruner.prune(program, scope, apply_names,
+                                  [chosen[n] for n in apply_names])
+            metric = float(self.eval_fn())
+            drop = (baseline - metric) if self.higher_is_better \
+                else (metric - baseline)
+            if drop <= self.max_drop or not apply_names:
+                return chosen
+            worst = max(apply_names, key=lambda n: chosen[n])
+            chosen[worst] = levels[levels.index(chosen[worst]) - 1]
+
+
 def sensitivity(program, scope, param_name, eval_fn,
                 ratios=(0.1, 0.3, 0.5, 0.7, 0.9),
                 pruner=None):

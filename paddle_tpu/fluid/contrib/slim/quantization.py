@@ -99,3 +99,179 @@ def quantize_program(program, startup_program=None, weight_bits=8,
     return QuantizationTransformPass(
         weight_bits, activation_bits).apply(program, startup_program,
                                             for_test)
+
+
+class PostTrainingQuantization(object):
+    """Post-training quantization: calibrate activation ranges on real
+    batches, then emit a QUANTIZED INFERENCE PROGRAM — no retraining.
+
+    Reference: python/paddle/fluid/contrib/slim/quantization/
+    post_training_quantization.py (PostTrainingQuantization: sample the
+    activations of quantizable ops over a calibration set, compute
+    abs-max/KL scales, rewrite the inference program with the
+    quant/dequant pair and int8 weights).
+
+    TPU-native rendering: weights are channel-wise abs-max
+    quantize-dequantized host-side into `<w>.ptq` scope arrays (the
+    values a dequantized int8 tensor would hold — simulated
+    quantization, the XLA-friendly form: the MXU consumes bf16/f32,
+    so PTQ's value on TPU is the ACCURACY/size contract, not an int8
+    kernel), and each quantizable op's activation input runs through a
+    fake_quantize_dequantize op pinned (is_test) to the CALIBRATED
+    scale held in a `<x>.ptq_scale` scope var.
+
+      ptq = PostTrainingQuantization(exe, infer_prog, feed_names,
+                                     calib_batches, scope=scope)
+      quant_prog = ptq.quantize()        # run/save like any program
+
+    algo: 'abs_max' (max over calibration batches) or 'avg' (mean of
+    per-batch maxes — robust to a single outlier batch)."""
+
+    def __init__(self, executor, program, feed_names, calib_batches,
+                 scope=None, quantizable_op_type=QUANTIZABLE,
+                 weight_bits=8, activation_bits=8, algo='abs_max'):
+        from ... import core
+        self._exe = executor
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._batches = calib_batches
+        self._scope = scope or core.global_scope()
+        self._quantizable = set(quantizable_op_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        if algo not in ('abs_max', 'avg'):
+            raise ValueError("algo must be 'abs_max' or 'avg'")
+        self._algo = algo
+        self.activation_scales = {}
+
+    def _collect_targets(self, block, param_names):
+        """[(op index, act name, weight name or None)] for quantizable
+        ops; act names deduped for one calibration fetch list."""
+        targets = []
+        for idx, op in enumerate(block.ops):
+            if op.type not in self._quantizable:
+                continue
+            aslot = _ACT_SLOTS[op.type]
+            wslot = _WEIGHT_SLOTS[op.type]
+            acts = op.inputs.get(aslot, [])
+            ws = [n for n in op.inputs.get(wslot, [])
+                  if n in param_names]
+            targets.append((idx, acts[0] if acts else None,
+                            ws[0] if ws else None))
+        return targets
+
+    def _calibrate(self, act_names):
+        """abs-max of each activation over the calibration batches.
+        Activations that ARE feeds (the first conv's image input) read
+        their range straight from the batch — a feed is not a fetchable
+        program output."""
+        import numpy as np
+        maxes = {n: [] for n in act_names}
+        fetchable = [n for n in act_names
+                     if n not in self._feed_names]
+        for feed in self._batches:
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=list(fetchable),
+                                 scope=self._scope)
+            for n, v in zip(fetchable, outs):
+                maxes[n].append(float(np.max(np.abs(np.asarray(v)))))
+            for n in act_names:
+                if n in feed:
+                    maxes[n].append(float(np.max(np.abs(
+                        np.asarray(feed[n])))))
+        if self._algo == 'abs_max':
+            return {n: max(v) for n, v in maxes.items() if v}
+        return {n: float(sum(v) / len(v)) for n, v in maxes.items()
+                if v}
+
+    def quantize(self):
+        """Calibrate, then return the quantized inference program (the
+        caller's scope gains the `<w>.ptq` weights and `.ptq_scale`
+        activation scales; save_inference_model on the returned
+        program persists a deployable quantized model)."""
+        import numpy as np
+        from ... import core
+        from ...framework import Operator
+        block = self._program.global_block()
+        param_names = set(p.name for p in block.all_parameters())
+        targets = self._collect_targets(block, param_names)
+        act_names = sorted(set(a for _, a, _ in targets if a))
+        self.activation_scales = self._calibrate(act_names)
+
+        quant = self._program.clone(for_test=True)
+        qblock = quant.global_block()
+        qparams = set(p.name for p in qblock.all_parameters())
+        qtargets = self._collect_targets(qblock, qparams)
+        bnt = (1 << (self._wbits - 1)) - 1
+        new_ops = []
+        done_w = set()
+        done_a = set()
+        by_idx = {t[0]: t for t in qtargets}
+        for idx, op in enumerate(qblock.ops):
+            tgt = by_idx.get(idx)
+            if tgt is not None:
+                _, act, wname = tgt
+                if wname and wname not in done_w:
+                    # channel-wise abs-max int8 simulate-quantize the
+                    # weight host-side into a fresh scope array
+                    arr = np.asarray(core.as_array(
+                        self._scope.find_var(wname))).astype('float32')
+                    axes = tuple(range(1, arr.ndim))
+                    s = np.maximum(np.max(np.abs(arr), axis=axes,
+                                          keepdims=True), 1e-8)
+                    qarr = np.round(np.clip(arr / s, -1, 1) * bnt) \
+                        / bnt * s
+                    self._scope.set_var(wname + '.ptq',
+                                        qarr.astype(arr.dtype))
+                    v = qblock._find_var_recursive(wname)
+                    nv = qblock.create_var(name=wname + '.ptq',
+                                           shape=v.shape,
+                                           dtype=v.dtype,
+                                           persistable=True)
+                    nv.stop_gradient = True
+                    done_w.add(wname)
+                if wname:
+                    wslot = _WEIGHT_SLOTS[op.type]
+                    op.inputs[wslot] = [
+                        wname + '.ptq' if n == wname else n
+                        for n in op.inputs[wslot]]
+                if act and act in self.activation_scales:
+                    qname = act + '.ptq_qd'
+                    if act not in done_a:
+                        sname = act + '.ptq_scale'
+                        self._scope.set_var(
+                            sname, np.asarray(
+                                [self.activation_scales[act]],
+                                'float32'))
+                        sv = qblock.create_var(name=sname, shape=(1,),
+                                               dtype='float32',
+                                               persistable=True)
+                        sv.stop_gradient = True
+                        av = qblock._find_var_recursive(act)
+                        qv = qblock.create_var(
+                            name=qname,
+                            shape=av.shape if av is not None else (),
+                            dtype=av.dtype if av is not None
+                            else 'float32')
+                        qv.stop_gradient = True
+                        new_ops.append(Operator(
+                            qblock,
+                            'fake_quantize_dequantize_moving_average'
+                            '_abs_max',
+                            inputs={'X': [act], 'InScale': [sname]},
+                            outputs={'Out': [qname],
+                                     'OutScale': [sname + '.out']},
+                            attrs={'bit_length': self._abits,
+                                   'is_test': True, '__op_seed__': 0,
+                                   '__op_role__': 'forward'}))
+                        qblock.create_var(name=sname + '.out',
+                                          shape=(1,),
+                                          dtype='float32')
+                        done_a.add(act)
+                    aslot = _ACT_SLOTS[op.type]
+                    op.inputs[aslot] = [qname if n == act else n
+                                        for n in op.inputs[aslot]]
+            new_ops.append(op)
+        qblock.ops = new_ops
+        quant._bump_version()
+        return quant

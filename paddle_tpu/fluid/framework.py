@@ -140,6 +140,17 @@ class Parameter(Variable):
         self.do_model_average = kwargs.get('do_model_average', None)
 
 
+def _op_is_stochastic(op_type):
+    """dropout, or any lowering registered stochastic=True (draws
+    randomness without a declared is_test attr) — clone(for_test)
+    stamps is_test on these so eval is deterministic."""
+    if op_type == 'dropout':
+        return True
+    from ..ops import registry
+    od = registry._REGISTRY.get(op_type)
+    return bool(od is not None and od.stochastic)
+
+
 def grad_var_name(name):
     return name + "@GRAD"
 
@@ -472,7 +483,9 @@ class Program(object):
                 attrs = copy.deepcopy(op.attrs)
                 if for_test and 'is_test' in attrs:
                     attrs['is_test'] = True
-                if for_test and op.type == 'dropout':
+                if for_test and _op_is_stochastic(op.type):
+                    # stochastic lowerings without a declared is_test
+                    # attr: stamp one so eval clones drop the mask
                     attrs['is_test'] = True
                 nop = Operator(nb, op.type, op.inputs, op.outputs, attrs)
                 nb.ops.append(nop)

@@ -31,7 +31,7 @@ def _add_hint(program, var_name, axes):
 
 
 def context_parallel_attention(q, k, v, causal=False, use_flash=False,
-                               axis='sp', name=None):
+                               axis='sp', dropout_rate=0.0, name=None):
     """Multi-head attention whose sequence dim shards over the `axis`
     mesh axis (ring attention: K/V blocks rotate over the ICI ring via
     ppermute while each device streams its Q block's online softmax).
@@ -40,6 +40,11 @@ def context_parallel_attention(q, k, v, causal=False, use_flash=False,
     use_flash: use the Pallas flash kernel as the per-block engine
         (long-context memory profile; falls back off-TPU to interpret
         mode, so tests keep it False).
+    dropout_rate: attention-prob dropout (round 5) — the mask is a
+        counter hash at GLOBAL sequence positions keyed on (op seed,
+        step), so ring-sharded and dense runs draw the same mask and
+        training dropout works under context parallelism; skipped in
+        test-mode programs.
     Returns Out [B, T, H, D].
 
     On a mesh without `axis` (or single-device) the op computes the
@@ -52,7 +57,8 @@ def context_parallel_attention(q, k, v, causal=False, use_flash=False,
                      outputs={'Out': out},
                      attrs={'causal': bool(causal),
                             'use_flash': bool(use_flash),
-                            'axis': axis})
+                            'axis': axis,
+                            'dropout_rate': float(dropout_rate or 0.0)})
     prog = helper.main_program
     for var in (q, k, v, out):
         _add_hint(prog, var.name, ('dp', axis, None, None))

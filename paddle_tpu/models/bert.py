@@ -71,15 +71,6 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None,
                 'use_context_parallel does not support attention '
                 'masks/biases yet: drop the input mask or disable '
                 'context parallelism')
-        if not is_test and getattr(cfg, 'attn_dropout', cfg.dropout):
-            # the ring never materializes the probs, so prob-dropout
-            # cannot be applied — refuse rather than silently train a
-            # different model (same policy as the flash path, which
-            # gates on attn_dropout == 0)
-            raise ValueError(
-                'use_context_parallel cannot apply attention-prob '
-                'dropout (the probs never materialize in the ring); '
-                'set attn_dropout=0 to opt in')
         seq = x.shape[1]
         t_dim = seq if seq and seq > 0 else -1
         q3 = layers.reshape(q, [-1, t_dim, heads, d] if t_dim > 0
@@ -88,10 +79,13 @@ def multi_head_attention(x, attn_bias, cfg, is_test, key_bias=None,
                             else [0, 0, heads, d])
         v3 = layers.reshape(v, [-1, t_dim, heads, d] if t_dim > 0
                             else [0, 0, heads, d])
+        cp_drop = 0.0 if is_test else float(
+            getattr(cfg, 'attn_dropout', cfg.dropout) or 0.0)
         out = layers.context_parallel_attention(
             q3, k3, v3, causal=causal,
             use_flash=getattr(cfg, 'cp_use_flash', False),
-            axis=getattr(cfg, 'cp_axis', 'sp'))
+            axis=getattr(cfg, 'cp_axis', 'sp'),
+            dropout_rate=cp_drop)
         ctx = layers.reshape(out, [0, 0, h])
         return layers.fc(ctx, size=h, num_flatten_dims=2)
 

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from .registry import register
 
 
-@register('fused_multihead_attention')
+@register('fused_multihead_attention', stochastic=True)
 def fused_multihead_attention(ctx, ins, attrs):
     """Q,K,V: [B, T, H, D] (+ optional KeyBias [B, T] additive score
     bias, e.g. a padding mask) -> Out [B, T, H, D] via the Pallas flash
@@ -30,12 +30,8 @@ def fused_multihead_attention(ctx, ins, attrs):
     v = ins['V'][0]
     bias = ins['KeyBias'][0] if ins.get('KeyBias') else None
     rate = float(attrs.get('dropout_rate', 0.0) or 0.0)
-    seed = None
-    if rate and not ctx.prefer_test:
-        seed = (jnp.uint32(ctx.op_seed * 2654435761 % (1 << 32)) ^
-                jnp.asarray(ctx.step, jnp.uint32) *
-                jnp.uint32(0x9E3779B9))
-    else:
+    seed = ctx.dropout_seed(attrs) if rate else None
+    if seed is None:
         rate = 0.0
     return {'Out': [flash_attention(q, k, v,
                                     causal=attrs.get('causal', False),

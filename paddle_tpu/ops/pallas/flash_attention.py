@@ -70,6 +70,42 @@ def _keep_threshold(rate):
     return int(round((1.0 - float(rate)) * (1 << 24)))
 
 
+def _seed_off(seed_ref, idx):
+    """Offset slot of the packed (1,4) seed operand
+    ([seed, q_off, k_off, g_off]) — ring attention shards T (and dp
+    meshes shard B), so local block positions and the per-instance
+    head index must shift to GLOBAL ones for the dropout hash."""
+    return jnp.asarray(seed_ref[0, idx], jnp.int32)
+
+
+def dropout_keep_dense(seed, b, h, tq, tk, q_off=0, k_off=0, g_off=0,
+                       rate=0.0):
+    """[b, h, tq, tk] keep mask at GLOBAL positions — the dense-form
+    twin of the in-kernel draw, shared by the XLA dense dispatch arm
+    and the einsum ring (_block_attend) so every path stays
+    bit-identical to the Pallas kernels."""
+    g = (jax.lax.broadcasted_iota(jnp.int32, (b, h, tq, tk), 0) * h +
+         jax.lax.broadcasted_iota(jnp.int32, (b, h, tq, tk), 1) +
+         jnp.asarray(g_off, jnp.int32))
+    qpos = jnp.asarray(q_off, jnp.int32) +         jax.lax.broadcasted_iota(jnp.int32, (b, h, tq, tk), 2)
+    kpos = jnp.asarray(k_off, jnp.int32) +         jax.lax.broadcasted_iota(jnp.int32, (b, h, tq, tk), 3)
+    return _dropout_keep(jnp.asarray(seed, jnp.uint32), g, qpos, kpos,
+                         _keep_threshold(rate))
+
+
+def _pack_seed(seed, offsets=None, g_off=0):
+    """[seed, q_off, k_off, g_off] uint32 (1,4) operand for the
+    kernels.  g_off shifts the per-instance head index to its GLOBAL
+    value when the batch dim is itself sharded (dp x sp meshes): the
+    kernels see local batch indices, and without the shift two dp
+    shards would draw identical masks for different samples."""
+    qo, ko = offsets if offsets is not None else (0, 0)
+    return jnp.stack([jnp.asarray(seed, jnp.uint32),
+                      jnp.asarray(qo, jnp.uint32),
+                      jnp.asarray(ko, jnp.uint32),
+                      jnp.asarray(g_off, jnp.uint32)]).reshape(1, 4)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
                       block_k, has_bias, rate):
     rest = list(rest)
@@ -119,11 +155,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         # accumulates the UNDROPPED p, only the V-weighting is masked
         l_new = l * corr + jnp.sum(p, axis=1)
         if rate:
-            qpos_d = q_off + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            kpos_d = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            keep = _dropout_keep(seed_ref[0, 0], g_id,
+            qpos_d = q_off + _seed_off(seed_ref, 1) + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos_d = i * block_k + _seed_off(seed_ref, 2) + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            keep = _dropout_keep(seed_ref[0, 0],
+                                 g_id + _seed_off(seed_ref, 3),
                                  qpos_d, kpos_d, _keep_threshold(rate))
             p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
@@ -195,11 +232,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             # softmax vjp with post-softmax dropout u: dS = p*(u*dp -
             # delta); delta = rowsum(dO*O) already sees the dropout
             # because O was computed WITH it
-            qpos_d = q_off + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            kpos_d = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            keep = _dropout_keep(seed_ref[0, 0], g_id,
+            qpos_d = q_off + _seed_off(seed_ref, 1) + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos_d = i * block_k + _seed_off(seed_ref, 2) + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            keep = _dropout_keep(seed_ref[0, 0],
+                                 g_id + _seed_off(seed_ref, 3),
                                  qpos_d, kpos_d, _keep_threshold(rate))
             dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
         dd = dp - delta[:, None]
@@ -265,11 +303,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
         p = jnp.where(jnp.isfinite(s),
                       jnp.exp(s - lse[:, None]), 0.0)
         if rate:
-            qpos_d = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            kpos_d = k_off + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            keep = _dropout_keep(seed_ref[0, 0], g_id,
+            qpos_d = j * block_q + _seed_off(seed_ref, 1) + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            kpos_d = k_off + _seed_off(seed_ref, 2) + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            keep = _dropout_keep(seed_ref[0, 0],
+                                 g_id + _seed_off(seed_ref, 3),
                                  qpos_d, kpos_d, _keep_threshold(rate))
             pu = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
         else:
@@ -369,12 +408,15 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, *rest, scale, causal,
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
             if rate:
-                qpos_d = j * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                kpos_d = i * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                keep = _dropout_keep(seed_ref[0, 0], g_id, qpos_d,
-                                     kpos_d, _keep_threshold(rate))
+                qpos_d = j * block_q + _seed_off(seed_ref, 1) + \
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0)
+                kpos_d = i * block_k + _seed_off(seed_ref, 2) + \
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1)
+                keep = _dropout_keep(
+                    seed_ref[0, 0], g_id + _seed_off(seed_ref, 3),
+                    qpos_d, kpos_d, _keep_threshold(rate))
                 pu = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
                 dp = jnp.where(keep, dp * (1.0 / (1.0 - rate)), 0.0)
             else:
@@ -447,7 +489,7 @@ def _flash_bwd_fused(q, k, v, bias, seed2, do, lse3, delta3, glse3, h,
                                      lambda i: (i // h, 0, 0)))
         operands.append(bias[:, None, :])
     if rate:
-        in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 4), lambda i: (0, 0)))
         operands.append(seed2)
     in_specs += [row, vec, vec]
     operands += [do, lse3, delta3]
@@ -548,8 +590,9 @@ def _block_sizes(t, block_q, block_k, d=64, itemsize=2):
 
 def _flash_fwd(q, k, v, bias, seed, h, causal, block_q, block_k,
                interpret, rate=0.0):
-    """q,k,v: [BH, T, D], bias: [B, T] or None, seed: uint32 scalar
-    (required when rate>0) -> (o [BH,T,D], lse [BH,T])."""
+    """q,k,v: [BH, T, D], bias: [B, T] or None, seed: packed (1,4)
+    uint32 [seed, q_off, k_off, g_off] (_pack_seed, required when
+    rate>0) -> (o [BH,T,D], lse [BH,T])."""
     bh, t, d = q.shape
     block_q, block_k = _block_sizes(t, block_q, block_k, d,
                                     q.dtype.itemsize)
@@ -570,8 +613,8 @@ def _flash_fwd(q, k, v, bias, seed, h, causal, block_q, block_k,
                                      lambda i, j: (i // h, 0, 0)))
         operands.append(bias[:, None, :])
     if rate:
-        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (0, 0)))
-        operands.append(jnp.asarray(seed, jnp.uint32).reshape(1, 1))
+        in_specs.append(pl.BlockSpec((1, 4), lambda i, j: (0, 0)))
+        operands.append(jnp.asarray(seed, jnp.uint32).reshape(1, 4))
     o, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
@@ -603,8 +646,8 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, do, g_lse, h, causal,
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
     glse3 = g_lse.astype(jnp.float32)[:, None, :] if has_glse else None
-    seed2 = jnp.asarray(seed, jnp.uint32).reshape(1, 1) if rate else None
-    seed_spec = pl.BlockSpec((1, 1), lambda i, j: (0, 0))
+    seed2 = jnp.asarray(seed, jnp.uint32).reshape(1, 4) if rate else None
+    seed_spec = pl.BlockSpec((1, 4), lambda i, j: (0, 0))
 
     fq, fk = min(block_q, 512), min(block_k, 512)
     while t % fq:
@@ -788,7 +831,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def _dense_path(q, k, v, causal, key_bias, dropout_rate=0.0,
-                dropout_seed=None):
+                dropout_seed=None, dropout_offsets=None,
+                dropout_g_offset=0):
     """Fused-by-XLA dense chain on [B, T, H, D] (bf16 dots, f32
     softmax) — the measured winner below FLASH_MIN_SEQ, where the
     whole chain fits VMEM outright.  Differentiable via XLA autodiff.
@@ -805,21 +849,20 @@ def _dense_path(q, k, v, causal, key_bias, dropout_rate=0.0,
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_rate:
-        # SAME hash as the kernels (_dropout_keep takes the per-element
-        # head index as an array here, the grid program_id there)
-        g = (jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 0) * h +
-             jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 1))
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 2)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (b, h, t, t), 3)
-        keep = _dropout_keep(jnp.asarray(dropout_seed, jnp.uint32), g,
-                             qpos, kpos, _keep_threshold(dropout_rate))
+        # SAME hash as the kernels (per-element head-index array here,
+        # the grid program_id there)
+        qo, ko = dropout_offsets if dropout_offsets is not None \
+            else (0, 0)
+        keep = dropout_keep_dense(dropout_seed, b, h, t, t, qo, ko,
+                                  dropout_g_offset, dropout_rate)
         p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
     p = p.astype(q.dtype)
     return jnp.einsum('bhts,bshd->bthd', p, v)
 
 
 def flash_attention(q, k, v, causal=False, key_bias=None,
-                    min_seq=None, dropout_rate=0.0, dropout_seed=None):
+                    min_seq=None, dropout_rate=0.0, dropout_seed=None,
+                    dropout_offsets=None, dropout_g_offset=0):
     """q,k,v: [B, T, H, D]; key_bias: optional [B, T] additive score
     bias (e.g. padding mask as 0 / -10000) -> [B, T, H, D].
 
@@ -844,21 +887,24 @@ def flash_attention(q, k, v, causal=False, key_bias=None,
         raise ValueError('dropout_rate > 0 needs a dropout_seed')
     if t < min_seq:
         return _dense_path(q, k, v, causal, key_bias, rate,
-                           dropout_seed)
+                           dropout_seed, dropout_offsets,
+                           dropout_g_offset)
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
     if key_bias is not None:
         key_bias = key_bias.astype(jnp.float32)
-    seed = jnp.asarray(dropout_seed, jnp.uint32) if rate else None
+    seed = _pack_seed(dropout_seed, dropout_offsets,
+                      dropout_g_offset) if rate else None
     out = _flash(to_bh(q), to_bh(k), to_bh(v), key_bias, seed, h,
                  causal, rate)
     return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
 
 
 def flash_attention_with_lse(q, k, v, causal=False, key_bias=None,
-                             dropout_rate=0.0, dropout_seed=None):
+                             dropout_rate=0.0, dropout_seed=None,
+                             dropout_offsets=None, dropout_g_offset=0):
     """Like flash_attention but also returns the per-row log-sum-exp
     [B, H, T] — the merge state for blockwise/ring composition.  Both
     outputs are differentiable (the lse cotangent folds into dS inside
@@ -875,7 +921,8 @@ def flash_attention_with_lse(q, k, v, causal=False, key_bias=None,
 
     if key_bias is not None:
         key_bias = key_bias.astype(jnp.float32)
-    seed = jnp.asarray(dropout_seed, jnp.uint32) if rate else None
+    seed = _pack_seed(dropout_seed, dropout_offsets,
+                      dropout_g_offset) if rate else None
     o, lse = _flash_lse(to_bh(q), to_bh(k), to_bh(v), key_bias, seed,
                         h, causal, rate)
     o = jnp.transpose(o.reshape(b, h, t, d), (0, 2, 1, 3))

@@ -55,7 +55,7 @@ def _token_axes(mesh, dims, prefer):
     return P(*spec), used
 
 
-@register('ring_attention')
+@register('ring_attention', stochastic=True)
 def ring_attention_op(ctx, ins, attrs):
     """Q,K,V: [B, T, H, D] -> Out [B, T, H, D].
 
@@ -65,6 +65,12 @@ def ring_attention_op(ctx, ins, attrs):
         (long-context memory profile) instead of the online-softmax
         einsum ring.
       axis (str): mesh axis carrying the sequence shards ('sp').
+      dropout_rate (float): attention-prob dropout (round 5).  The
+        mask is the flash kernels' counter hash at GLOBAL positions
+        (ring shards shift by their k/q offsets), keyed on the op seed
+        and step — the ring-sharded and dense-fallback runs draw the
+        SAME mask, and the probs still never materialize under flash.
+        Skipped in test-mode lowering.
 
     Under a trace mesh whose `axis` has size > 1, the sequence dim is
     sharded over it and K/V blocks rotate via ppermute
@@ -81,6 +87,10 @@ def ring_attention_op(ctx, ins, attrs):
     causal = bool(attrs.get('causal', False))
     use_flash = bool(attrs.get('use_flash', False))
     axis = attrs.get('axis', 'sp')
+    rate = float(attrs.get('dropout_rate', 0.0) or 0.0)
+    seed = ctx.dropout_seed(attrs) if rate else None
+    if seed is None:
+        rate = 0.0
 
     mesh = pmesh.trace_mesh()
     sp = pmesh.axis_size(mesh, axis)
@@ -92,6 +102,24 @@ def ring_attention_op(ctx, ins, attrs):
         spec = P(*spec)
         inner = ring_flash_attention_inner if use_flash \
             else ring_attention_inner
+        if rate:
+            batch_sharded = spec[0] == 'dp'
+
+            def wrapped(q_, k_, v_, seed_):
+                # batch sharded over 'dp': shift the head index to its
+                # GLOBAL value or every dp shard draws the same mask
+                g_off = jax.lax.axis_index('dp') * q_.shape[0] * \
+                    q_.shape[2] if batch_sharded else 0
+                return inner(q_, k_, v_, axis_name=axis,
+                             causal=causal, dropout_rate=rate,
+                             dropout_seed=seed_,
+                             dropout_g_offset=g_off)
+
+            f = jax.shard_map(
+                wrapped, mesh=mesh,
+                in_specs=(spec, spec, spec, P()), out_specs=spec,
+                check_vma=False)
+            return {'Out': [f(q, k, v, seed)]}
         f = jax.shard_map(
             functools.partial(inner, axis_name=axis, causal=causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -99,7 +127,15 @@ def ring_attention_op(ctx, ins, attrs):
         return {'Out': [f(q, k, v)]}
     if use_flash:
         from .pallas.flash_attention import flash_attention
-        return {'Out': [flash_attention(q, k, v, causal=causal)]}
+        return {'Out': [flash_attention(q, k, v, causal=causal,
+                                        dropout_rate=rate,
+                                        dropout_seed=seed)]}
+    if rate:
+        # dense fallback with the SAME global-position hash mask the
+        # ring draws (flash _dense_path implements it)
+        from .pallas.flash_attention import _dense_path
+        return {'Out': [_dense_path(q, k, v, causal, None, rate,
+                                    seed)]}
     return {'Out': [reference_attention(q, k, v, causal=causal)]}
 
 

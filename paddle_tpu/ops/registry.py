@@ -39,19 +39,35 @@ class LowerCtx(object):
         key = jax.random.PRNGKey(self.op_seed + 7919 * salt)
         return jax.random.fold_in(key, self.step)
 
+    def dropout_seed(self, attrs):
+        """uint32 counter-hash seed for in-kernel dropout, or None in
+        eval mode (prefer_test lowering or a clone-stamped is_test
+        attr).  Shared by every stochastic attention lowering so the
+        (op_seed, step) keying never diverges between them."""
+        if self.prefer_test or attrs.get("is_test"):
+            return None
+        return (jnp.uint32(self.op_seed * 2654435761 % (1 << 32)) ^
+                jnp.asarray(self.step, jnp.uint32) *
+                jnp.uint32(0x9E3779B9))
+
 
 class OpDef(object):
     __slots__ = ("type", "fn", "in_slots", "out_slots", "no_grad_out_slots",
-                 "host_only")
+                 "host_only", "stochastic")
 
     def __init__(self, type, fn, in_slots=None, out_slots=None,
-                 no_grad_out_slots=(), host_only=False):
+                 no_grad_out_slots=(), host_only=False,
+                 stochastic=False):
         self.type = type
         self.fn = fn
         self.in_slots = in_slots
         self.out_slots = out_slots
         self.no_grad_out_slots = tuple(no_grad_out_slots)
         self.host_only = host_only
+        # draws randomness without a declared is_test attr: clone
+        # (for_test=True) stamps is_test on these so eval is
+        # deterministic (framework.Program.clone)
+        self.stochastic = stochastic
 
     def run(self, ctx, ins, attrs):
         """Invoke the lowering with AMP gray/black dtype harmonization
@@ -103,12 +119,14 @@ _REGISTRY = {}
 HOST_OPS = set()
 
 
-def register(type, in_slots=None, out_slots=None, no_grad_out_slots=()):
+def register(type, in_slots=None, out_slots=None, no_grad_out_slots=(),
+             stochastic=False):
     """Decorator: register `fn(ctx, ins, attrs) -> outs` as op `type`."""
 
     def deco(fn):
         _REGISTRY[type] = OpDef(type, fn, in_slots, out_slots,
-                                no_grad_out_slots)
+                                no_grad_out_slots,
+                                stochastic=stochastic)
         return fn
 
     return deco

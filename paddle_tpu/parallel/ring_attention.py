@@ -18,13 +18,20 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def _block_attend(q, k, v, m, l, acc, q_off, k_off, scale, causal):
+def _block_attend(q, k, v, m, l, acc, q_off, k_off, scale, causal,
+                  dropout_rate=0.0, dropout_seed=None,
+                  dropout_g_offset=0):
     """One K/V block of online-softmax attention.
-    q [B,Tq,H,D], k/v [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D]."""
+    q [B,Tq,H,D], k/v [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D].
+    Dropout (post-softmax, reference semantics) draws the SAME counter
+    hash as the flash kernels at GLOBAL (q_off/k_off-shifted)
+    positions, so ring-sharded and dense runs are bit-identical
+    stochastic functions of the seed; the normalizer l accumulates the
+    undropped probs, so the lse merge stays exact."""
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                    preferred_element_type=jnp.float32) * scale
+    tq, tk = q.shape[1], k.shape[1]
     if causal:
-        tq, tk = q.shape[1], k.shape[1]
         qpos = q_off + jnp.arange(tq)
         kpos = k_off + jnp.arange(tk)
         mask = qpos[:, None] >= kpos[None, :]
@@ -36,13 +43,22 @@ def _block_attend(q, k, v, m, l, acc, q_off, k_off, scale, causal):
     p = jnp.where(jnp.isfinite(s), p, 0.0)
     corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
     l_new = l * corr + jnp.sum(p, axis=-1)
+    if dropout_rate:
+        from ..ops.pallas.flash_attention import dropout_keep_dense
+        b, h = q.shape[0], q.shape[2]
+        keep = dropout_keep_dense(dropout_seed, b, h, tq, tk, q_off,
+                                  k_off, dropout_g_offset,
+                                  dropout_rate)
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
     pv = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
     acc_new = acc * jnp.transpose(corr, (0, 2, 1))[..., None] + pv
     return m_new, l_new, acc_new
 
 
-def ring_attention_inner(q, k, v, axis_name, causal=False):
+def ring_attention_inner(q, k, v, axis_name, causal=False,
+                         dropout_rate=0.0, dropout_seed=None,
+                         dropout_g_offset=0):
     """Call INSIDE shard_map with q,k,v sequence-sharded [B,T_loc,H,D]."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -58,7 +74,9 @@ def ring_attention_inner(q, k, v, axis_name, causal=False):
         m, l, acc, kk, vv = carry
         kv_idx = (idx - i) % n
         m, l, acc = _block_attend(q, kk, vv, m, l, acc,
-                                  idx * tq, kv_idx * tq, scale, causal)
+                                  idx * tq, kv_idx * tq, scale, causal,
+                                  dropout_rate, dropout_seed,
+                                  dropout_g_offset)
         kk = jax.lax.ppermute(kk, axis_name, perm)
         vv = jax.lax.ppermute(vv, axis_name, perm)
         return m, l, acc, kk, vv
@@ -81,7 +99,9 @@ def ring_attention(q, k, v, mesh, axis='sp', causal=False):
     return f(q, k, v)
 
 
-def ring_flash_attention_inner(q, k, v, axis_name, causal=False):
+def ring_flash_attention_inner(q, k, v, axis_name, causal=False,
+                               dropout_rate=0.0, dropout_seed=None,
+                               dropout_g_offset=0):
     """Ring attention with the Pallas FLASH kernel as the per-block
     engine: each hop runs blockwise flash attention over the resident
     K/V shard (no [T_loc, T_loc] scores in HBM — the long-context
@@ -105,13 +125,23 @@ def ring_flash_attention_inner(q, k, v, axis_name, causal=False):
     o0 = jnp.zeros((b, tq, h, d), jnp.float32)
     l0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
 
-    def full_block(kk, vv):
-        return flash_attention_with_lse(q, kk, vv, causal=False)
+    def _drop_kw(k_off):
+        if not dropout_rate:
+            return {}
+        return {'dropout_rate': dropout_rate,
+                'dropout_seed': dropout_seed,
+                'dropout_offsets': (idx * tq, k_off),
+                'dropout_g_offset': dropout_g_offset}
 
-    def diag_block(kk, vv):
-        return flash_attention_with_lse(q, kk, vv, causal=True)
+    def full_block(kk, vv, k_off):
+        return flash_attention_with_lse(q, kk, vv, causal=False,
+                                        **_drop_kw(k_off))
 
-    def skip_block(kk, vv):
+    def diag_block(kk, vv, k_off):
+        return flash_attention_with_lse(q, kk, vv, causal=True,
+                                        **_drop_kw(k_off))
+
+    def skip_block(kk, vv, k_off):
         return (jnp.zeros((b, tq, h, d), q.dtype),
                 jnp.full((b, h, tq), -jnp.inf, jnp.float32))
 
@@ -124,9 +154,10 @@ def ring_flash_attention_inner(q, k, v, axis_name, causal=False):
             case = jnp.where(kv_idx > idx, 2,
                              jnp.where(kv_idx == idx, 1, 0))
             o_blk, lse_blk = jax.lax.switch(
-                case, [full_block, diag_block, skip_block], kk, vv)
+                case, [full_block, diag_block, skip_block], kk, vv,
+                kv_idx * tq)
         else:
-            o_blk, lse_blk = full_block(kk, vv)
+            o_blk, lse_blk = full_block(kk, vv, kv_idx * tq)
         o_blk = o_blk.astype(jnp.float32)
         lse_new = jnp.logaddexp(lse, lse_blk)
         # guard rows no block has touched yet (-inf - -inf = nan)

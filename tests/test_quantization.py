@@ -62,3 +62,129 @@ def test_qat_rewrite_trains():
                          fetch_list=[loss])
             losses.append(float(l))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def _train_lenet_blobs(seed=0, steps=40):
+    """Tiny conv net on separable 2-class 8x8 'images'; returns
+    (inference program, feed name, logits name, scope, eval batches,
+    accuracy fn)."""
+    import paddle_tpu.fluid as fluid
+    layers = fluid.layers
+
+    rng = np.random.RandomState(seed)
+
+    def make_batch(n=64):
+        y = rng.randint(0, 2, n)
+        x = rng.randn(n, 1, 8, 8).astype('float32') * 0.5
+        x[y == 1, :, 2:6, 2:6] += 1.5   # class-1 blob in the center
+        return x, y.astype('int64').reshape(-1, 1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = layers.data('img', shape=[1, 8, 8], dtype='float32')
+        lab = layers.data('lab', shape=[1], dtype='int64')
+        c = layers.conv2d(img, num_filters=4, filter_size=3,
+                          padding=1, act='relu')
+        p = layers.pool2d(c, pool_size=2, pool_stride=2)
+        f = layers.fc(p, size=16, act='relu')
+        logits = layers.fc(f, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, lab))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(steps):
+            xb, yb = make_batch()
+            exe.run(main, feed={'img': xb, 'lab': yb}, fetch_list=[])
+
+    eval_batches = [make_batch(128) for _ in range(3)]
+
+    def accuracy(program, sc):
+        good = tot = 0
+        with fluid.scope_guard(sc):
+            exe2 = fluid.Executor(fluid.XLAPlace(0))
+            for xb, yb in eval_batches:
+                out, = exe2.run(program, feed={'img': xb},
+                                fetch_list=[logits.name])
+                good += (np.argmax(np.asarray(out), 1) ==
+                         yb.ravel()).sum()
+                tot += len(yb)
+        return good / tot
+
+    infer = main.clone(for_test=True)
+    infer = fluid.io._prune_for_inference(infer, ['img'],
+                                          [logits.name]) \
+        if hasattr(fluid.io, '_prune_for_inference') else infer
+    return infer, 'img', logits.name, scope, eval_batches, accuracy
+
+
+def test_post_training_quantization_accuracy_budget():
+    """VERDICT r4 #8: PTQ — calibrate activation ranges on real
+    batches, emit a quantized inference program, accuracy within a
+    stated budget (here: <= 3 points of the fp32 baseline on a
+    comfortably-separable task)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.contrib.slim.quantization import \
+        PostTrainingQuantization
+
+    infer, feed_name, out_name, scope, eval_batches, accuracy = \
+        _train_lenet_blobs()
+    base_acc = accuracy(infer, scope)
+    assert base_acc > 0.9, base_acc   # the task is easy by design
+
+    calib = [{feed_name: xb} for xb, _ in eval_batches[:2]]
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        ptq = PostTrainingQuantization(exe, infer, [feed_name], calib,
+                                       scope=scope)
+        quant = ptq.quantize()
+
+    # the quantized program carries the static-scale quant-dequant ops
+    types = [op.type for op in quant.global_block().ops]
+    assert 'fake_quantize_dequantize_moving_average_abs_max' in types
+    assert ptq.activation_scales, 'calibration collected no scales'
+    # weights are 8-bit grids: <= 255 distinct values per channel
+    for op in quant.global_block().ops:
+        for n in op.input_arg_names:
+            if n.endswith('.ptq'):
+                arr = np.asarray(scope.find_var(n))
+                ch0 = arr.reshape(arr.shape[0], -1)[0]
+                assert len(np.unique(ch0)) <= 255
+    q_acc = accuracy(quant, scope)
+    assert q_acc >= base_acc - 0.03, (base_acc, q_acc)
+    # determinism: the pinned scales make eval repeatable
+    assert accuracy(quant, scope) == q_acc
+
+
+def test_sensitive_prune_strategy_respects_budget():
+    """VERDICT r4 #8: magnitude pruning driven by a sensitivity scan —
+    per-param ratios chosen so the eval metric stays within max_drop."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.contrib.slim.prune import \
+        SensitivePruneStrategy
+
+    infer, feed_name, out_name, scope, eval_batches, accuracy = \
+        _train_lenet_blobs(seed=3)
+    base_acc = accuracy(infer, scope)
+    assert base_acc > 0.9, base_acc
+
+    strat = SensitivePruneStrategy(
+        eval_fn=lambda: accuracy(infer, scope), max_drop=0.02,
+        params=[p.name for p in infer.all_parameters()
+                if len(p.shape) > 1])   # weights only, skip biases
+    chosen = strat.prune(infer, scope)
+    assert chosen and any(r > 0 for r in chosen.values()), chosen
+    final_acc = accuracy(infer, scope)
+    assert final_acc >= base_acc - 0.02 - 1e-9, (base_acc, final_acc,
+                                                 chosen)
+    # pruning really zeroed weights at the chosen ratios
+    for name, r in chosen.items():
+        if r > 0:
+            arr = np.asarray(scope.find_var(name))
+            frac0 = float((arr == 0).mean())
+            assert frac0 >= r * 0.9, (name, r, frac0)

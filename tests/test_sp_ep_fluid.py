@@ -263,3 +263,83 @@ def test_3d_dp_pp_mp_through_fluid_program():
     loss2, _ = step(params, x_np, y_np)
     np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-4)
     assert float(loss2) < float(loss)
+
+
+def _build_attn_dropout(seed=9, rate=0.3, use_flash=False):
+    """Attention-only program with IN-RING attention-prob dropout
+    (round 5): mask drawn at GLOBAL positions so sharded and dense
+    paths agree bit-for-bit."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[T, DIM], dtype='float32')
+        y = layers.data('y', shape=[T, DIM], dtype='float32')
+        qkv = layers.fc(x, size=3 * DIM, num_flatten_dims=2,
+                        bias_attr=False)
+        q, k, v = layers.split(qkv, 3, dim=-1)
+        q = layers.reshape(q, [-1, T, H, D])
+        k = layers.reshape(k, [-1, T, H, D])
+        v = layers.reshape(v, [-1, T, H, D])
+        att = layers.context_parallel_attention(
+            q, k, v, causal=True, use_flash=use_flash,
+            dropout_rate=rate)
+        att = layers.reshape(att, [-1, T, DIM])
+        loss = layers.reduce_mean(
+            layers.square(layers.elementwise_sub(att, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_ring_attention_dropout_sharded_matches_dense():
+    """Round 5: attention-prob dropout under context parallelism —
+    the global-position counter-hash mask makes the sp-sharded ring
+    and the single-device dense fallback IDENTICAL stochastic
+    functions; training losses must match across the mesh boundary."""
+    rng = np.random.RandomState(3)
+    feed = {'x': rng.randn(B, T, DIM).astype('float32'),
+            'y': rng.randn(B, T, DIM).astype('float32')}
+    main, startup, loss = _build_attn_dropout()
+    single = _run_losses(main, startup, loss, feed, 4)
+
+    mesh = pmesh.create_mesh(dp=2, sp=4)
+    main2, startup2, loss2 = _build_attn_dropout()
+    comp = fluid.CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name).with_mesh(mesh)
+    sharded = _run_losses(main2, startup2, loss2, feed, 4,
+                          compiled=comp)
+    np.testing.assert_allclose(sharded, single, rtol=5e-3, atol=5e-4)
+
+
+def test_ring_flash_attention_dropout_sharded_matches_dense():
+    """Same contract with the Pallas flash per-block engine (interpret
+    mode on CPU): dropout offsets ride the packed seed operand into
+    the kernels."""
+    rng = np.random.RandomState(4)
+    feed = {'x': rng.randn(B, T, DIM).astype('float32'),
+            'y': rng.randn(B, T, DIM).astype('float32')}
+    main, startup, loss = _build_attn_dropout(use_flash=True)
+    single = _run_losses(main, startup, loss, feed, 3)
+
+    mesh = pmesh.create_mesh(sp=2)
+    main2, startup2, loss2 = _build_attn_dropout(use_flash=True)
+    comp = fluid.CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name).with_mesh(mesh)
+    sharded = _run_losses(main2, startup2, loss2, feed, 3,
+                          compiled=comp)
+    np.testing.assert_allclose(sharded, single, rtol=5e-3, atol=5e-4)
+
+
+def test_cp_attention_dropout_eval_clone_is_deterministic():
+    """for_test clones drop the stochastic mask (prefer_test lowering
+    skips dropout): two eval runs produce identical losses."""
+    main, startup, loss = _build_attn_dropout(rate=0.5)
+    test_prog = main.clone(for_test=True)
+    rng = np.random.RandomState(5)
+    feed = {'x': rng.randn(B, T, DIM).astype('float32'),
+            'y': rng.randn(B, T, DIM).astype('float32')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        a, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        b, = exe.run(test_prog, feed=feed, fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
