@@ -66,8 +66,11 @@ def context_parallel_attention(q, k, v, causal=False, use_flash=False,
 
 
 def moe(x, num_experts, hidden_size, capacity_factor=2.0,
-        aux_weight=0.01, axis='ep', param_attr=None, name=None):
-    """GShard-style top-1 Mixture-of-Experts FFN layer.
+        aux_weight=0.01, axis='ep', top_k=1, param_attr=None,
+        name=None):
+    """GShard-style Mixture-of-Experts FFN layer (top_k=1 Switch
+    routing; top_k=2 adds GShard second-choice routing with
+    renormalized gates and drop-second-first capacity overflow).
 
     x: [B, T, D].  Creates gate [D, E] and per-expert FFN weights
     W1 [E, D, hidden_size], W2 [E, hidden_size, D]; under a mesh with
@@ -78,6 +81,9 @@ def moe(x, num_experts, hidden_size, capacity_factor=2.0,
     scaled by aux_weight) to the training loss — the Switch
     load-balance term that keeps routing spread across experts.
     """
+    if int(top_k) not in (1, 2):
+        raise ValueError('moe: top_k must be 1 (Switch) or 2 (GShard), '
+                         'got %r' % (top_k,))
     helper = LayerHelper(name or 'moe', param_attr=param_attr)
     d = int(x.shape[-1])
     e, h = int(num_experts), int(hidden_size)
@@ -96,7 +102,8 @@ def moe(x, num_experts, hidden_size, capacity_factor=2.0,
                      inputs={'X': x, 'Gate': wg, 'W1': w1, 'W2': w2},
                      outputs={'Out': out, 'AuxLoss': aux},
                      attrs={'axis': axis,
-                            'capacity_factor': float(capacity_factor)})
+                            'capacity_factor': float(capacity_factor),
+                            'top_k': int(top_k)})
     prog = helper.main_program
     _add_hint(prog, w1.name, (axis, None, None))
     _add_hint(prog, w2.name, (axis, None, None))

@@ -24,7 +24,8 @@ class GptConfig(object):
                  heads=12, intermediate=None, max_pos=1024,
                  dropout=0.1, attn_dropout=None, use_flash=True,
                  moe_experts=0, moe_hidden=None, moe_aux_weight=0.01,
-                 moe_capacity_factor=2.0, use_context_parallel=False):
+                 moe_capacity_factor=2.0, moe_top_k=1,
+                 use_context_parallel=False):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -43,6 +44,7 @@ class GptConfig(object):
         self.moe_hidden = moe_hidden or self.intermediate
         self.moe_aux_weight = moe_aux_weight
         self.moe_capacity_factor = moe_capacity_factor
+        self.moe_top_k = moe_top_k
         # route attention through layers.context_parallel_attention
         # (ring attention over the 'sp' axis on a mesh; dense fallback
         # on one device)
@@ -68,7 +70,8 @@ def decoder_block(x, cfg, is_test, aux_losses=None):
         m, aux = layers.moe(m, num_experts=cfg.moe_experts,
                             hidden_size=cfg.moe_hidden,
                             capacity_factor=cfg.moe_capacity_factor,
-                            aux_weight=cfg.moe_aux_weight)
+                            aux_weight=cfg.moe_aux_weight,
+                            top_k=cfg.moe_top_k)
         if aux_losses is not None:
             aux_losses.append(aux)
     else:

@@ -147,6 +147,8 @@ def moe_ffn_op(ctx, ins, attrs):
     Outs: Out [B, T, D], AuxLoss [] (Switch load-balance loss — add it
     to the training objective scaled by attrs['aux_weight'] upstream).
 
+    attrs['top_k'] (1=Switch, 2=GShard second-choice routing with
+    renormalized gates and drop-second-first overflow — round 5).
     Under a trace mesh with an 'ep' axis (attrs['axis']), experts shard
     over 'ep' (leading dim of W1/W2) and tokens route via all_to_all
     (parallel/moe.py); tokens additionally shard over dp/sp/ep when
@@ -164,6 +166,7 @@ def moe_ffn_op(ctx, ins, attrs):
     w1, w2 = ins['W1'][0], ins['W2'][0]
     axis = attrs.get('axis', 'ep')
     cf = float(attrs.get('capacity_factor', 2.0))
+    top_k = int(attrs.get('top_k', 1))
 
     mesh = pmesh.trace_mesh()
     ep = pmesh.axis_size(mesh, axis)
@@ -179,7 +182,8 @@ def moe_ffn_op(ctx, ins, attrs):
 
         def inner(xl, wg_, w1_, w2_):
             out, aux = moe_ffn_inner(
-                xl.reshape(b_loc * t_loc, d), wg_, w1_, w2_, axis, cf)
+                xl.reshape(b_loc * t_loc, d), wg_, w1_, w2_, axis, cf,
+                top_k)
             # aux is computed from this shard's tokens; average over
             # every axis the tokens are split (or replicated) across
             for ax in mesh.axis_names:
@@ -192,5 +196,6 @@ def moe_ffn_op(ctx, ins, attrs):
             out_specs=(xspec, P()), check_vma=False)
         out, aux = f(x, wg, w1, w2)
         return {'Out': [out], 'AuxLoss': [aux]}
-    out, aux = reference_moe_ffn(x, wg, w1, w2, capacity_factor=cf)
+    out, aux = reference_moe_ffn(x, wg, w1, w2, capacity_factor=cf,
+                                 top_k=top_k)
     return {'Out': [out], 'AuxLoss': [jnp.asarray(aux, jnp.float32)]}

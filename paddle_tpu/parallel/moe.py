@@ -18,33 +18,71 @@ from jax.sharding import PartitionSpec as P
 
 
 def top1_gating(x, wg, n_experts, capacity):
-    """Top-1 gating (Switch-style) producing dense dispatch/combine maps.
+    """Top-1 gating (Switch-style) producing dense dispatch/combine
+    maps; see topk_gating."""
+    return topk_gating(x, wg, n_experts, capacity, top_k=1)
+
+
+def topk_gating(x, wg, n_experts, capacity, top_k=1):
+    """Top-k gating (k=1 Switch, k=2 GShard) producing dense
+    dispatch/combine maps.
 
     x: [S, D] local tokens.  wg: [D, E].  Returns
       dispatch [S, E, C] one-hot, combine [S, E, C] gate-weighted,
-      aux_loss (load-balance loss, Switch eq. 4).
-    """
+      aux_loss (load-balance loss).
+
+    k=2 (the GShard design): each token also routes to its
+    second-choice expert with the gates RENORMALIZED over the two
+    choices; second-choice tokens queue BEHIND every first-choice
+    token of that expert, so under capacity pressure the overflow
+    drops second choices first — the GShard overflow policy.  The aux
+    loss stays the Switch/GShard form over FIRST-choice density."""
+    if top_k not in (1, 2):
+        raise ValueError('topk_gating supports top_k in (1, 2)')
     logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)                 # [S, E]
-    expert = jnp.argmax(probs, axis=-1)                     # [S]
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
-    # position of each token within its expert's queue
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot       # [S, E]
-    pos_in_expert = jnp.sum(pos, axis=-1)                   # [S]
-    keep = pos_in_expert < capacity
-    gate = jnp.max(probs * onehot, axis=-1) * keep          # [S]
-    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
-                            dtype=jnp.float32)
-    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
-    combine = dispatch * gate[:, None, None]
+    e1 = jnp.argmax(probs, axis=-1)                         # [S]
+    oh1 = jax.nn.one_hot(e1, n_experts, dtype=jnp.float32)
+    # position of each token within its expert's first-choice queue
+    pos1 = jnp.sum((jnp.cumsum(oh1, axis=0) - 1.0) * oh1, axis=-1)
+    keep1 = pos1 < capacity
+    g1 = jnp.max(probs * oh1, axis=-1)
     # load-balance aux loss: E * sum_e fraction_e * mean_prob_e
-    density = jnp.mean(onehot, axis=0)
+    density = jnp.mean(oh1, axis=0)
     density_proxy = jnp.mean(probs, axis=0)
     aux = jnp.sum(density * density_proxy) * n_experts
-    return dispatch, combine, aux
+
+    def maps(onehot, pos_in_expert, keep, gate):
+        pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32),
+                                capacity, dtype=jnp.float32)
+        dispatch = onehot[:, :, None] * pos_oh[:, None, :] * \
+            keep[:, None, None]
+        return dispatch, dispatch * gate[:, None, None]
+
+    if top_k == 1:
+        dispatch, combine = maps(oh1, pos1, keep1, g1 * keep1)
+        return dispatch, combine, aux
+
+    probs2 = probs * (1.0 - oh1)                            # mask 1st
+    e2 = jnp.argmax(probs2, axis=-1)
+    oh2 = jax.nn.one_hot(e2, n_experts, dtype=jnp.float32)
+    g2 = jnp.max(probs2 * oh2, axis=-1)
+    # renormalize the pair (GShard): each kept route carries its share
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1n, g2n = g1 / denom, g2 / denom
+    # second-choice positions start after ALL first-choice tokens of
+    # that expert
+    first_counts = jnp.sum(oh1, axis=0)                     # [E]
+    pos2 = jnp.sum((jnp.cumsum(oh2, axis=0) - 1.0) * oh2, axis=-1) + \
+        jnp.sum(oh2 * first_counts[None, :], axis=-1)
+    keep2 = pos2 < capacity
+    d1, c1 = maps(oh1, pos1, keep1, g1n * keep1)
+    d2, c2 = maps(oh2, pos2, keep2, g2n * keep2)
+    return d1 + d2, c1 + c2, aux
 
 
-def moe_ffn_inner(x, wg, w1, w2, axis_name, capacity_factor=2.0):
+def moe_ffn_inner(x, wg, w1, w2, axis_name, capacity_factor=2.0,
+                  top_k=1):
     """Call INSIDE shard_map.  Expert-parallel MoE FFN.
 
     x:  [S, D] tokens local to this shard (any sharding of the batch).
@@ -57,9 +95,12 @@ def moe_ffn_inner(x, wg, w1, w2, axis_name, capacity_factor=2.0):
     e_loc = w1.shape[0]
     n_experts = n_shards * e_loc
     s, d = x.shape
-    capacity = max(1, int(capacity_factor * s / n_experts))
+    # GShard capacity: C = k * cf * S / E — each of a token's k routes
+    # needs a slot, so per-expert headroom scales with top_k
+    capacity = max(1, int(top_k * capacity_factor * s / n_experts))
 
-    dispatch, combine, aux = top1_gating(x, wg, n_experts, capacity)
+    dispatch, combine, aux = topk_gating(x, wg, n_experts, capacity,
+                                         top_k)
     # gather expert inputs: [E, C, D]
     expert_in = jnp.einsum('sec,sd->ecd', dispatch, x.astype(jnp.float32))
     # scatter expert dim over shards, concat token dim:
@@ -78,7 +119,8 @@ def moe_ffn_inner(x, wg, w1, w2, axis_name, capacity_factor=2.0):
     return out.astype(x.dtype), aux
 
 
-def moe_ffn(x, wg, w1, w2, mesh, axis='ep', capacity_factor=2.0):
+def moe_ffn(x, wg, w1, w2, mesh, axis='ep', capacity_factor=2.0,
+            top_k=1):
     """Global-array wrapper.  x [B, T, D] with the batch sharded over
     `axis` (the canonical GShard layout: the expert axis doubles as a
     data axis for tokens); experts sharded on `axis` via the leading dim
@@ -88,7 +130,7 @@ def moe_ffn(x, wg, w1, w2, mesh, axis='ep', capacity_factor=2.0):
 
     def inner(xf, wg_, w1_, w2_):
         out, aux = moe_ffn_inner(xf.reshape(b_loc * t, d), wg_, w1_, w2_,
-                                 axis, capacity_factor)
+                                 axis, capacity_factor, top_k)
         return out.reshape(b_loc, t, d), jax.lax.pmean(aux, axis)
 
     f = jax.shard_map(
@@ -98,7 +140,8 @@ def moe_ffn(x, wg, w1, w2, mesh, axis='ep', capacity_factor=2.0):
     return f(x, wg, w1, w2)
 
 
-def reference_moe_ffn(x, wg, w1_full, w2_full, capacity_factor=2.0):
+def reference_moe_ffn(x, wg, w1_full, w2_full, capacity_factor=2.0,
+                      top_k=1):
     """Dense single-device reference: w1_full [E, D, H], w2_full
     [E, H, D].  Capacity is computed from x's own token count, so to
     reproduce the sharded version's per-shard capacity semantics, call
@@ -106,8 +149,9 @@ def reference_moe_ffn(x, wg, w1_full, w2_full, capacity_factor=2.0):
     b, t, d = x.shape
     s = b * t
     e = w1_full.shape[0]
-    capacity = max(1, int(capacity_factor * s / e))
-    dispatch, combine, aux = top1_gating(x.reshape(s, d), wg, e, capacity)
+    capacity = max(1, int(top_k * capacity_factor * s / e))
+    dispatch, combine, aux = topk_gating(x.reshape(s, d), wg, e,
+                                         capacity, top_k)
     expert_in = jnp.einsum('sec,sd->ecd', dispatch,
                            x.reshape(s, d).astype(jnp.float32))
     h = jax.nn.relu(jnp.einsum('ecd,edh->ech', expert_in, w1_full))

@@ -343,3 +343,68 @@ def test_cp_attention_dropout_eval_clone_is_deterministic():
         a, = exe.run(test_prog, feed=feed, fetch_list=[loss])
         b, = exe.run(test_prog, feed=feed, fetch_list=[loss])
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_moe_top2_gating_properties():
+    """GShard top-2 (round 5): combine weights of an uncapped token
+    sum to 1 over its two routes (renormalized pair); under capacity
+    pressure second choices drop FIRST; top_k=1 path unchanged."""
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.moe import topk_gating
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 6).astype('float32'))
+    wg = jnp.asarray(rng.randn(6, 4).astype('float32'))
+
+    # generous capacity: nothing drops; each token's combine mass == 1
+    d, c, aux = topk_gating(x, wg, 4, capacity=16, top_k=2)
+    np.testing.assert_allclose(np.asarray(c.sum(axis=(1, 2))),
+                               np.ones(8), rtol=1e-5)
+    # each token occupies exactly two dispatch slots
+    np.testing.assert_allclose(np.asarray(d.sum(axis=(1, 2))),
+                               2 * np.ones(8), rtol=1e-6)
+    # tight capacity: total kept slots per expert <= capacity, and the
+    # kept mass never exceeds the uncapped mass
+    d2, c2, _ = topk_gating(x, wg, 4, capacity=1, top_k=2)
+    per_expert = np.asarray(d2.sum(axis=(0, 2)))
+    assert (per_expert <= 1 + 1e-6).all(), per_expert
+    assert float(c2.sum()) <= float(c.sum()) + 1e-6
+    # top_k=1 equals the legacy top1_gating exactly
+    from paddle_tpu.parallel.moe import top1_gating
+    d1a, c1a, aux1a = topk_gating(x, wg, 4, capacity=4, top_k=1)
+    d1b, c1b, aux1b = top1_gating(x, wg, 4, capacity=4)
+    np.testing.assert_array_equal(np.asarray(d1a), np.asarray(d1b))
+    np.testing.assert_array_equal(np.asarray(c1a), np.asarray(c1b))
+
+
+def test_moe_top2_sharded_matches_dense():
+    """top_k=2 through the fluid op: ep-sharded all_to_all routing ==
+    dense fallback at shard-divisible shapes (the top-1 parity
+    contract extended to GShard routing)."""
+    def build(seed=21):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[T, DIM], dtype='float32')
+            y = layers.data('y', shape=[T, DIM], dtype='float32')
+            mo, aux = layers.moe(x, num_experts=E, hidden_size=FF,
+                                 aux_weight=0.01, top_k=2)
+            out = layers.elementwise_add(x, mo)
+            mse = layers.reduce_mean(
+                layers.square(layers.elementwise_sub(out, y)))
+            loss = layers.elementwise_add(mse, aux)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(6)
+    feed = {'x': rng.randn(B, T, DIM).astype('float32'),
+            'y': rng.randn(B, T, DIM).astype('float32')}
+    main, startup, loss = build()
+    single = _run_losses(main, startup, loss, feed, 3)
+
+    mesh = pmesh.create_mesh(dp=4, ep=2)
+    m2, s2, loss2 = build()
+    comp = fluid.CompiledProgram(m2).with_data_parallel(
+        loss_name=loss2.name).with_mesh(mesh)
+    sharded = _run_losses(m2, s2, loss2, feed, 3, compiled=comp)
+    np.testing.assert_allclose(sharded, single, rtol=5e-3, atol=5e-4)
