@@ -187,3 +187,96 @@ def test_dataset_loaders_shapes():
     emb = dataset.conll05.get_embedding()
     assert emb.shape == (dataset.conll05.WORD_VOCAB,
                          dataset.conll05.EMB_DIM)
+
+
+def test_soft_label_distillation_transfers_knowledge_e2e():
+    """End-to-end knowledge transfer (round 5): a student trained ONLY
+    on the SoftLabelDistiller loss (zero hard labels) learns to agree
+    with a trained teacher on held-out data — the reference
+    distillation contract (distiller.py:195) exercised through real
+    training, not just loss shrinkage."""
+    rng = np.random.RandomState(0)
+
+    def make_batch(n=64):
+        y = rng.randint(0, 2, n)
+        x = rng.randn(n, 8).astype('float32')
+        x[y == 1, :4] += 1.6
+        return x, y.astype('int64').reshape(-1, 1)
+
+    # --- teacher: train a wider net on labels ---
+    tmain, tstart = fluid.Program(), fluid.Program()
+    tmain.random_seed = tstart.random_seed = 1
+    with fluid.program_guard(tmain, tstart):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        yv = fluid.layers.data('y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, 32, act='relu')
+        tlogits = fluid.layers.fc(h, 2)
+        tloss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(tlogits, yv))
+        fluid.optimizer.Adam(5e-3).minimize(tloss)
+    tscope = fluid.Scope()
+    with fluid.scope_guard(tscope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(tstart)
+        for _ in range(40):
+            xb, yb = make_batch()
+            exe.run(tmain, feed={'x': xb, 'y': yb}, fetch_list=[])
+        tparams = {p.name: np.asarray(tscope.find_var(p.name))
+                   for p in tmain.all_parameters()}
+
+    # --- student: teacher forward (frozen) + student net + soft loss
+    # in ONE program, the reference graph-merging recipe ---
+    smain, sstart = fluid.Program(), fluid.Program()
+    smain.random_seed = sstart.random_seed = 2
+    with fluid.program_guard(smain, sstart):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        th = fluid.layers.fc(x, 32, act='relu',
+                             param_attr=fluid.ParamAttr(name='t_w0'),
+                             bias_attr=fluid.ParamAttr(name='t_b0'))
+        tlog = fluid.layers.fc(th, 2,
+                               param_attr=fluid.ParamAttr(name='t_w1'),
+                               bias_attr=fluid.ParamAttr(name='t_b1'))
+        tlog.stop_gradient = True
+        sh = fluid.layers.fc(x, 8, act='relu')   # smaller student
+        slog = fluid.layers.fc(sh, 2)
+        dloss = distillation.SoftLabelDistiller(
+            slog, tlog, teacher_temperature=2.0,
+            student_temperature=2.0).distiller_loss()
+        fluid.optimizer.Adam(
+            1e-2).minimize(dloss,
+                           no_grad_set=['t_w0', 't_b0', 't_w1', 't_b1'])
+    sscope = fluid.Scope()
+    with fluid.scope_guard(sscope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(sstart)
+        # load the frozen teacher weights under their t_* names,
+        # mapped BY SHAPE so a change in fc's param-creation order
+        # fails loudly here instead of at the agreement assertion
+        want = {'t_w0': (8, 32), 't_b0': (32,),
+                't_w1': (32, 2), 't_b1': (2,)}
+        for dst, shape in want.items():
+            srcs = [n for n, v in tparams.items()
+                    if tuple(v.shape) == shape]
+            assert len(srcs) == 1, (dst, shape, srcs)
+            sscope.set_var(dst, tparams[srcs[0]])
+        frozen_before = np.array(np.asarray(sscope.find_var('t_w1')))
+        d0 = None
+        for i in range(200):
+            xb, _ = make_batch()
+            d, = exe.run(smain, feed={'x': xb}, fetch_list=[dloss])
+            if d0 is None:
+                d0 = float(np.asarray(d).ravel()[0])
+        d1 = float(np.asarray(d).ravel()[0])
+        assert d1 < d0, (d0, d1)
+        # teacher stayed frozen
+        np.testing.assert_array_equal(
+            frozen_before, np.asarray(sscope.find_var('t_w1')))
+
+        # held-out agreement: student mimics the teacher WITHOUT ever
+        # seeing a label
+        xe, _ = make_batch(256)
+        s_out, t_out = exe.run(smain, feed={'x': xe},
+                               fetch_list=[slog, tlog])
+    agree = (np.argmax(np.asarray(s_out), 1) ==
+             np.argmax(np.asarray(t_out), 1)).mean()
+    assert agree > 0.9, agree
