@@ -526,6 +526,11 @@ def _flash_bwd_fused(q, k, v, bias, seed2, do, lse3, delta3, glse3, h,
 # The fused one-pass backward engages when the per-head VMEM residency
 # fits; False forces the two-pass scheme (sweeps / A-B measurement).
 FUSED_BWD = True
+# Fused-backward tile shape (chip-swept round 5: 512/512 best at d=64
+# within the VMEM budget; larger k-tiles push the f32 score blocks
+# over it and fall back to two-pass).
+FUSED_BLOCK_Q = 512
+FUSED_BLOCK_K = 512
 
 
 def _fused_bwd_vmem(t, d, block_q, block_k, itemsize):
@@ -649,7 +654,7 @@ def _flash_bwd(q, k, v, bias, seed, o, lse, do, g_lse, h, causal,
     seed2 = jnp.asarray(seed, jnp.uint32).reshape(1, 4) if rate else None
     seed_spec = pl.BlockSpec((1, 4), lambda i, j: (0, 0))
 
-    fq, fk = min(block_q, 512), min(block_k, 512)
+    fq, fk = min(block_q, FUSED_BLOCK_Q), min(block_k, FUSED_BLOCK_K)
     while t % fq:
         fq //= 2
     while t % fk:
