@@ -45,6 +45,11 @@ test: all
 bench:
 	python bench.py
 
+# static gates that need no device: the monitor instrument points the
+# observability contract depends on must stay in the source
+check:
+	python tools/check_stat_coverage.py
+
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist
 
@@ -53,4 +58,4 @@ clean:
 	$(MAKE) -C paddle_tpu/inference/capi clean
 	rm -rf build dist *.egg-info
 
-.PHONY: all test bench wheel clean
+.PHONY: all test bench check wheel clean

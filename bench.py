@@ -119,6 +119,28 @@ def _chip_peak():
 LAST_PERF = {}
 
 
+def _monitor_fields():
+    """Always-on runtime-stats subset recorded alongside throughput, so
+    BENCH_*.json carries the counters (segment-cache behavior, compile
+    seconds, bytes fed) next to every images/sec number.  Each --all
+    entry runs in its own child process, so the registry is per-entry:
+    these are the counts for THIS bench's runs (warmup included)."""
+    try:
+        from paddle_tpu.fluid import monitor
+        hist = monitor.histogram_value(
+            'executor/segment_compile_seconds') or {}
+        return {'monitor': {
+            'segment_cache_hit':
+                monitor.counter_value('executor/segment_cache_hit'),
+            'segment_cache_miss':
+                monitor.counter_value('executor/segment_cache_miss'),
+            'compile_seconds': round(hist.get('sum', 0.0), 3),
+            'feed_bytes': monitor.counter_value('executor/feed_bytes'),
+        }}
+    except Exception:
+        return {}
+
+
 def _perf_fields(step_s, cost):
     if not cost or not cost.get('flops'):
         return {}
@@ -207,7 +229,8 @@ def bench_bert(batch=32, seq_len=128, steps=20, cfg=None):
     return dict({'metric': 'bert_base_pretrain_step_ms_b%d_s%d'
                  % (batch, seq_len),
                  'value': round(dt * 1000, 2), 'unit': 'ms/step',
-                 'seq_per_sec': round(batch / dt, 1)}, **LAST_PERF)
+                 'seq_per_sec': round(batch / dt, 1)},
+                **LAST_PERF, **_monitor_fields())
 
 
 def bench_bert_long(batch=4, seq_len=2048, steps=10):
@@ -287,8 +310,9 @@ def bench_resnet_infer(batch=32, steps=30, warmup=5):
         out = predictor.run_dict({'image': x}, return_numpy=False)
     np.asarray(out[0])
     dt = (time.time() - t0) / steps
-    return {'metric': 'resnet50_infer_images_per_sec_b%d' % batch,
-            'value': round(batch / dt, 1), 'unit': 'images/sec'}
+    return dict({'metric': 'resnet50_infer_images_per_sec_b%d' % batch,
+                 'value': round(batch / dt, 1), 'unit': 'images/sec'},
+                **_monitor_fields())
 
 
 def bench_wide_deep(batch=2048, steps=30, is_sparse=False):
@@ -315,7 +339,8 @@ def bench_wide_deep(batch=2048, steps=30, is_sparse=False):
     return dict({'metric': 'wide_deep_ctr_examples_per_sec_b%d%s'
                  % (batch, '_sparse' if is_sparse else ''),
                  'value': round(batch / dt, 1),
-                 'unit': 'examples/sec'}, **LAST_PERF)
+                 'unit': 'examples/sec'},
+                **LAST_PERF, **_monitor_fields())
 
 
 def bench_wide_deep_sparse(batch=2048, steps=30):
@@ -414,7 +439,8 @@ def bench_transformer(batch=32, src_len=64, tgt_len=64, steps=20):
     return dict({'metric': 'transformer_nmt_tokens_per_sec_b%d' % batch,
                  'value': round(batch * tgt_len / dt, 1),
                  'unit': 'tokens/sec',
-                 'step_ms': round(dt * 1000, 2)}, **LAST_PERF)
+                 'step_ms': round(dt * 1000, 2)},
+                **LAST_PERF, **_monitor_fields())
 
 
 def bench_resnet50_hostfed(batch=128, steps=20, warmup=3,
@@ -488,11 +514,12 @@ def bench_resnet50_hostfed(batch=128, steps=20, warmup=3,
         l, = exe.run(main, feed=host_batches[0], fetch_list=[loss])
         np.asarray(l)
         sync_dt = (time.time() - t0) / (max(4, steps // 4) + 1)
-    return {'metric': 'resnet50_train_hostfed_images_per_sec_b%d'
-            % batch,
-            'value': round(batch * (n + 1) / dt, 1),
-            'unit': 'images/sec',
-            'sync_feed_images_per_sec': round(batch / sync_dt, 1)}
+    return dict({'metric': 'resnet50_train_hostfed_images_per_sec_b%d'
+                 % batch,
+                 'value': round(batch * (n + 1) / dt, 1),
+                 'unit': 'images/sec',
+                 'sync_feed_images_per_sec': round(batch / sync_dt, 1)},
+                **_monitor_fields())
 
 
 def bench_lenet(batch=512, steps=30, conv_precision=None):
@@ -529,7 +556,8 @@ def bench_lenet(batch=512, steps=30, conv_precision=None):
         fluid.flags.set_flags({'FLAGS_conv_precision': prev_precision})
     return dict({'metric': 'lenet_mnist_images_per_sec_b%d' % batch,
                  'value': round(batch / dt, 1),
-                 'unit': 'images/sec'}, **LAST_PERF)
+                 'unit': 'images/sec'},
+                **LAST_PERF, **_monitor_fields())
 
 
 # --all entries: (name, config variants tried in order).  The second
@@ -595,7 +623,8 @@ def main():
             print(json.dumps(dict({
                 'metric': 'resnet50_train_images_per_sec_chip',
                 'value': round(ips, 2), 'unit': 'images/sec',
-                'vs_baseline': round(ips / 365.0, 3)}, **LAST_PERF)))
+                'vs_baseline': round(ips / 365.0, 3)},
+                **LAST_PERF, **_monitor_fields())))
         else:
             print(json.dumps(
                 globals()['bench_' + sys.argv[2]](**kwargs)))

@@ -23,6 +23,9 @@ import queue as _queue
 
 import numpy as np
 
+# dependency-free stats module (no fluid package init required)
+from ..fluid import monitor
+
 
 class ParameterServerStore(object):
     """In-process stand-in for the pserver side: name -> np.ndarray with
@@ -150,7 +153,15 @@ class AsyncCommunicator(object):
     def send(self, name, grad):
         if not self._running:
             raise RuntimeError('communicator not started')
-        self._queue_of(name).put(np.asarray(grad))
+        grad = np.asarray(grad)
+        monitor.add('communicator/sends')
+        monitor.add('communicator/send_bytes', float(grad.nbytes))
+        self._queue_of(name).put(grad)
+        # total backlog ACROSS the per-variable queues: a single slow
+        # variable's pile-up must show even when others drain fine
+        monitor.set_gauge('communicator/send_queue_depth',
+                          sum(q.qsize()
+                              for q in list(self._queues.values())))
 
     def _send_loop(self, name, q):
         while self._running or not q.empty():
@@ -165,6 +176,9 @@ class AsyncCommunicator(object):
                     n += 1
                 except _queue.Empty:
                     break
+            # MergeVars accounting: grads folded into one server apply
+            monitor.add('communicator/grads_merged', float(n))
+            monitor.add('communicator/server_applies')
             self.server.apply_grad(name, (merged / n).astype(g.dtype))
 
     def recv(self, name):

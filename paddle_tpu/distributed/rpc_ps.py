@@ -178,16 +178,22 @@ class PsClient(object):
         dropped instead, like the reference's async send path
         (grpc_client.h completion-queue sends are fire-and-forget for
         grads), and returns None."""
+        from ..fluid import monitor
         nb = name.encode()
         frame = struct.pack('<BI', op, len(nb)) + nb + payload
         msg = struct.pack('<I', len(frame)) + frame
         retries = 0 if blocking else self.retry_times
+        monitor.add('rpc/calls')
+        monitor.add('rpc/bytes_sent', float(len(msg)))
+        t_call = time.perf_counter()
         with self._lock:
             last = None
             for attempt in range(retries + 1):
                 sent = False
                 try:
                     if self._sock is None or attempt > 0:
+                        if attempt > 0:
+                            monitor.add('rpc/retries')
                         self._connect()
                     if blocking:
                         self._sock.settimeout(None)
@@ -213,6 +219,7 @@ class PsClient(object):
                             pass
                         self._sock = None
                         self.dropped_pushes += 1
+                        monitor.add('rpc/dropped_pushes')
                         import logging
                         logging.getLogger(__name__).warning(
                             'ps push op=%d var=%r to %s:%d dropped '
@@ -221,15 +228,20 @@ class PsClient(object):
                             self._addr[1], e, self.dropped_pushes)
                         return None
             else:
+                monitor.add('rpc/deadline_errors')
                 raise RpcDeadlineError(
                     'ps rpc to %s:%d failed after %d attempts with '
                     '%.1fs deadline each: %s'
                     % (self._addr[0], self._addr[1], retries + 1,
                        self.deadline, last))
+        monitor.add('rpc/bytes_received', float(4 + len(body)))
+        monitor.observe('rpc/call_seconds',
+                        time.perf_counter() - t_call)
         if not body:
             raise PsServerError('empty reply frame')
         status, payload = body[0], body[1:]
         if status != 0:
+            monitor.add('rpc/server_errors')
             raise PsServerError(payload.decode('utf-8', 'replace'))
         return payload
 

@@ -7,6 +7,7 @@ ports by replacing `import paddle.fluid as fluid` with
 optional).
 """
 
+from . import monitor  # dependency-free; first so every layer can use it
 from . import core
 from .core import (CPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
                    LoDTensor, SelectedRows, Scope, global_scope,
@@ -59,7 +60,7 @@ __all__ = [
     'program_guard', 'default_main_program', 'default_startup_program',
     'Executor', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
     'ParamAttr', 'CompiledProgram', 'BuildStrategy', 'io', 'metrics',
-    'dygraph', 'DataFeeder', 'scope_guard', 'global_scope',
+    'dygraph', 'DataFeeder', 'scope_guard', 'global_scope', 'monitor',
 ]
 from . import dataset
 from .dataset import DatasetFactory

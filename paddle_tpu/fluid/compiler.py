@@ -82,6 +82,8 @@ class CompiledProgram(object):
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None):
+        from . import monitor
+        monitor.add('compiler/data_parallel_programs_built')
         self._is_data_parallel = True
         self._loss_name = loss_name
         if build_strategy is not None:
@@ -105,6 +107,9 @@ class CompiledProgram(object):
     def with_mesh(self, mesh):
         """Execute over an explicit jax.sharding.Mesh (multi-axis meshes
         enable tensor/pipeline axes beyond 'dp')."""
+        from . import monitor
+        monitor.add('compiler/mesh_programs_built')
+        monitor.set_gauge('parallel/device_count', mesh.devices.size)
         self._mesh = mesh
         self._is_data_parallel = True
         return self

@@ -17,12 +17,28 @@ analog of the reference's nGraph engine-op precedent
 Host ops (feed/fetch/save/load/print) cut segments and run on the host.
 """
 
+import time as _time_mod
+
 import numpy as np
 import jax
 
 from . import core
 from . import framework
+from . import monitor
 from ..ops import registry
+
+
+def _stat_nbytes(v):
+    """Host-side byte count of a feed/fetch value for the monitor
+    counters.  Runs per feed var per step, so it must stay O(1):
+    jax.Array and np.ndarray expose nbytes directly; anything else
+    (lists, scalars) counts as 0 rather than paying an np.asarray
+    materialization just for a stats counter — the executor converts
+    those exactly once on its own path."""
+    if isinstance(v, core.LoDTensor):
+        v = v.data
+    n = getattr(v, 'nbytes', None)
+    return float(n) if n is not None else 0.0
 
 
 class _Segment(object):
@@ -752,6 +768,7 @@ class Executor(object):
 
         feed_names = _norm(feed_names)
         fetch_names = _norm(fetch_names)
+        monitor.add('executor/programs_compiled')
         plan = self._get_plan(program, tuple(sorted(feed_names)),
                               tuple(fetch_names), prefer_test)
         segs = [it for it in plan if isinstance(it, _Segment)]
@@ -854,9 +871,15 @@ class Executor(object):
         plan = self._get_plan(program, tuple(sorted(feed.keys())),
                               tuple(fetch_names))
         self._step += 1
+        t0 = _time_mod.perf_counter()
         out = self._run_plan(program, plan, feed, fetch_names, scope,
                              return_numpy)
         self._post_step(program, scope)
+        # dispatch-side wall time: jit dispatch is async, so this is the
+        # host cost of one step (compiles land here on cold caches)
+        monitor.add('executor/run_calls')
+        monitor.observe('executor/run_seconds',
+                        _time_mod.perf_counter() - t0)
         return out
 
     def program_cost(self, program, feed, fetch_list=None, scope=None):
@@ -939,6 +962,8 @@ class Executor(object):
         key = ('plan', feed_names, fetch_names, id(self), prefer_test,
                per_op)
         plan = program._exec_cache.get(key)
+        monitor.add('executor/plan_cache_hit' if plan is not None
+                    else 'executor/plan_cache_miss')
         if plan is None:
             plan = self._build_plan(program, feed_names, fetch_names,
                                     per_op=per_op)
@@ -1119,6 +1144,8 @@ class Executor(object):
         for k, v in feed.items():
             if isinstance(v, core.LoDTensor) and len(v.lod) >= 2:
                 self._reject_multilevel_lod(program, k, len(v.lod))
+            monitor.add('executor/feed_bytes', _stat_nbytes(v))
+        monitor.add('executor/feed_vars', float(len(feed)))
         device = self.place.jax_device()
         fetched = {}
         has_host = any(not isinstance(it, _Segment) for it in plan)
@@ -1142,6 +1169,7 @@ class Executor(object):
                                        prefer_test)
             else:
                 op = item[1]
+                monitor.add('executor/host_ops_run')
                 registry.get(op.type).fn(self, scope, op)
             if prof:
                 if isinstance(item, _Segment):
@@ -1163,7 +1191,10 @@ class Executor(object):
                 if val is None:
                     raise RuntimeError('fetch var %s not produced' % name)
             val = core.as_array(val)
+            monitor.add('executor/fetch_bytes', _stat_nbytes(val))
             results.append(np.asarray(val) if return_numpy else val)
+        if fetch_names:
+            monitor.add('executor/fetch_vars', float(len(fetch_names)))
         return results
 
     def _lookup_input(self, name, feed, scope):
@@ -1259,9 +1290,17 @@ class Executor(object):
         key = (auto, prec, wpg) + tuple(op.attrs.get('max_trip_count')
                               for op in seg.bucket_ops)
         compiled = seg.compiled.get(key)
-        if compiled is None:
+        # executable-cache accounting (reference STAT_ADD counters):
+        # a miss lowers + compiles this segment; each auto-bucket size
+        # is its own executable and counts as its own miss
+        first_run = compiled is None
+        if first_run:
+            monitor.add('executor/segment_cache_miss')
+            monitor.add('executor/segments_lowered')
             compiled = seg.compiled[key] = _jit_segment(
                 seg, auto, whole_program_grad=wpg)
+        else:
+            monitor.add('executor/segment_cache_hit')
 
         state = {}
         for n in seg.state_names:
@@ -1275,8 +1314,16 @@ class Executor(object):
         data = {n: self._lookup_input(n, feed, scope)
                 for n in seg.input_names}
         try:
+            if first_run:
+                # the first call of a jitted segment traces + compiles
+                # synchronously (only execution is async), so timing it
+                # is the per-segment compile-latency histogram
+                t0 = _time_mod.perf_counter()
             with jax.default_device(device):
                 out = compiled(self._step, state, data)
+            if first_run:
+                monitor.observe('executor/segment_compile_seconds',
+                                _time_mod.perf_counter() - t0)
         except Exception as e:
             note = _feed_mismatch_note(seg.ops[0].block.program, feed)
             if note:

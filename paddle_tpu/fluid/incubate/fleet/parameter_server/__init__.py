@@ -18,11 +18,14 @@ sync_mode=True degenerates to collective grad-allreduce, matching the
 reference guidance that sync PS ~ collective training.
 """
 
+import time as _time
+
 import numpy as np
 
 from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
 from .....distributed import ParameterServerStore, AsyncCommunicator
 from .... import core
+from .... import monitor
 
 
 class ParameterServerFleet(Fleet):
@@ -143,6 +146,7 @@ def ps_async_step(executor, scope, program):
     # trainer reattaching to a long-lived server must install ITS
     # optimizer rule, not silently inherit the previous run's
     conf_done = program._ps_async.setdefault('_conf_done', set())
+    t0 = _time.perf_counter()
     for pname, gname in program._ps_async['pairs']:
         if pname not in server.names():
             server.init_var(pname, core.as_array(scope.find_var(pname)))
@@ -153,8 +157,16 @@ def ps_async_step(executor, scope, program):
             conf_done.add(pname)
         g = scope.find_var(gname)
         if g is not None:
-            comm.send(pname, np.asarray(core.as_array(g)))
-        scope.set_var(pname, comm.recv(pname))
+            g = np.asarray(core.as_array(g))
+            monitor.add('ps/push_calls')
+            monitor.add('ps/push_bytes', float(g.nbytes))
+            comm.send(pname, g)
+        pulled = comm.recv(pname)
+        monitor.add('ps/pull_calls')
+        monitor.add('ps/pull_bytes',
+                    float(getattr(pulled, 'nbytes', 0)))
+        scope.set_var(pname, pulled)
+    monitor.observe('ps/step_seconds', _time.perf_counter() - t0)
 
 
 def _server_rule_of(optimizer):

@@ -10,11 +10,14 @@ all-reduces for the replicated parameter updates.  Parameter "broadcast"
 is jit auto-replication of the scope's single-device arrays.
 """
 
+import time as _time_mod
+
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
+from . import monitor
 from .executor import _Segment, _make_segment_fn, _add_note
 
 
@@ -196,9 +199,13 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
                    for v in fetch_list]
     mesh = get_mesh(compiled)
     ndev = mesh.devices.size
+    monitor.set_gauge('parallel/device_count', ndev)
+    monitor.set_gauge('parallel/process_count', jax.process_count())
 
     key = ('pplan', tuple(sorted(feed.keys())), tuple(fetch_names))
     plan = compiled._exec_cache.get(key)
+    monitor.add('parallel/plan_cache_hit' if plan is not None
+                else 'parallel/plan_cache_miss')
     if plan is None:
         plan = executor._build_plan(program, tuple(sorted(feed.keys())),
                                     tuple(fetch_names))
@@ -295,6 +302,9 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         return _to_global(v, sh, per_process=sh.spec != P())
     data = {n: _convert_data(n, v) for n, v in data.items()}
     compiled = seg.compiled.get('parallel')
+    first_run = compiled is None
+    monitor.add('parallel/segment_cache_miss' if first_run
+                else 'parallel/segment_cache_hit')
     if compiled is None:
         fn0 = _make_segment_fn(seg)
 
@@ -314,7 +324,12 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
                          seg.input_names})
         compiled = seg.compiled['parallel'] = jax.jit(
             fn, in_shardings=in_shardings, donate_argnums=(1,))
+    if first_run:
+        t0 = _time_mod.perf_counter()
     out = compiled(executor._step, state, data)
+    if first_run:
+        monitor.observe('parallel/segment_compile_seconds',
+                        _time_mod.perf_counter() - t0)
     for n, v in out.items():
         scope.set_var(n, v)
         fetched[n] = v
@@ -337,10 +352,13 @@ def run_collective(executor, program, feed, fetch_list, scope,
         program._mesh = _default_mesh()
     mesh = _check_mesh_spans_processes(program._mesh)
     ndev = mesh.devices.size
+    monitor.set_gauge('parallel/device_count', ndev)
 
     key = ('cplan', tuple(sorted(feed.keys())), tuple(fetch_names),
            id(executor))
     plan = program._exec_cache.get(key)
+    monitor.add('parallel/plan_cache_hit' if plan is not None
+                else 'parallel/plan_cache_miss')
     if plan is None:
         plan = executor._build_plan(program, tuple(sorted(feed.keys())),
                                     tuple(fetch_names))
@@ -381,6 +399,9 @@ def run_collective(executor, program, feed, fetch_list, scope,
                                   per_process=data_specs[n] != P())
                     for n, v in data.items()}
         compiled = seg.compiled.get('collective')
+        first_run = compiled is None
+        monitor.add('parallel/segment_cache_miss' if first_run
+                    else 'parallel/segment_cache_hit')
         if compiled is None:
             fn = _make_segment_fn(seg)
             in_specs = (P(),
@@ -399,7 +420,12 @@ def run_collective(executor, program, feed, fetch_list, scope,
         else:
             step = jnp.asarray(executor._step)
         try:
+            if first_run:
+                t0 = _time_mod.perf_counter()
             out = compiled(step, state, data)
+            if first_run:
+                monitor.observe('parallel/segment_compile_seconds',
+                                _time_mod.perf_counter() - t0)
         except Exception as e:
             detail = []
             for group, d in (('state', state), ('data', data)):

@@ -40,6 +40,7 @@ _SORT_KEYS = ('calls', 'total', 'max', 'min', 'ave')
 _enabled = False
 _mode = 'Serial'         # 'Serial' | 'Default' (trace-derived)
 _records = {}  # op type -> [calls, total, max, min]
+_folded = False          # records already added to fluid.monitor
 _trace_path = None
 _prof_trace_dir = None   # capture dir while a 'Default' profile runs
 
@@ -65,7 +66,9 @@ def record_op(op_type, seconds):
 def reset_profiler():
     """Drop all accumulated per-op records (reference
     platform::ResetProfiler)."""
+    global _folded
     _records.clear()
+    _folded = False
 
 
 def summary_records():
@@ -194,11 +197,34 @@ def start_profiler(state='All', tracer_option='Serial'):
     _enabled = True
 
 
+def _fold_into_monitor():
+    """Fold the per-op table into the always-on stats registry under
+    'profiler/<op>/…' keys, so one monitor.snapshot()/dump_jsonl()
+    carries BOTH the cheap counters and the last profile's per-op
+    accounting (the reference keeps StatRegistry and the profiler
+    side by side; here they meet at stop time)."""
+    global _folded
+    if _folded:
+        # a second stop_profiler (defensive stop, re-reading the
+        # returned table) must not re-add the same cumulative records
+        return
+    _folded = True
+    from . import monitor
+    for t, (c, tot, mx, mn) in _records.items():
+        # 'unattributed/<hlo>' buckets carry '/' — keep them one level
+        safe = t.replace('/', ':')
+        monitor.add('profiler/%s/calls' % safe, float(c))
+        monitor.add('profiler/%s/total_seconds' % safe, tot)
+
+
 def stop_profiler(sorted_key='total', profile_path=None):
     """Disable profiling and print the sorted per-op table (reference
     DisableProfiler).  profile_path, when given, receives the table as
-    a text file."""
-    global _enabled, _prof_trace_dir
+    a text file.  Returns the table string, folds the per-op records
+    into fluid.monitor under 'profiler/…' keys, and resets the tracer
+    mode to 'Serial' so a later bare start_profiler()/is_enabled()
+    sequence never inherits a stale 'Default' trace mode."""
+    global _enabled, _mode, _prof_trace_dir
     _enabled = False
     if _mode == 'Default' and _prof_trace_dir is not None:
         import shutil
@@ -207,6 +233,8 @@ def stop_profiler(sorted_key='total', profile_path=None):
         _records.update(attribute_trace_events(events))
         shutil.rmtree(_prof_trace_dir, ignore_errors=True)
         _prof_trace_dir = None
+    _mode = 'Serial'
+    _fold_into_monitor()
     table = summary_string(sorted_key)
     print(table)
     if profile_path:
@@ -221,6 +249,7 @@ def stop_profiler(sorted_key='total', profile_path=None):
             os.makedirs(d, exist_ok=True)
         with open(profile_path, 'w') as f:
             f.write(table + '\n')
+    return table
 
 
 @contextlib.contextmanager
@@ -244,8 +273,20 @@ def cuda_profiler(*a, **k):
 
 
 def start_trace(logdir='/tmp/profile'):
-    """Device-trace capture (Perfetto/XPlane) — the DeviceTracer leg."""
+    """Device-trace capture (Perfetto/XPlane) — the DeviceTracer leg.
+
+    Like start_profiler, double-starts fail with a clear error instead
+    of jax's raw 'profiler already started' (only one device trace can
+    run per process, and a 'Default' profile capture owns it too)."""
     global _trace_path
+    if _trace_path is not None:
+        raise RuntimeError(
+            'a trace capture is already active (logdir %r): call '
+            'stop_trace() before starting another' % (_trace_path,))
+    if _prof_trace_dir is not None:
+        raise RuntimeError(
+            "a profiler capture (tracer_option='Default') owns the "
+            'device tracer: call stop_profiler() before start_trace()')
     os.makedirs(logdir, exist_ok=True)
     jax.profiler.start_trace(logdir)
     _trace_path = logdir

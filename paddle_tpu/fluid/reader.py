@@ -20,10 +20,12 @@ bounded recompiles where the reference used LoD offset vectors
 
 import queue as _queue
 import threading
+import time as _time
 
 import numpy as np
 
 from . import core
+from . import monitor
 
 
 class _AsyncBatchIterator(object):
@@ -71,6 +73,11 @@ class _AsyncBatchIterator(object):
             for batch in gen():
                 if not self._put(batch):
                     return
+                # producer-side accounting (LoDTensorBlockingQueue
+                # stats analog): batches entering the host queue, and
+                # its depth right after the put
+                monitor.add('reader/batches_produced')
+                monitor.set_gauge('reader/queue_depth', self._q.qsize())
         except BaseException as e:  # noqa: B036 — must cross threads
             self._exc = e
         finally:
@@ -89,7 +96,9 @@ class _AsyncBatchIterator(object):
                 v = v.data
             if isinstance(v, (np.ndarray, np.generic)) or not hasattr(
                     v, 'devices'):
-                v = jax.device_put(np.asarray(v), self._device)
+                v = np.asarray(v)
+                monitor.add('reader/bytes_staged', float(v.nbytes))
+                v = jax.device_put(v, self._device)
             out[k] = v
         return out
 
@@ -103,7 +112,13 @@ class _AsyncBatchIterator(object):
                 except _queue.Empty:
                     return
             else:
+                # empty device window: the consumer now stalls on the
+                # producer — the time the step loop loses to input.
+                # A healthy pipeline keeps this histogram's sum near 0
+                t0 = _time.perf_counter()
                 item = self._q.get()
+                monitor.observe('reader/consume_blocked_seconds',
+                                _time.perf_counter() - t0)
             if item is self._END:
                 self._done = True
                 self._stop.set()
@@ -121,6 +136,8 @@ class _AsyncBatchIterator(object):
                 raise exc
             raise StopIteration
         batch = self._staged.pop(0)
+        monitor.add('reader/batches_consumed')
+        monitor.set_gauge('reader/queue_depth', self._q.qsize())
         self._fill_window()  # keep the DMA window ahead of compute
         return batch
 

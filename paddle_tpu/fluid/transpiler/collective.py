@@ -5,6 +5,34 @@ Collective(:36), GradAllReduce(:178), LocalSGD(:270),
 SingleProcessMultiThread(:377).
 """
 
+import numpy as np
+
+from .. import monitor
+
+
+def _count_inserted_collectives(block, names, kind):
+    """Monitor accounting for a collective rewrite: ops inserted and
+    the per-step payload they move (static estimate from the declared
+    var shapes; -1 dims count as 1, so it is a lower bound for batch-
+    shaped vars — param/grad syncs, the common case, are exact)."""
+    monitor.add('collective/%s_ops_inserted' % kind, float(len(names)))
+    total = 0.0
+    for n in names:
+        v = block._find_var_recursive(n)
+        shape = tuple(getattr(v, 'shape', ()) or ()) if v is not None \
+            else ()
+        if not shape:
+            continue
+        elems = 1
+        for d in shape:
+            elems *= max(int(d), 1)
+        try:
+            itemsize = np.dtype(v.dtype).itemsize
+        except Exception:
+            itemsize = 4
+        total += float(elems * itemsize)
+    monitor.add('collective/%s_bytes_per_step' % kind, total)
+
 
 class Collective(object):
     def __init__(self, nrings=1):
@@ -23,6 +51,7 @@ class Collective(object):
         self.endpoints = endpoints if isinstance(endpoints, list) else \
             endpoints.split(',')
         self.nranks = max(len(self.endpoints), len(jax.devices()))
+        monitor.add('collective/transpile_calls')
         self._transpile_main_program()
         main_program._collective_dp = True
 
@@ -47,7 +76,8 @@ class GradAllReduce(Collective):
                 insert_at = i + 1
         if insert_at is None:
             insert_at = len(block.ops)
-        for g in dict.fromkeys(grad_names):
+        uniq = list(dict.fromkeys(grad_names))
+        for g in uniq:
             block._insert_op(insert_at, 'c_allreduce_sum',
                              inputs={'X': g}, outputs={'Out': g},
                              attrs={'ring_id': 0})
@@ -55,6 +85,7 @@ class GradAllReduce(Collective):
                              inputs={'X': g}, outputs={'Out': g},
                              attrs={'scale': 1.0 / self.nranks})
             insert_at += 2
+        _count_inserted_collectives(block, uniq, 'allreduce')
 
 
 class LocalSGD(Collective):
@@ -123,6 +154,7 @@ class LocalSGD(Collective):
                             outputs={'Out': name},
                             attrs={'scale': 1.0 / self.nranks},
                             infer_shape=False)
+        _count_inserted_collectives(block, names, 'allreduce')
 
 
 class SingleProcessMultiThread(GradAllReduce):

@@ -37,13 +37,30 @@ def _in_shard_map():
         return False
 
 
-def _maybe(axis_fn, x, axis):
+def _stat_collective(kind, x):
+    """Trace-time collective accounting: each registered lowering runs
+    ONCE per compile (the traced collective then runs every step), so
+    these are bytes-moved-per-step estimates keyed at trace time —
+    recording inside the traced graph would put a host call on the hot
+    path.  Lazy import: ops must not pull the fluid package at import
+    time (fluid.executor imports ops.registry)."""
+    from ..fluid import monitor
+    size = int(getattr(x, 'size', 0) or 0)
+    itemsize = getattr(getattr(x, 'dtype', None), 'itemsize', 4)
+    monitor.add('collective/traced_calls')
+    monitor.add('collective/traced_%s_calls' % kind)
+    monitor.add('collective/traced_bytes', float(size * itemsize))
+
+
+def _maybe(axis_fn, x, axis, kind='allreduce'):
     """Apply collective if the axis is bound; identity on single device
     (matches reference behavior when nranks == 1)."""
     try:
-        return axis_fn(x, axis)
+        out = axis_fn(x, axis)
     except NameError:
         return x
+    _stat_collective(kind, x)
+    return out
 
 
 @register('c_allreduce_sum')
@@ -70,9 +87,11 @@ def c_allreduce_prod(ctx, ins, attrs):
     axis = ring_axis(attrs.get('ring_id', 0))
     x = ins['X'][0]
     try:
-        return {'Out': [jnp.exp(jax.lax.psum(jnp.log(x), axis))]}
+        out = jnp.exp(jax.lax.psum(jnp.log(x), axis))
     except NameError:
         return {'Out': [x]}
+    _stat_collective('allreduce', x)
+    return {'Out': [out]}
 
 
 @register('c_allgather')
@@ -81,9 +100,10 @@ def c_allgather(ctx, ins, attrs):
     axis = ring_axis(attrs.get('ring_id', 0))
     try:
         g = jax.lax.all_gather(x, axis)  # [nranks, ...]
-        return {'Out': [g.reshape((-1,) + x.shape[1:])]}
     except NameError:
         return {'Out': [x]}
+    _stat_collective('allgather', x)
+    return {'Out': [g.reshape((-1,) + x.shape[1:])]}
 
 
 @register('c_reducescatter')
@@ -91,11 +111,12 @@ def c_reducescatter(ctx, ins, attrs):
     x = ins['X'][0]
     axis = ring_axis(attrs.get('ring_id', 0))
     try:
-        return {'Out': [jax.lax.psum_scatter(x, axis,
-                                             scatter_dimension=0,
-                                             tiled=True)]}
+        out = jax.lax.psum_scatter(x, axis, scatter_dimension=0,
+                                   tiled=True)
     except NameError:
         return {'Out': [x]}
+    _stat_collective('reducescatter', x)
+    return {'Out': [out]}
 
 
 @register('c_broadcast')
@@ -106,9 +127,11 @@ def c_broadcast(ctx, ins, attrs):
     try:
         idx = jax.lax.axis_index(axis)
         masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-        return {'Out': [jax.lax.psum(masked, axis)]}
+        out = jax.lax.psum(masked, axis)
     except NameError:
         return {'Out': [x]}
+    _stat_collective('broadcast', x)
+    return {'Out': [out]}
 
 
 @register('c_concat')

@@ -1,0 +1,87 @@
+"""Stat-coverage audit: the monitor instrument points the observability
+contract depends on must stay in the source (the CI-gate analog of
+check_op_coverage.py, for fluid.monitor instead of the op registry).
+
+Each entry below is (file, literal stat key) — a refactor that drops
+one silently blinds production scraping, so this exits nonzero and
+names the missing point.  Run from `make check`.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (repo-relative file, substring that must appear in it)
+REQUIRED = [
+    # executor core: segment executable cache, compile latency, volume
+    ('paddle_tpu/fluid/executor.py', 'executor/segment_cache_hit'),
+    ('paddle_tpu/fluid/executor.py', 'executor/segment_cache_miss'),
+    ('paddle_tpu/fluid/executor.py', 'executor/segments_lowered'),
+    ('paddle_tpu/fluid/executor.py', 'executor/segment_compile_seconds'),
+    ('paddle_tpu/fluid/executor.py', 'executor/plan_cache_hit'),
+    ('paddle_tpu/fluid/executor.py', 'executor/feed_bytes'),
+    ('paddle_tpu/fluid/executor.py', 'executor/fetch_bytes'),
+    ('paddle_tpu/fluid/executor.py', 'executor/run_seconds'),
+    ('paddle_tpu/fluid/executor.py', 'executor/host_ops_run'),
+    # data-parallel / collective runners
+    ('paddle_tpu/fluid/parallel_executor.py', 'parallel/device_count'),
+    ('paddle_tpu/fluid/parallel_executor.py',
+     'parallel/segment_cache_miss'),
+    ('paddle_tpu/fluid/parallel_executor.py',
+     'parallel/segment_compile_seconds'),
+    ('paddle_tpu/fluid/compiler.py',
+     'compiler/data_parallel_programs_built'),
+    # async input pipeline
+    ('paddle_tpu/fluid/reader.py', 'reader/queue_depth'),
+    ('paddle_tpu/fluid/reader.py', 'reader/batches_produced'),
+    ('paddle_tpu/fluid/reader.py', 'reader/batches_consumed'),
+    ('paddle_tpu/fluid/reader.py', 'reader/consume_blocked_seconds'),
+    ('paddle_tpu/fluid/reader.py', 'reader/bytes_staged'),
+    # PS / RPC planes
+    ('paddle_tpu/fluid/incubate/fleet/parameter_server/__init__.py',
+     'ps/push_bytes'),
+    ('paddle_tpu/fluid/incubate/fleet/parameter_server/__init__.py',
+     'ps/step_seconds'),
+    ('paddle_tpu/distributed/rpc_ps.py', 'rpc/calls'),
+    ('paddle_tpu/distributed/rpc_ps.py', 'rpc/call_seconds'),
+    ('paddle_tpu/distributed/rpc_ps.py', 'rpc/retries'),
+    ('paddle_tpu/distributed/communicator.py', 'communicator/sends'),
+    ('paddle_tpu/distributed/communicator.py',
+     'communicator/grads_merged'),
+    # collective rewrites + trace-time lowering accounting
+    ('paddle_tpu/fluid/transpiler/collective.py',
+     'collective/%s_ops_inserted'),
+    ('paddle_tpu/ops/collective_ops.py', 'collective/traced_bytes'),
+    # profiler fold-in + bench export
+    ('paddle_tpu/fluid/profiler.py', "profiler/%s/calls"),
+    ('bench.py', '_monitor_fields'),
+]
+
+
+def main():
+    missing = []
+    for rel, needle in REQUIRED:
+        path = os.path.join(ROOT, rel)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            missing.append('%s: FILE MISSING (needed %r)'
+                           % (rel, needle))
+            continue
+        if needle not in src:
+            missing.append('%s: instrument point %r disappeared'
+                           % (rel, needle))
+    print('stat instrument points: %d required, %d present'
+          % (len(REQUIRED), len(REQUIRED) - len(missing)))
+    if missing:
+        for m in missing:
+            print('MISSING  ' + m)
+        return 1
+    print('coverage: complete')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Render or diff fluid.monitor JSONL dumps.
+
+Usage:
+  python tools/stat_summary.py run.jsonl            # render last line
+  python tools/stat_summary.py before.jsonl after.jsonl   # diff
+  python tools/stat_summary.py --live               # snapshot of THIS
+                                                    # process's registry
+
+One-file mode prints the last record as a sorted table (counters,
+gauges, histogram sum/count).  Two-file mode prints after-minus-before
+for counters and histograms — the per-interval rates a trajectory of
+dump_jsonl() lines is for (e.g. diffing two BENCH rounds' monitor
+sections).  Companion of tools/timeline.py (traces) and the profiler
+table: this one reads the ALWAYS-ON stats.
+"""
+
+import json
+import sys
+
+
+def load_last(path):
+    """Last JSONL record of `path` (one dump_jsonl line per step)."""
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                last = json.loads(line)
+    if last is None:
+        raise ValueError('no records in %s' % path)
+    return last
+
+
+def _rows(rec):
+    rows = []
+    for n, v in sorted(rec.get('counters', {}).items()):
+        rows.append((n, 'counter', v))
+    for n, v in sorted(rec.get('gauges', {}).items()):
+        rows.append((n, 'gauge', v))
+    for n, h in sorted(rec.get('histograms', {}).items()):
+        rows.append((n + '/count', 'histogram', float(h['count'])))
+        rows.append((n + '/sum', 'histogram', h['sum']))
+    return rows
+
+
+def _fmt(v):
+    if v == int(v) and abs(v) < 1e15:
+        return '%d' % int(v)
+    return '%.6g' % v
+
+
+def render(rec, out=sys.stdout):
+    out.write('%-52s %-10s %14s\n' % ('stat', 'kind', 'value'))
+    for n, kind, v in _rows(rec):
+        out.write('%-52s %-10s %14s\n' % (n, kind, _fmt(v)))
+
+
+def diff(before, after, out=sys.stdout):
+    """after − before for cumulative stats; gauges show both levels."""
+    b = dict((n, v) for n, k, v in _rows(before) if k != 'gauge')
+    out.write('%-52s %14s\n' % ('stat', 'delta'))
+    for n, kind, v in _rows(after):
+        if kind == 'gauge':
+            continue
+        out.write('%-52s %14s\n' % (n, _fmt(v - b.get(n, 0.0))))
+    ga = after.get('gauges', {})
+    gb = before.get('gauges', {})
+    for n in sorted(set(ga) | set(gb)):
+        out.write('%-52s %14s -> %s\n'
+                  % (n + ' (gauge)', _fmt(gb.get(n, 0.0)),
+                     _fmt(ga.get(n, 0.0))))
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv == ['--live']:
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+        from paddle_tpu.fluid import monitor
+        rec = {'counters': monitor._counters, 'gauges': monitor._gauges,
+               'histograms': {n: {'count': h[3], 'sum': h[2]}
+                              for n, h in monitor._hists.items()}}
+        render(rec)
+        return 0
+    if len(argv) == 1:
+        render(load_last(argv[0]))
+        return 0
+    if len(argv) == 2:
+        diff(load_last(argv[0]), load_last(argv[1]))
+        return 0
+    sys.stderr.write(__doc__)
+    return 2
+
+
+if __name__ == '__main__':
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `stat_summary.py x.jsonl | head`
+        sys.exit(0)
