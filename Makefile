@@ -45,10 +45,12 @@ test: all
 bench:
 	python bench.py
 
-# static gates that need no device: the monitor instrument points the
-# observability contract depends on must stay in the source
+# gates: the monitor instrument points the observability contract
+# depends on must stay in the source, and the steady-state step fast
+# path must stay within its per-step counter budgets
 check:
 	python tools/check_stat_coverage.py
+	JAX_PLATFORMS=cpu python tools/check_hot_path.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -129,6 +129,8 @@ def _monitor_fields():
         from paddle_tpu.fluid import monitor
         hist = monitor.histogram_value(
             'executor/segment_compile_seconds') or {}
+        run = monitor.histogram_value('executor/run_seconds') or {}
+        bind = monitor.histogram_value('executor/bind_seconds') or {}
         return {'monitor': {
             'segment_cache_hit':
                 monitor.counter_value('executor/segment_cache_hit'),
@@ -136,6 +138,16 @@ def _monitor_fields():
                 monitor.counter_value('executor/segment_cache_miss'),
             'compile_seconds': round(hist.get('sum', 0.0), 3),
             'feed_bytes': monitor.counter_value('executor/feed_bytes'),
+            # dispatch-side host accounting (steady-state fast path)
+            'run_seconds': round(run.get('sum', 0.0), 4),
+            'run_calls': run.get('count', 0),
+            'fastpath_hits':
+                monitor.counter_value('executor/fastpath_hits'),
+            'scope_lookups':
+                monitor.counter_value('executor/scope_lookups'),
+            'bind_seconds': round(bind.get('sum', 0.0), 5),
+            'h2d_bytes_async':
+                monitor.counter_value('executor/h2d_bytes_async'),
         }}
     except Exception:
         return {}
@@ -560,6 +572,62 @@ def bench_lenet(batch=512, steps=30, conv_precision=None):
                 **LAST_PERF, **_monitor_fields())
 
 
+def bench_dispatch(depth=6, width=8, batch=4, steps=300, warmup=8):
+    """Steady-state dispatch-side host cost per step, isolated: a tiny
+    deep-ish MLP whose compute is ~free, fed device-resident data with
+    no per-step fetch.  The device queue is drained OUTSIDE run() after
+    every step, so `executor/run_seconds` sees pure host dispatch
+    (binders, staging checks, jit call), never device backpressure —
+    the metric the steady-state fast path moves; compute-bound entries
+    bury it."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import monitor
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[width], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = fluid.layers.fc(h, size=width, act='relu')
+        loss = fluid.layers.reduce_mean(h)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    feed = {'x': jax.device_put(
+        np.ones((batch, width), 'float32'))}
+    pname = main.all_parameters()[0].name
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed=feed, fetch_list=[])
+        jax.block_until_ready(scope.find_var(pname))
+        f0 = {k: v for k, v in monitor.flat().items()}
+        for _ in range(steps):
+            exe.run(main, feed=feed, fetch_list=[])
+            jax.block_until_ready(scope.find_var(pname))
+        f1 = monitor.flat()
+
+    def d(key):
+        return f1.get(key, 0.0) - f0.get(key, 0.0)
+
+    per_step = d('executor/run_seconds/sum') / steps
+    bind_n = d('executor/bind_seconds/count')
+    return dict({'metric': 'dispatch_host_us_per_step_d%d' % depth,
+                 'value': round(per_step * 1e6, 1),
+                 'unit': 'us/step',
+                 'fastpath_hit_rate': round(
+                     d('executor/fastpath_hits') / steps, 3),
+                 'bind_us_per_step': round(
+                     1e6 * d('executor/bind_seconds/sum') /
+                     max(bind_n, 1), 2)},
+                **_monitor_fields())
+
+
+SMOKE_BENCHES = (('dispatch', {}),
+                 ('lenet', {'batch': 64, 'steps': 30}))
+
+
 # --all entries: (name, config variants tried in order).  The second
 # variant is a near-equivalent config with a DIFFERENT XLA program
 # fingerprint — observed failure mode on the tunnel service: one
@@ -628,6 +696,15 @@ def main():
         else:
             print(json.dumps(
                 globals()['bench_' + sys.argv[2]](**kwargs)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--smoke':
+        # CPU-friendly minutes-scale sweep: the dispatch micro-bench
+        # (steady-state host time per step — the fast-path metric) and
+        # a small LeNet entry, each in its own child process so the
+        # monitor registry is per-entry.  Baseline recorded in
+        # BENCH_fastpath_smoke.json.
+        for name, kwargs in SMOKE_BENCHES:
+            _run_entry(name, kwargs, timeout=600)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--all':
         # secondary configs (BASELINE.json 0,2,3,4); the driver contract

@@ -12,6 +12,8 @@ Scope keeps the reference's name->Variable contract with parent-chain lookup
 so executors, save/load and the fleet API work unchanged.
 """
 
+import weakref
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -201,17 +203,31 @@ class SelectedRows(object):
 # ---------------------------------------------------------------------------
 
 
+_ERASED = object()  # pop sentinel for Scope.erase
+
+
 class Scope(object):
     """name -> value map with parent-chain lookup and child scopes.
 
     Reference: framework/scope.h:46 (Var/FindVar/kids).  Values are
     jax.Array, numpy arrays, LoDTensor or SelectedRows.
+
+    The scope is VERSIONED for the executor's steady-state fast path:
+    `_struct_version` counts STRUCTURAL mutations only — a name
+    appearing in or leaving this scope's own dict — and overwriting an
+    existing name (the per-step device write-back of segment outputs)
+    does not bump it.  Segment argument binders cache which scope dict
+    owns each variable name and revalidate against `_chain_token()`, so
+    the per-step state/data bind is one dict read per name instead of a
+    parent-chain walk: device-resident values (jax.Array segment
+    outputs) flow between consecutive segments and steps by pointer.
     """
 
     def __init__(self, parent=None):
         self._vars = {}
         self.parent = parent
         self.kids = []
+        self._struct_version = 0
 
     def new_scope(self):
         kid = Scope(self)
@@ -221,9 +237,12 @@ class Scope(object):
     def var(self, name):
         if name not in self._vars:
             self._vars[name] = None
+            self._struct_version += 1
         return name
 
     def set_var(self, name, value):
+        if name not in self._vars:
+            self._struct_version += 1
         self._vars[name] = value
 
     def find_var(self, name):
@@ -243,13 +262,38 @@ class Scope(object):
         return False
 
     def erase(self, name):
-        self._vars.pop(name, None)
+        if self._vars.pop(name, _ERASED) is not _ERASED:
+            self._struct_version += 1
 
     def local_var_names(self):
         return list(self._vars.keys())
 
     def drop_kids(self):
         self.kids = []
+
+    # ---- fast-path binding surface (executor._SegmentBinder) --------
+    def _owner_vars(self, name):
+        """The `_vars` dict along the parent chain that holds `name`,
+        or None.  Binders cache this dict so steady-state reads skip
+        the chain walk; validity is guarded by `_chain_token()`."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars
+            s = s.parent
+        return None
+
+    def _chain_token(self):
+        """Structural version summed over the parent chain.  A cached
+        owner-dict resolution is valid while this token is unchanged:
+        value overwrites keep the token, so per-step output write-back
+        never invalidates a binder."""
+        t = 0
+        s = self
+        while s is not None:
+            t += s._struct_version
+            s = s.parent
+        return t
 
 
 _global_scope = Scope()
@@ -284,3 +328,46 @@ def as_array(value):
     if isinstance(value, SelectedRows):
         return value.to_dense()
     return value
+
+
+# ---------------------------------------------------------------------------
+# Device-buffer ownership registry
+# ---------------------------------------------------------------------------
+# Arrays the RUNTIME created and never exposed to the caller (the
+# executor's per-step feed staging) are safe to hand to a jitted
+# segment as donated state: no caller holds them, so invalidating the
+# buffer is invisible.  Reader-staged batches do NOT qualify — the
+# batch dict is returned to user code.  A
+# jax.Array the CALLER fed must never be donated — the executor copies
+# it instead.  This registry turns that per-step defensive copy into a
+# once-per-buffer membership check: jax.Array identity keyed by id()
+# with a weakref finalizer, so entries die with the buffer and a
+# recycled address can never alias a stale claim.
+
+_owned_buffers = {}
+
+
+def mark_owned(arr):
+    """Record `arr` as runtime-created (donation-safe).  No-op for
+    values that don't support weakrefs (numpy scalars etc.)."""
+    i = id(arr)
+    try:
+        _owned_buffers[i] = weakref.ref(
+            arr, lambda _r, _i=i: _owned_buffers.pop(_i, None))
+    except TypeError:
+        pass
+    return arr
+
+
+def is_owned(arr):
+    """True iff `arr` is the SAME object previously mark_owned()ed."""
+    r = _owned_buffers.get(id(arr))
+    return r is not None and r() is arr
+
+
+def disown(arr):
+    """Withdraw a mark_owned() claim: `arr` has grown a second
+    consumer (another segment, the scope), so donating it by pointer
+    would invalidate that consumer — binders fall back to the copy."""
+    _owned_buffers.pop(id(arr), None)
+    return arr

@@ -18,6 +18,7 @@ Host ops (feed/fetch/save/load/print) cut segments and run on the host.
 """
 
 import time as _time_mod
+import weakref
 
 import numpy as np
 import jax
@@ -43,7 +44,8 @@ def _stat_nbytes(v):
 
 class _Segment(object):
     __slots__ = ('ops', 'input_names', 'state_names', 'output_names',
-                 'compiled', 'bucket_ops', 'prefer_test')
+                 'compiled', 'bucket_ops', 'prefer_test', 'binder',
+                 'pbinder')
 
     def __init__(self, ops):
         self.ops = ops
@@ -58,6 +60,231 @@ class _Segment(object):
         # executables keyed by (auto_layout_flag, per-op bucket sizes)
         self.compiled = {}
         self.prefer_test = False
+        # steady-state argument binders (built lazily at first run):
+        # `binder` serves the single-device executor (staged feeds),
+        # `pbinder` the parallel/collective runners (raw feeds)
+        self.binder = None
+        self.pbinder = None
+
+
+class _Plan(list):
+    """An execution plan: _Segment | ('host', op) | ('bucket', op)
+    items, plus plan-level precomputation.  `device_feed_names` is the
+    union of every segment's state/input names (and bucket-count
+    reads): only feeds in it are staged onto the device — a feed read
+    exclusively by host ops must stay host-side, or it would cross to
+    the device and straight back every step.  `donatable_feed_names`
+    are the fed STATE names with exactly ONE consumer in the plan (and
+    no host/bucket items keeping feeds visible in the scope): only
+    those may be donated by pointer — any shared buffer must be copied
+    before donation or a later consumer reads a deleted array."""
+
+    __slots__ = ('device_feed_names', 'donatable_feed_names')
+
+
+class _BindTable(object):
+    """Bindings of one (segment, feed keyset): which argument names
+    come from the feed dict, and — for scope-sourced names — WHICH
+    scope dict owns each one.  Owner dicts are resolved once and
+    revalidated against the scope's structural chain token, so the
+    steady-state bind never walks the scope parent chain."""
+
+    __slots__ = ('state_feed', 'data_feed', 'state_scope', 'data_scope',
+                 'scope_ref', 'token', 'state_slots', 'data_slots')
+
+    def __init__(self, seg, keyset):
+        self.state_feed = tuple(n for n in seg.state_names
+                                if n in keyset)
+        self.data_feed = tuple(n for n in seg.input_names if n in keyset)
+        self.state_scope = tuple(n for n in seg.state_names
+                                 if n not in keyset)
+        self.data_scope = tuple(n for n in seg.input_names
+                                if n not in keyset)
+        self.scope_ref = None
+        self.token = -1
+        self.state_slots = ()
+        self.data_slots = ()
+
+
+def _uninitialized(name):
+    return RuntimeError(
+        'Variable %s is not initialized: feed it or run the startup '
+        'program first' % name)
+
+
+# concrete device-array class for hot-loop type checks: `type(v) is
+# _ArrayImpl` costs ~60ns where `isinstance(v, jax.Array)` pays the
+# ABC __instancecheck__ (~1us) — per name per step, that dominates the
+# bind at a few hundred parameters
+try:
+    from jax._src.array import ArrayImpl as _ArrayImpl
+except Exception:  # pragma: no cover - jax internals moved
+    _ArrayImpl = jax.Array
+
+_process_default_device = None
+
+
+def _is_default_device(device):
+    """True iff entering jax.default_device(device) would be a no-op:
+    `device` is already where jax places un-pinned computations.  The
+    context costs ~0.1 ms per jit call on the dispatch path, so the
+    steady-state run loop skips it whenever it cannot matter."""
+    cfg = jax.config.jax_default_device
+    if cfg is not None:
+        return cfg == device
+    global _process_default_device
+    if _process_default_device is None:
+        _process_default_device = jax.devices()[0]
+    return device == _process_default_device
+
+
+def _normalize_feed_value(v):
+    """The `_lookup_input` feed conversion, as a standalone step for
+    binders fed RAW (un-staged) feed dicts."""
+    if isinstance(v, core.LoDTensor):
+        v = v.data
+    if isinstance(v, jax.Array):
+        return v
+    return np.asarray(v)
+
+
+class _SegmentBinder(object):
+    """Per-(plan, segment) argument binder — the steady-state fast
+    path's core.  At first use per feed keyset it precompiles the
+    name->source split (feed vs scope) and resolves scope names to
+    their owning `_vars` dicts; each later step binds `state`/`data`
+    with one dict read per name — no per-step dict comprehensions over
+    `_lookup_input`, no isinstance chains for device-resident values,
+    no scope parent-chain walks.  Donated-state safety is a
+    once-per-buffer ownership check (core.mark_owned/is_owned) instead
+    of an unconditional per-step device copy."""
+
+    __slots__ = ('_seg', '_tables', '_raw_feed')
+
+    _EMPTY = frozenset()
+
+    def __init__(self, seg, raw_feed=False):
+        self._seg = seg
+        self._tables = {}
+        self._raw_feed = raw_feed
+
+    def _resolve(self, tab, scope):
+        """Slow path: walk the scope chain once per name and cache the
+        owning dicts; counted so tools/check_hot_path.py can assert the
+        steady state never comes back here."""
+        for names, slot_attr in ((tab.state_scope, 'state_slots'),
+                                 (tab.data_scope, 'data_slots')):
+            slots = []
+            for n in names:
+                owner = scope._owner_vars(n)
+                if owner is None:
+                    raise _uninitialized(n)
+                slots.append((n, owner))
+            setattr(tab, slot_attr, tuple(slots))
+        tab.scope_ref = weakref.ref(scope)
+        tab.token = scope._chain_token()
+        monitor.add('executor/scope_lookups',
+                    float(len(tab.state_scope) + len(tab.data_scope)))
+
+    def bind(self, feed, scope, donate_feed_state=True):
+        """One step's (state, data) argument dicts for the segment."""
+        t0 = _time_mod.perf_counter()
+        keyset = frozenset(feed) if feed else self._EMPTY
+        tab = self._tables.get(keyset)
+        if tab is None:
+            tab = self._tables[keyset] = _BindTable(self._seg, keyset)
+        ref = tab.scope_ref
+        if ref is not None and ref() is scope and \
+                tab.token == scope._chain_token():
+            monitor.add('executor/fastpath_hits')
+        else:
+            self._resolve(tab, scope)
+        state = {}
+        data = {}
+        for out, slots in ((state, tab.state_slots),
+                           (data, tab.data_slots)):
+            for n, owner in slots:
+                v = owner[n]
+                if type(v) is _ArrayImpl:
+                    out[n] = v       # device-resident: pointer-passing
+                elif v is None:
+                    raise _uninitialized(n)
+                elif isinstance(v, jax.Array):
+                    out[n] = v       # exotic array subclass
+                else:
+                    out[n] = core.as_array(v)
+        raw = self._raw_feed
+        for n in tab.state_feed:
+            v = feed[n]
+            if raw:
+                v = _normalize_feed_value(v)
+            if donate_feed_state and isinstance(v, jax.Array) and \
+                    not core.is_owned(v):
+                # state buffers are donated to the jitted step; a
+                # CALLER-owned fed array must survive it — copy.
+                # Runtime-staged buffers (is_owned) pass by pointer.
+                v = jax.numpy.array(v, copy=True)
+            state[n] = v
+        for n in tab.data_feed:
+            v = feed[n]
+            data[n] = _normalize_feed_value(v) if raw else v
+        monitor.observe('executor/bind_seconds',
+                        _time_mod.perf_counter() - t0)
+        return state, data
+
+
+class FetchHandle(object):
+    """A fetch resolving asynchronously (`return_numpy='async'`): the
+    device->host copy is REQUESTED at construction without blocking
+    dispatch of the next step; `as_numpy()` blocks on it.
+    `np.asarray(handle)` also resolves it.  The handle holds the live
+    device buffer, not a snapshot: resolve it BEFORE running a step
+    that donates the fetched variable (e.g. fetching a parameter the
+    next step updates in place), or resolution fails on the deleted
+    buffer."""
+
+    __slots__ = ('_val', '_np', '_resolver')
+
+    def __init__(self, val, resolver=None):
+        val = core.as_array(val)
+        self._val = val
+        self._np = None
+        self._resolver = resolver
+        if isinstance(val, jax.Array):
+            try:
+                val.copy_to_host_async()
+            except Exception:
+                pass  # non-prefetchable array kinds: as_numpy still works
+
+    @property
+    def value(self):
+        """The raw device-side value, unresolved."""
+        return self._val
+
+    def as_numpy(self):
+        if self._np is None:
+            t0 = _time_mod.perf_counter()
+            try:
+                if self._resolver is not None:
+                    self._np = self._resolver(self._val)
+                else:
+                    self._np = np.asarray(self._val)
+            except RuntimeError as e:
+                if 'deleted' in str(e).lower():
+                    raise RuntimeError(
+                        'async fetch resolved after its buffer was '
+                        'donated: a later step updated this variable '
+                        'in place.  Call as_numpy() before running a '
+                        'step that donates the fetched var, or fetch '
+                        'with return_numpy=True.') from e
+                raise
+            monitor.observe('executor/fetch_blocked_seconds',
+                            _time_mod.perf_counter() - t0)
+        return self._np
+
+    def __array__(self, dtype=None):
+        arr = self.as_numpy()
+        return arr.astype(dtype) if dtype is not None else arr
 
 
 def _op_reads(op):
@@ -731,9 +958,15 @@ class CompiledPipeline(object):
         scope = scope or core.global_scope()
         exe = self._exe
         exe._step += 1
+        t0 = _time_mod.perf_counter()
         out = exe._run_plan(self._program, self._plan, feed or {},
                             self.fetch_names, scope, return_numpy)
         exe._post_step(self._program, scope)
+        # same instrumentation as Executor.run: this is the other
+        # per-step entry point, monitor dumps must cover both
+        monitor.add('executor/run_calls')
+        monitor.observe('executor/run_seconds',
+                        _time_mod.perf_counter() - t0)
         return out
 
 
@@ -853,6 +1086,13 @@ class Executor(object):
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, feed_var_name='feed',
             fetch_var_name='fetch'):
+        """Run one step.  `return_numpy` accepts True (block and
+        convert each fetch), False (raw device values), or 'async'
+        (FetchHandle per fetch: the D2H copy starts immediately but
+        resolution blocks only at as_numpy()).  use_program_cache=False
+        bypasses the program's plan cache: the plan (and its segment
+        executables) is rebuilt for this call — the reference's
+        uncached Executor.run semantics, paid in recompiles."""
         from .compiler import CompiledProgram
         from .parallel_executor import run_parallel, run_collective
         if isinstance(program, CompiledProgram):
@@ -869,7 +1109,8 @@ class Executor(object):
                        for v in fetch_list]
 
         plan = self._get_plan(program, tuple(sorted(feed.keys())),
-                              tuple(fetch_names))
+                              tuple(fetch_names),
+                              use_cache=use_program_cache)
         self._step += 1
         t0 = _time_mod.perf_counter()
         out = self._run_plan(program, plan, feed, fetch_names, scope,
@@ -951,12 +1192,24 @@ class Executor(object):
 
     # ------------------------------------------------------------------
     def _get_plan(self, program, feed_names, fetch_names,
-                  prefer_test=False):
+                  prefer_test=False, use_cache=True):
         from . import profiler as _profiler
         # per-op profiling compiles every device op as its own one-op
         # segment (separately cached), so each can be host-timed —
         # the reference's per-op RecordEvent granularity
         per_op = _profiler.is_enabled()
+        if not use_cache:
+            # use_program_cache=False: rebuild the plan for THIS call
+            # and leave program._exec_cache untouched (fresh segments,
+            # fresh executables — the uncached reference semantics)
+            monitor.add('executor/plan_cache_bypass')
+            plan = self._build_plan(program, feed_names, fetch_names,
+                                    per_op=per_op)
+            if prefer_test:
+                for it in plan:
+                    if isinstance(it, _Segment):
+                        it.prefer_test = True
+            return plan
         # prefer_test keys the cache so test-mode lowering never shares
         # executables with the training-mode plan
         key = ('plan', feed_names, fetch_names, id(self), prefer_test,
@@ -1092,7 +1345,35 @@ class Executor(object):
             item.input_names = inputs
             item.state_names = state
             item.output_names = sorted(outputs)
-        return items
+        plan = _Plan(items)
+        dev_names = set()
+        consume_count = {}
+        state_anywhere = set()
+        pure_segments = True
+        for it in items:
+            if isinstance(it, _Segment):
+                for n in set(it.state_names) | set(it.input_names):
+                    consume_count[n] = consume_count.get(n, 0) + 1
+                state_anywhere.update(it.state_names)
+                dev_names.update(it.state_names)
+                dev_names.update(it.input_names)
+            else:
+                pure_segments = False
+                if it[0] == 'bucket':
+                    # the host-side trip counter binds these through
+                    # _lookup_input; staged device values are fine there
+                    dev_names.update(_op_dep_reads(it[1]))
+        plan.device_feed_names = frozenset(dev_names)
+        # pointer-donation eligibility: a fed state buffer may only be
+        # donated un-copied when exactly ONE plan item consumes it and
+        # no host/bucket item exists (host plans publish feeds into the
+        # scope, which would keep a reference to the deleted buffer)
+        if pure_segments:
+            plan.donatable_feed_names = frozenset(
+                n for n in state_anywhere if consume_count.get(n) == 1)
+        else:
+            plan.donatable_feed_names = frozenset()
+        return plan
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -1139,21 +1420,65 @@ class Executor(object):
                         tainted.add(out)
                         changed = True
 
+    def _stage_feeds(self, program, plan, feed, device):
+        """Batch every host-side feed value through ONE async
+        jax.device_put ahead of dispatch: device_put returns
+        immediately, so the H2D DMA overlaps the PREVIOUS step's
+        compute (and composes with the reader's staging window, whose
+        batches arrive here already device-resident and skip straight
+        through).  Feeds read only by host ops (outside the plan's
+        device_feed_names) stay host-side.  Staged buffers are
+        runtime-owned: binders may donate them without the defensive
+        per-step copy."""
+        if not feed:
+            return feed
+        device_names = getattr(plan, 'device_feed_names', None)
+        donatable = getattr(plan, 'donatable_feed_names', frozenset())
+        staged = {}
+        host_part = None
+        nbytes = 0.0
+        for k, v in feed.items():
+            if isinstance(v, core.LoDTensor):
+                if len(v.lod) >= 2:
+                    self._reject_multilevel_lod(program, k, len(v.lod))
+                v = v.data
+            monitor.add('executor/feed_bytes', _stat_nbytes(v))
+            if isinstance(v, jax.Array) or (
+                    device_names is not None and k not in device_names):
+                if k not in donatable and isinstance(v, jax.Array) \
+                        and core.is_owned(v):
+                    # a runtime-staged buffer (reader double-buffer)
+                    # reaching a plan where this name has several
+                    # consumers: withdraw the donation claim so the
+                    # binder copies before the first donate
+                    core.disown(v)
+                staged[k] = v
+                continue
+            a = np.asarray(v)
+            if host_part is None:
+                host_part = {}
+            host_part[k] = a
+            nbytes += float(a.nbytes)
+        monitor.add('executor/feed_vars', float(len(feed)))
+        if host_part:
+            put = jax.device_put(host_part, device)
+            monitor.add('executor/h2d_bytes_async', nbytes)
+            for k, a in put.items():
+                # pointer-donation claim only where the plan proves a
+                # single consumer (see _Plan.donatable_feed_names)
+                staged[k] = core.mark_owned(a) if k in donatable else a
+        return staged
+
     def _run_plan(self, program, plan, feed, fetch_names, scope,
                   return_numpy):
-        for k, v in feed.items():
-            if isinstance(v, core.LoDTensor) and len(v.lod) >= 2:
-                self._reject_multilevel_lod(program, k, len(v.lod))
-            monitor.add('executor/feed_bytes', _stat_nbytes(v))
-        monitor.add('executor/feed_vars', float(len(feed)))
         device = self.place.jax_device()
+        feed = self._stage_feeds(program, plan, feed, device)
         fetched = {}
         has_host = any(not isinstance(it, _Segment) for it in plan)
         if has_host:
             # host ops read vars through the scope; make feeds visible
             for k, v in feed.items():
-                scope.set_var(k, v.data if isinstance(v, core.LoDTensor)
-                              else v)
+                scope.set_var(k, v)
         prefer_test = any(isinstance(it, _Segment) and it.prefer_test
                           for it in plan)
         from . import profiler as _profiler
@@ -1190,29 +1515,33 @@ class Executor(object):
                 val = scope.find_var(name)
                 if val is None:
                     raise RuntimeError('fetch var %s not produced' % name)
+            # byte accounting on the DENSE value (SelectedRows expose
+            # nbytes only after densification)
             val = core.as_array(val)
             monitor.add('executor/fetch_bytes', _stat_nbytes(val))
-            results.append(np.asarray(val) if return_numpy else val)
+            if return_numpy == 'async':
+                # start the D2H copy now, block never: the handle
+                # resolves on as_numpy() while later steps dispatch
+                results.append(FetchHandle(val))
+                continue
+            if return_numpy:
+                t0 = _time_mod.perf_counter()
+                val = np.asarray(val)
+                monitor.observe('executor/fetch_blocked_seconds',
+                                _time_mod.perf_counter() - t0)
+            results.append(val)
         if fetch_names:
             monitor.add('executor/fetch_vars', float(len(fetch_names)))
         return results
 
     def _lookup_input(self, name, feed, scope):
+        """One-off argument lookup for the cold paths (program_cost,
+        bucket counting); the run loop binds through _SegmentBinder."""
         if name in feed:
-            val = feed[name]
-            if isinstance(val, core.LoDTensor):
-                val = val.data
-            if isinstance(val, jax.Array):
-                # device-resident feed: hand the buffer to jit as-is —
-                # np.asarray here would round-trip it through the host
-                # on every step
-                return val
-            return np.asarray(val)
+            return _normalize_feed_value(feed[name])
         val = scope.find_var(name)
         if val is None:
-            raise RuntimeError(
-                'Variable %s is not initialized: feed it or run the '
-                'startup program first' % name)
+            raise _uninitialized(name)
         return core.as_array(val)
 
     def _run_bucket_count(self, op, feed, scope, device,
@@ -1302,25 +1631,28 @@ class Executor(object):
         else:
             monitor.add('executor/segment_cache_hit')
 
-        state = {}
-        for n in seg.state_names:
-            v = self._lookup_input(n, feed, scope)
-            if n in feed and isinstance(v, jax.Array):
-                # state buffers are donated to the jitted step; donating
-                # a caller-owned fed array would invalidate it, so hand
-                # jit a fresh copy instead
-                v = jax.numpy.array(v, copy=True)
-            state[n] = v
-        data = {n: self._lookup_input(n, feed, scope)
-                for n in seg.input_names}
+        binder = seg.binder
+        if binder is None:
+            binder = seg.binder = _SegmentBinder(seg)
+        state, data = binder.bind(feed, scope)
         try:
             if first_run:
                 # the first call of a jitted segment traces + compiles
                 # synchronously (only execution is async), so timing it
                 # is the per-segment compile-latency histogram
                 t0 = _time_mod.perf_counter()
-            with jax.default_device(device):
+            if _is_default_device(device):
+                # `device` IS where jax would place this anyway, so the
+                # default_device context is a no-op — and it must be
+                # skipped CONSISTENTLY (first call included): a config
+                # context present on call 1 but absent on call 2 makes
+                # every later call miss jit's C++ fast path on the
+                # config mismatch and re-enter the python dispatch
+                # (~ms), which is exactly the host cost this path kills
                 out = compiled(self._step, state, data)
+            else:
+                with jax.default_device(device):
+                    out = compiled(self._step, state, data)
             if first_run:
                 monitor.observe('executor/segment_compile_seconds',
                                 _time_mod.perf_counter() - t0)
@@ -1330,19 +1662,34 @@ class Executor(object):
                 _add_note(e, note)
             raise
         if get_flag('FLAGS_check_nan_inf'):
-            # reference: CheckVarHasNanOrInf per-op sweep
-            # (framework/details/nan_inf_utils.h:28) — here per segment
-            # output, which is where values become observable
-            for n, v in out.items():
-                arr = np.asarray(core.as_array(v))
-                if np.issubdtype(arr.dtype, np.floating) and \
-                        not np.isfinite(arr).all():
-                    raise FloatingPointError(
-                        'nan/inf detected in var %s (step %d)'
-                        % (n, self._step))
+            self._check_nan_inf(out)
         for n, v in out.items():
             scope.set_var(n, v)
             fetched[n] = v
+
+    def _check_nan_inf(self, out):
+        """Reference: CheckVarHasNanOrInf per-op sweep
+        (framework/details/nan_inf_utils.h:28) — here per segment
+        output, which is where values become observable.  The isfinite
+        reduction runs ON DEVICE; only the per-var scalar verdict
+        crosses to the host (the old path np.asarray'd every full
+        output tensor every step).  All reductions dispatch before the
+        first verdict blocks, so the device sweeps them in one wave."""
+        import jax.numpy as jnp
+        verdicts = []
+        for n, v in out.items():
+            if isinstance(v, jax.Array):
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    verdicts.append((n, jnp.isfinite(v).all()))
+            else:
+                arr = np.asarray(core.as_array(v))
+                if np.issubdtype(arr.dtype, np.floating):
+                    verdicts.append((n, np.isfinite(arr).all()))
+        for n, ok in verdicts:
+            if not bool(ok):
+                raise FloatingPointError(
+                    'nan/inf detected in var %s (step %d)'
+                    % (n, self._step))
 
 
 def _as_numpy(v):

@@ -18,7 +18,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
 from . import monitor
-from .executor import _Segment, _make_segment_fn, _add_note
+from .executor import (_Segment, _SegmentBinder, FetchHandle,
+                       _make_segment_fn, _add_note)
+
+
+def _bind_segment_args(seg, feed, scope):
+    """Steady-state (state, data) bind for the parallel runners: the
+    same precompiled binder tables the single-device executor uses
+    (raw feeds — the runners do their own sharding-aware device
+    placement downstream, so no donation copy here either)."""
+    binder = seg.pbinder
+    if binder is None:
+        binder = seg.pbinder = _SegmentBinder(seg, raw_feed=True)
+    return binder.bind(feed, scope, donate_feed_state=False)
+
+
+def _resolve_fetch(val, return_numpy):
+    if return_numpy == 'async':
+        return FetchHandle(val, resolver=_fetch_to_host)
+    return _fetch_to_host(val) if return_numpy else val
 
 
 def _default_mesh(places=None):
@@ -201,6 +219,7 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     ndev = mesh.devices.size
     monitor.set_gauge('parallel/device_count', ndev)
     monitor.set_gauge('parallel/process_count', jax.process_count())
+    t_run0 = _time_mod.perf_counter()
 
     key = ('pplan', tuple(sorted(feed.keys())), tuple(fetch_names))
     plan = compiled._exec_cache.get(key)
@@ -258,7 +277,12 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
         val = fetched.get(name)
         if val is None:
             val = core.as_array(scope.find_var(name))
-        results.append(_fetch_to_host(val) if return_numpy else val)
+        results.append(_resolve_fetch(val, return_numpy))
+    # dispatch-side wall time: this runner is an Executor.run entry
+    # point too (CompiledProgram path), so it records the same counters
+    monitor.add('executor/run_calls')
+    monitor.observe('executor/run_seconds',
+                    _time_mod.perf_counter() - t_run0)
     return results
 
 
@@ -287,10 +311,7 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
                 return NamedSharding(mesh, spec)
         return repl
 
-    state = {n: executor._lookup_input(n, feed, scope)
-             for n in seg.state_names}
-    data = {n: executor._lookup_input(n, feed, scope)
-            for n in seg.input_names}
+    state, data = _bind_segment_args(seg, feed, scope)
     # pin state shardings by resharding the inputs (device_put is a
     # no-op when the array already matches); outputs inherit XLA's
     # propagated shardings and flow back here next step
@@ -365,6 +386,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
         program._exec_cache[key] = plan
 
     executor._step += 1
+    t_run0 = _time_mod.perf_counter()
     fetched = {}
     batch_feeds = _batch_feed_names(program, feed)
     if any(not isinstance(it, _Segment) for it in plan):
@@ -379,10 +401,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
             registry.get(item[1].type).fn(executor, scope, item[1])
             continue
         seg = item
-        state = {n: executor._lookup_input(n, feed, scope)
-                 for n in seg.state_names}
-        data = {n: executor._lookup_input(n, feed, scope)
-                for n in seg.input_names}
+        state, data = _bind_segment_args(seg, feed, scope)
         data_specs = {n: (P('dp') if (n in feed and n in batch_feeds and
                                       getattr(data[n], 'ndim', 0) >= 1 and
                                       (jax.process_count() == 1 or
@@ -444,7 +463,10 @@ def run_collective(executor, program, feed, fetch_list, scope,
         val = fetched.get(name)
         if val is None:
             val = _core.as_array(scope.find_var(name))
-        results.append(_fetch_to_host(val) if return_numpy else val)
+        results.append(_resolve_fetch(val, return_numpy))
+    monitor.add('executor/run_calls')
+    monitor.observe('executor/run_seconds',
+                    _time_mod.perf_counter() - t_run0)
     return results
 
 

@@ -1,0 +1,124 @@
+"""Hot-path regression gate: the steady-state step fast path must stay
+fast (the runtime analog of check_stat_coverage.py's static audit).
+
+Runs a small TWO-SEGMENT program (device segment -> py_func host op ->
+device segment) for a handful of steps with device-resident feeds and
+async fetches, then checks the per-step monitor counters of the
+POST-WARMUP window against budgets:
+
+  - executor/scope_lookups      == 0   (every bind hits the cached
+                                        owner tables; a regression that
+                                        re-walks the scope per step
+                                        shows up here first)
+  - executor/fastpath_hits      == steps * segments
+  - executor/h2d_bytes_async    == 0   (feeds are device-resident;
+                                        a defensive re-copy of state or
+                                        feed data would reappear here)
+  - executor/fetch_blocked_seconds count == 0 for the unresolved-async
+                                        window (dispatch never blocks
+                                        on D2H)
+  - executor/bind_seconds mean  <  BIND_BUDGET_S (generous wall budget
+                                        for the flat bind loop itself)
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import os
+import sys
+
+BIND_BUDGET_S = float(os.environ.get('PADDLE_TPU_BIND_BUDGET_S', 0.005))
+WARMUP = 3
+STEPS = 8
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import numpy as np
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, monitor
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main_p, startup):
+        x = layers.data('x', shape=[16], dtype='float32')
+        h = layers.fc(x, 16, act='relu')
+        mid = main_p.current_block().create_var(
+            name='hot_mid', shape=[-1, 16], dtype='float32')
+        layers.py_func(lambda a: a, h, mid)   # host op: cuts 2 segments
+        h2 = layers.fc(mid, 8, act='relu')
+        loss = layers.reduce_mean(h2)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    xs = jax.device_put(
+        np.random.RandomState(0).randn(8, 16).astype('float32'))
+    failures = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        # warm up the SAME call signature as the timed window (fetch
+        # set keys the plan; a different signature would compile and
+        # resolve binders inside the window)
+        for _ in range(WARMUP):
+            w_, = exe.run(main_p, feed={'x': xs}, fetch_list=[loss],
+                          return_numpy='async')
+            w_.as_numpy()
+        f0 = monitor.flat()
+        handles = []
+        for _ in range(STEPS):
+            h_, = exe.run(main_p, feed={'x': xs}, fetch_list=[loss],
+                          return_numpy='async')
+            handles.append(h_)
+        f1 = monitor.flat()
+        # resolution correctness stays part of the gate: every handle
+        # must produce a finite loss once resolved
+        vals = [float(np.asarray(h_).ravel()[0]) for h_ in handles]
+        if not np.isfinite(vals).all():
+            failures.append('async fetches resolved non-finite: %r'
+                            % (vals,))
+
+    def delta(key):
+        return f1.get(key, 0.0) - f0.get(key, 0.0)
+
+    n_segments = 2
+    checks = [
+        ('executor/scope_lookups per step', delta('executor/scope_lookups'),
+         0.0),
+        ('executor/h2d_bytes_async per step',
+         delta('executor/h2d_bytes_async'), 0.0),
+        ('executor/fetch_blocked_seconds count (pre-resolve)',
+         delta('executor/fetch_blocked_seconds/count'), 0.0),
+    ]
+    for name, got, budget in checks:
+        if got > budget:
+            failures.append('%s regressed: %g (budget %g)'
+                            % (name, got, budget))
+    hits = delta('executor/fastpath_hits')
+    want_hits = STEPS * n_segments
+    if hits != want_hits:
+        failures.append('executor/fastpath_hits: %g, expected %d '
+                        '(every steady-state bind must hit the cached '
+                        'tables)' % (hits, want_hits))
+    bind_n = delta('executor/bind_seconds/count')
+    bind_s = delta('executor/bind_seconds/sum')
+    if bind_n and bind_s / bind_n > BIND_BUDGET_S:
+        failures.append('executor/bind_seconds mean %.6fs exceeds '
+                        'budget %.6fs' % (bind_s / bind_n,
+                                          BIND_BUDGET_S))
+    print('hot path: %d steps x %d segments, %g fastpath hits, '
+          '%.1fus mean bind, %g B async H2D'
+          % (STEPS, n_segments, hits,
+             1e6 * bind_s / max(bind_n, 1),
+             delta('executor/h2d_bytes_async')))
+    if failures:
+        for f in failures:
+            print('HOT-PATH REGRESSION  ' + f)
+        return 1
+    print('hot path: within budget')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

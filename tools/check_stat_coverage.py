@@ -24,6 +24,15 @@ REQUIRED = [
     ('paddle_tpu/fluid/executor.py', 'executor/fetch_bytes'),
     ('paddle_tpu/fluid/executor.py', 'executor/run_seconds'),
     ('paddle_tpu/fluid/executor.py', 'executor/host_ops_run'),
+    # steady-state fast path (PR 2): binder cache behavior, batched
+    # async H2D, blocked fetch time — tools/check_hot_path.py budgets
+    # these per step
+    ('paddle_tpu/fluid/executor.py', 'executor/fastpath_hits'),
+    ('paddle_tpu/fluid/executor.py', 'executor/scope_lookups'),
+    ('paddle_tpu/fluid/executor.py', 'executor/bind_seconds'),
+    ('paddle_tpu/fluid/executor.py', 'executor/h2d_bytes_async'),
+    ('paddle_tpu/fluid/executor.py', 'executor/fetch_blocked_seconds'),
+    ('paddle_tpu/fluid/executor.py', 'executor/plan_cache_bypass'),
     # data-parallel / collective runners
     ('paddle_tpu/fluid/parallel_executor.py', 'parallel/device_count'),
     ('paddle_tpu/fluid/parallel_executor.py',
