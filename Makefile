@@ -46,11 +46,13 @@ bench:
 	python bench.py
 
 # gates: the monitor instrument points the observability contract
-# depends on must stay in the source, and the steady-state step fast
-# path must stay within its per-step counter budgets
+# depends on must stay in the source, the steady-state step fast
+# path must stay within its per-step counter budgets, and the
+# persistent compile cache must carry executables across processes
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
+	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -15,6 +15,11 @@ import time
 
 import numpy as np
 
+# process birth, as close as a module can observe it: --cold children
+# measure start->first-step from here (python+import cost included —
+# that IS part of a service replica's restart latency)
+_PROC_T0 = time.time()
+
 
 def _enable_compile_cache():
     import jax
@@ -624,6 +629,115 @@ def bench_dispatch(depth=6, width=8, batch=4, steps=300, warmup=8):
                 **_monitor_fields())
 
 
+def bench_cold_lenet(batch=64, steps=5, use_warmup=False):
+    """--cold child: process-start -> first-train-step-complete wall
+    time for LeNet (the metric a restarting/autoscaling service
+    replica pays).  With FLAGS_compile_cache_dir set (the parent sets
+    it), the first process populates the persistent segment store and
+    the second starts from it; `use_warmup` additionally issues
+    Executor.warmup right after the startup program so segment
+    compilation (or disk loading) overlaps host-side setup."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import monitor
+    from paddle_tpu import models
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(batch, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (batch, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        if use_warmup:
+            exe.warmup(main, feed_shapes=feed, fetch_list=[loss])
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        first_loss = float(np.asarray(l).ravel()[0])  # block: step is
+        t_first = time.time() - _PROC_T0              # COMPLETE
+        t0 = time.time()
+        for _ in range(steps - 1):
+            exe.run(main, feed=feed, fetch_list=[])
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        np.asarray(l)
+        steady = (time.time() - t0) / steps
+    flat = monitor.flat()
+    return {'metric': 'lenet_cold_start_to_first_step_s_b%d' % batch,
+            'value': round(t_first, 3), 'unit': 'seconds',
+            'steady_step_ms': round(steady * 1000, 2),
+            'first_loss': first_loss,
+            'compile_cache': {
+                short: flat.get('executor/' + key, 0.0)
+                for short, key in (
+                    ('disk_hit', 'compile_cache_disk_hit'),
+                    ('disk_miss', 'compile_cache_disk_miss'),
+                    ('disk_writes', 'compile_cache_disk_writes'),
+                    ('aot_compiles', 'aot_compiles'),
+                    ('segments_lowered', 'segments_lowered'),
+                    ('warmup_segments', 'warmup_segments'))}}
+
+
+def _run_cold(cache_dir=None, out_path=None):
+    """--cold driver: run bench_cold_lenet in three child processes
+    against one FRESH private temp dir — cold (populates), warm
+    (loads), and warm+warmup (loads in the background) — and print one
+    JSON line per child plus a summary.  The bench NEVER touches
+    PADDLE_TPU_COMPILE_CACHE_DIR / FLAGS_compile_cache_dir: 'cold'
+    must mean cold, and wiping a user's shared production cache to get
+    there is not this tool's call."""
+    import shutil
+    import subprocess
+    import tempfile
+    cleanup = cache_dir is None
+    d = cache_dir or tempfile.mkdtemp(prefix='paddle_tpu_cold_')
+    os.makedirs(d, exist_ok=True)
+    results = {}
+    for tag, kwargs in (('cold', {}), ('warm', {}),
+                        ('warm_warmup', {'use_warmup': True})):
+        env = dict(os.environ, FLAGS_compile_cache_dir=d)
+        p = subprocess.run(
+            [sys.executable, '-u', os.path.abspath(__file__), '--one',
+             'cold_lenet', json.dumps(kwargs)],
+            capture_output=True, text=True, timeout=900, env=env)
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith('{')]
+        if not line:
+            sys.stderr.write('cold child %s failed (rc=%d): %s\n'
+                             % (tag, p.returncode, p.stderr[-300:]))
+            continue
+        rec = json.loads(line[-1])
+        rec['phase'] = tag
+        results[tag] = rec
+        print(json.dumps(rec))
+    if 'cold' in results and 'warm' in results:
+        summary = {
+            'metric': 'lenet_cold_vs_warm_start_s',
+            'cold_s': results['cold']['value'],
+            'warm_s': results['warm']['value'],
+            'warm_warmup_s': results.get('warm_warmup',
+                                         {}).get('value'),
+            'speedup': round(results['cold']['value'] /
+                             max(results['warm']['value'], 1e-9), 2),
+            'warm_disk_hits':
+                results['warm']['compile_cache']['disk_hit'],
+            'warm_retraces':
+                results['warm']['compile_cache']['segments_lowered'],
+        }
+        print(json.dumps(summary))
+        if out_path:
+            with open(out_path, 'w') as f:
+                json.dump({'entries': list(results.values()),
+                           'summary': summary}, f, indent=1,
+                          sort_keys=True)
+        if cleanup:
+            shutil.rmtree(d, ignore_errors=True)
+        return summary
+    if cleanup:
+        shutil.rmtree(d, ignore_errors=True)
+    return None
+
+
 SMOKE_BENCHES = (('dispatch', {}),
                  ('lenet', {'batch': 64, 'steps': 30}))
 
@@ -696,6 +810,13 @@ def main():
         else:
             print(json.dumps(
                 globals()['bench_' + sys.argv[2]](**kwargs)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--cold':
+        # process-restart latency: cold (populate the persistent
+        # compile cache) vs warm (start from it) vs warm+warmup.
+        # Baseline recorded in BENCH_compile_cache.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else None
+        _run_cold(out_path=out)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--smoke':
         # CPU-friendly minutes-scale sweep: the dispatch micro-bench

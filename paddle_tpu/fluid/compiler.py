@@ -77,7 +77,8 @@ class CompiledProgram(object):
         self._loss_name = None
         self._places = None
         self._share_vars_from = None
-        self._exec_cache = {}
+        from .framework import _new_exec_cache
+        self._exec_cache = _new_exec_cache()
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,

@@ -23,6 +23,7 @@ import weakref
 import numpy as np
 import jax
 
+from . import compile_cache
 from . import core
 from . import framework
 from . import monitor
@@ -57,8 +58,14 @@ class _Segment(object):
         self.bucket_ops = [op for op in ops
                            if op.attrs.get('__bucket_group__')
                            is not None]
-        # executables keyed by (auto_layout_flag, per-op bucket sizes)
-        self.compiled = {}
+        # executables: LRU keyed by the lowering-flag tuple (+ bucket
+        # sizes, + per-shape AOT spec keys when the compile plane is
+        # on) — bucketing/re-tracing would otherwise grow this without
+        # bound in a long-running service
+        from .flags import get_flag
+        self.compiled = compile_cache.LRUCache(
+            lambda: get_flag('FLAGS_segment_cache_capacity', 32),
+            'executor/segment_cache_evictions')
         self.prefer_test = False
         # steady-state argument binders (built lazily at first run):
         # `binder` serves the single-device executor (staged feeds),
@@ -905,6 +912,83 @@ def _jit_segment(segment, auto_layout=False, whole_program_grad=False):
     return jax.jit(fn, donate_argnums=(1,))
 
 
+def _lowering_flag_items(prefer_test, wpg, auto=False):
+    """The flag values that change a segment's lowering — exactly the
+    set the in-memory executable key already guards — as a fingerprint
+    component."""
+    from .flags import get_flag
+    return (bool(prefer_test), bool(wpg), bool(auto),
+            str(get_flag('FLAGS_conv_precision', 'highest')))
+
+
+def _step_spec():
+    import numpy as _np
+    return jax.ShapeDtypeStruct((), _np.int32)
+
+
+def _aot_build(seg, wpg, state_specs, data_specs, device=None):
+    """Trace + XLA-compile one segment ahead of time for concrete
+    boundary specs: ``jax.jit(fn).lower(specs).compile()``.  The
+    returned executable is called exactly like the lazily-jitted one
+    (python-int step and numpy args are accepted), but the compile has
+    already happened — and the lowering can run on a background thread.
+    `device` pins the executable to the executor's place (the lazily-
+    jitted path compiles inside jax.default_device(device); the AOT
+    build must match or a non-default-place executor would get a
+    device-0 executable).  Returns (compiled, out_specs) for the
+    plane's disk entry."""
+    import contextlib
+    import numpy as _np
+    t0 = _time_mod.perf_counter()
+    fn = _make_segment_fn(seg, seg.prefer_test, whole_program_grad=wpg)
+    ctx = contextlib.nullcontext() if (
+        device is None or _is_default_device(device)) \
+        else jax.default_device(device)
+    with ctx:
+        lowered = jax.jit(fn, donate_argnums=(1,)).lower(
+            _step_spec(), state_specs, data_specs)
+        out_info = lowered.out_info
+        compiled = lowered.compile()
+    monitor.add('executor/aot_compiles')
+    monitor.add('executor/segments_lowered')
+    monitor.observe('executor/segment_compile_seconds',
+                    _time_mod.perf_counter() - t0)
+    out_specs = {n: (tuple(int(s) for s in v.shape),
+                     _np.dtype(v.dtype).str)
+                 for n, v in out_info.items()}
+    return compiled, out_specs
+
+
+def _specs_from_args(state, data):
+    """ShapeDtypeStruct pytrees mirroring bound (state, data) dicts."""
+    import numpy as _np
+
+    def spec(v):
+        return jax.ShapeDtypeStruct(
+            tuple(int(s) for s in getattr(v, 'shape', ())),
+            compile_cache.canonical_dtype(
+                getattr(v, 'dtype', _np.float32)))
+
+    return ({n: spec(v) for n, v in state.items()},
+            {n: spec(v) for n, v in data.items()})
+
+
+try:
+    from jax.core import Tracer as _Tracer
+except Exception:  # pragma: no cover - jax internals moved
+    _Tracer = ()
+
+
+def _any_tracer(step, state, data):
+    if isinstance(step, _Tracer):
+        return True
+    for d in (state, data):
+        for v in d.values():
+            if isinstance(v, _Tracer):
+                return True
+    return False
+
+
 class CompiledStep(object):
     """A program compiled to one jittable callable — the public
     'compile program -> function' surface (the reference's
@@ -914,18 +998,59 @@ class CompiledStep(object):
     fn(step, state, data) -> {output_name: array}; `state` holds the
     in-place-updated names (parameters, optimizer slots), `data` the
     pure inputs.  The function is pure and jit/grad/shard-compatible.
-    """
 
-    __slots__ = ('fn', 'input_names', 'state_names', 'output_names')
+    Concrete calls dispatch through a compile-plane-shared jit (no
+    donation — caller-owned state must survive): repeated calls never
+    re-trace, a SECOND CompiledStep of a content-identical program
+    reuses the first one's jit object (fingerprint-keyed,
+    compile_cache.py), and with FLAGS_compile_cache_dir the XLA
+    compile itself persists across processes.  Called under an outer
+    trace (jit/grad/vmap) it degrades to the raw traceable `fn`, so
+    composability is unchanged."""
 
-    def __init__(self, fn, input_names, state_names, output_names):
+    __slots__ = ('fn', 'input_names', 'state_names', 'output_names',
+                 '_jitted')
+
+    def __init__(self, fn, input_names, state_names, output_names,
+                 jitted=None):
         self.fn = fn
         self.input_names = list(input_names)
         self.state_names = list(state_names)
         self.output_names = list(output_names)
+        self._jitted = jitted
 
     def __call__(self, step, state, data):
+        if self._jitted is not None and \
+                not _any_tracer(step, state, data):
+            return self._jitted(step, state, data)
         return self.fn(step, state, data)
+
+
+class _WarmupResult(object):
+    """Handle over one Executor.warmup() submission: `submitted` /
+    `skipped` segment counts and `wait()` to block until every
+    background compile resolved (compile errors surface lazily at the
+    first run of the failing segment, not here)."""
+
+    __slots__ = ('futures', 'submitted', 'skipped')
+
+    def __init__(self, futures, submitted, skipped):
+        self.futures = list(futures)
+        self.submitted = submitted
+        self.skipped = skipped
+
+    def done(self):
+        return all(f.done() for f in self.futures)
+
+    def wait(self, timeout=None):
+        """Block until every submitted compile resolved, or `timeout`
+        seconds total (ONE deadline, not per future).  Never raises:
+        check done() to see whether the deadline cut the wait short; a
+        failed background compile recompiles lazily at first run."""
+        if self.futures:
+            from concurrent.futures import wait as _futures_wait
+            _futures_wait(self.futures, timeout=timeout)
+        return self
 
 
 class CompiledPipeline(object):
@@ -1076,11 +1201,223 @@ class Executor(object):
                 'feed names %r are not read by the program (inputs: '
                 '%r)' % (bogus, sorted(known)))
         from .flags import get_flag
-        return CompiledStep(
-            _make_segment_fn(seg, prefer_test,
-                             whole_program_grad=bool(
-                                 get_flag('FLAGS_whole_program_grad'))),
-            seg.input_names, seg.state_names, seg.output_names)
+        wpg = bool(get_flag('FLAGS_whole_program_grad'))
+        fn = _make_segment_fn(seg, prefer_test, whole_program_grad=wpg)
+        # the compile plane keys the jit on the segment's content
+        # fingerprint (donate=False: CompiledStep state is caller-owned)
+        # so compiling the same program twice — or a program `run`
+        # already planned — never pays a second trace, and the XLA
+        # compile dedupes across processes via the persistent cache
+        fp = compile_cache.fingerprint(
+            seg.ops, (), _lowering_flag_items(prefer_test, wpg),
+            donate=False, purpose='jit')
+        jitted = compile_cache.plane().shared_jit(
+            fp, lambda: jax.jit(fn))
+        return CompiledStep(fn, seg.input_names, seg.state_names,
+                            seg.output_names, jitted=jitted)
+
+    # ------------------------------------------------------------------
+    def warmup(self, program=None, feed_shapes=None, fetch_list=None,
+               scope=None, prefer_test=False, wait=False):
+        """Compile a program's segments in the BACKGROUND, ahead of the
+        first run() — the parallel half of the AOT compile plane.
+
+        `feed_shapes` maps each feed name to its spec: a (shape, dtype)
+        pair, an example array, or a jax.ShapeDtypeStruct.  Pass the
+        same feed names and `fetch_list` the later run() calls will use
+        (they key the plan).  Parameters/optimizer state resolve from
+        `scope` (run the startup program first) or from static var
+        declarations.  Segment output shapes propagate to downstream
+        segments; segments cut off by host-op outputs or un-stamped
+        auto-bucket trip counts are skipped and compile lazily.
+
+        Every resolvable segment is fingerprinted and submitted to the
+        compile pool (FLAGS_compile_threads): disk entries deserialize,
+        everything else traces (foreground — cheap) and XLA-compiles
+        (background — the expensive part, concurrent across segments).
+        Executables are delivered via futures, so step 1 blocks only on
+        the segment it is about to execute, not the whole plan.
+
+        Returns a result object with `.wait()`; `wait=True` blocks
+        until every submitted compile finished.  Calling warmup marks
+        the process 'warmed': run() uses the AOT plane from then on
+        even without a cache dir (memory-only)."""
+        import threading as _threading
+        import numpy as _np
+        from .flags import get_flag
+        program = program or framework.default_main_program()
+        scope = scope or core.global_scope()
+        plane = compile_cache.plane()
+        plane.mark_warmed()
+        feed_shapes = feed_shapes or {}
+        fetch_list = fetch_list or []
+        fetch_names = [v.name if isinstance(v, framework.Variable)
+                       else v for v in fetch_list]
+
+        canon = compile_cache.canonical_dtype
+
+        def as_spec(v):
+            if isinstance(v, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(v.shape, canon(v.dtype))
+            if isinstance(v, core.LoDTensor):
+                v = v.data
+            shp = getattr(v, 'shape', None)
+            if shp is not None and hasattr(v, 'dtype'):
+                return jax.ShapeDtypeStruct(
+                    tuple(int(s) for s in shp), canon(v.dtype))
+            shape, dtype = v
+            return jax.ShapeDtypeStruct(
+                tuple(int(s) for s in shape), canon(dtype))
+
+        feed_specs = {k: as_spec(v) for k, v in feed_shapes.items()}
+        plan = self._get_plan(program, tuple(sorted(feed_specs)),
+                              tuple(fetch_names), prefer_test)
+        auto = bool(get_flag('FLAGS_segment_auto_layout'))
+        wpg = bool(get_flag('FLAGS_whole_program_grad'))
+        device = self.place.jax_device()
+        t_start = _time_mod.perf_counter()
+        env = {}        # scope-as-of-this-plan-position specs
+        unknown = set()  # names only a real step can produce
+        block = program.global_block()
+
+        def spec_of(name):
+            if name in feed_specs:
+                return feed_specs[name]
+            if name in unknown:
+                return None
+            if name in env:
+                return env[name]
+            v = scope.find_var(name)
+            if v is not None:
+                v = core.as_array(v)
+                if hasattr(v, 'shape') and hasattr(v, 'dtype'):
+                    return jax.ShapeDtypeStruct(
+                        tuple(int(s) for s in v.shape),
+                        canon(v.dtype))
+            var = block._find_var_recursive(name)
+            if var is not None and var.shape and \
+                    all(int(s) >= 0 for s in var.shape):
+                try:
+                    return jax.ShapeDtypeStruct(
+                        tuple(int(s) for s in var.shape),
+                        canon(core.convert_dtype(var.dtype)))
+                except Exception:
+                    return None
+            return None
+
+        futures = []
+        submitted = skipped = 0
+        for item in plan:
+            if not isinstance(item, _Segment):
+                # host/bucket legs run with real data at step time;
+                # whatever they write only a real step can shape
+                for n in _op_writes(item[1]):
+                    env.pop(n, None)
+                    unknown.add(n)
+                continue
+            seg = item
+            buckets = tuple(op.attrs.get('max_trip_count')
+                            for op in seg.bucket_ops)
+            resolvable = not auto and all(buckets)
+            state_specs, data_specs = {}, {}
+            if resolvable:
+                for names, dst in ((seg.state_names, state_specs),
+                                   (seg.input_names, data_specs)):
+                    for n in names:
+                        s = spec_of(n)
+                        if s is None:
+                            resolvable = False
+                            break
+                        dst[n] = s
+                    if not resolvable:
+                        break
+            if not resolvable:
+                skipped += 1
+                monitor.add('executor/warmup_skipped')
+                for n in seg.output_names:
+                    env.pop(n, None)
+                    unknown.add(n)
+                continue
+            specs = compile_cache.arg_specs(state_specs, data_specs)
+            fp = compile_cache.fingerprint(
+                seg.ops, specs,
+                _lowering_flag_items(seg.prefer_test, wpg) +
+                (int(getattr(device, 'id', 0)),),
+                donate=True)
+            out_specs = plane.out_specs(fp)
+            if plane.lookup(fp) is None and out_specs is None:
+                loaded = plane.disk_load(fp, with_specs=True)
+                if loaded is not None:
+                    compiled, out_specs = loaded
+                    monitor.add('executor/compile_cache_disk_hit')
+                    plane.store(fp, compiled)
+                    plane.note_out_specs(fp, out_specs)
+            if out_specs is None:
+                # trace in the foreground (cheap, and it yields the
+                # output specs downstream segments need), compile in
+                # the pool (the expensive part, concurrent); both
+                # under the executor's device, matching _aot_build
+                import contextlib
+
+                def _dev_ctx():
+                    return contextlib.nullcontext() \
+                        if _is_default_device(device) \
+                        else jax.default_device(device)
+
+                monitor.add('executor/segments_lowered')
+                fn = _make_segment_fn(seg, seg.prefer_test,
+                                      whole_program_grad=wpg)
+                with _dev_ctx():
+                    lowered = jax.jit(fn, donate_argnums=(1,)).lower(
+                        _step_spec(), state_specs, data_specs)
+                out_specs = {
+                    n: (tuple(int(s) for s in v.shape),
+                        _np.dtype(v.dtype).str)
+                    for n, v in lowered.out_info.items()}
+                plane.note_out_specs(fp, out_specs)
+
+                def build(_lowered=lowered, _specs=out_specs,
+                          _ctx=_dev_ctx):
+                    t0 = _time_mod.perf_counter()
+                    with _ctx():
+                        compiled = _lowered.compile()
+                    monitor.add('executor/aot_compiles')
+                    monitor.observe(
+                        'executor/segment_compile_seconds',
+                        _time_mod.perf_counter() - t0)
+                    return compiled, _specs
+
+                fut = plane.submit(fp, build)
+                from concurrent.futures import Future
+                if isinstance(fut, Future):
+                    futures.append(fut)
+                submitted += 1
+                monitor.add('executor/warmup_segments')
+            for n, (shp, dt) in (out_specs or {}).items():
+                env[n] = jax.ShapeDtypeStruct(tuple(shp), _np.dtype(dt))
+                unknown.discard(n)
+
+        res = _WarmupResult(futures, submitted, skipped)
+        if wait or not futures:
+            res.wait()
+            monitor.observe('executor/warmup_seconds',
+                            _time_mod.perf_counter() - t_start)
+        else:
+            remaining = [len(futures)]
+            lock = _threading.Lock()
+
+            def _done(_f):
+                with lock:
+                    remaining[0] -= 1
+                    last = remaining[0] == 0
+                if last:
+                    monitor.observe(
+                        'executor/warmup_seconds',
+                        _time_mod.perf_counter() - t_start)
+
+            for f in futures:
+                f.add_done_callback(_done)
+        return res
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -1618,29 +1955,67 @@ class Executor(object):
         wpg = bool(get_flag('FLAGS_whole_program_grad'))
         key = (auto, prec, wpg) + tuple(op.attrs.get('max_trip_count')
                               for op in seg.bucket_ops)
-        compiled = seg.compiled.get(key)
-        # executable-cache accounting (reference STAT_ADD counters):
-        # a miss lowers + compiles this segment; each auto-bucket size
-        # is its own executable and counts as its own miss
-        first_run = compiled is None
-        if first_run:
-            monitor.add('executor/segment_cache_miss')
-            monitor.add('executor/segments_lowered')
-            compiled = seg.compiled[key] = _jit_segment(
-                seg, auto, whole_program_grad=wpg)
-        else:
-            monitor.add('executor/segment_cache_hit')
-
         binder = seg.binder
         if binder is None:
             binder = seg.binder = _SegmentBinder(seg)
         state, data = binder.bind(feed, scope)
-        try:
+        plane = compile_cache.plane()
+        first_run = False
+        if plane.active and not auto:
+            # AOT compile plane: executables are content-addressed and
+            # resolved memory -> in-flight future -> disk -> compile,
+            # so a restarted process (or a warmup()ed one) runs its
+            # first step without paying the trace+compile serially.
+            # (auto-layout executables are excluded: they are known to
+            # break when reloaded from the persistent cache, flags.py.)
+            # The per-step lookup key is the CHEAP spec form — raw
+            # (name, shape, dtype) in the binder's deterministic dict
+            # order, no sort, no dtype stringification — the hot loop
+            # pays attribute reads only; the canonical sorted form is
+            # computed once, on miss, for the fingerprint.
+            skey = (key,
+                    tuple((n, getattr(v, 'shape', ()),
+                           getattr(v, 'dtype', None))
+                          for n, v in state.items()),
+                    tuple((n, getattr(v, 'shape', ()),
+                           getattr(v, 'dtype', None))
+                          for n, v in data.items()))
+            compiled = seg.compiled.get(skey)
+            if compiled is None:
+                monitor.add('executor/segment_cache_miss')
+                specs = compile_cache.arg_specs(state, data)
+                # the executor's device is part of the executable
+                # identity: a non-default place compiles (and caches)
+                # its own executable, matching the lazy path's
+                # jax.default_device(device) compile
+                fp = compile_cache.fingerprint(
+                    seg.ops, specs,
+                    _lowering_flag_items(seg.prefer_test, wpg) +
+                    (int(getattr(device, 'id', 0)),),
+                    donate=True)
+                state_specs, data_specs = _specs_from_args(state, data)
+                compiled = plane.obtain(
+                    fp, lambda: _aot_build(seg, wpg, state_specs,
+                                           data_specs, device))
+                seg.compiled[skey] = compiled
+            else:
+                monitor.add('executor/segment_cache_hit')
+        else:
+            compiled = seg.compiled.get(key)
+            # executable-cache accounting (reference STAT_ADD
+            # counters): a miss lowers + compiles this segment; each
+            # auto-bucket size is its own executable and counts as its
+            # own miss
+            first_run = compiled is None
             if first_run:
-                # the first call of a jitted segment traces + compiles
-                # synchronously (only execution is async), so timing it
-                # is the per-segment compile-latency histogram
-                t0 = _time_mod.perf_counter()
+                monitor.add('executor/segment_cache_miss')
+                monitor.add('executor/segments_lowered')
+                compiled = seg.compiled[key] = _jit_segment(
+                    seg, auto, whole_program_grad=wpg)
+            else:
+                monitor.add('executor/segment_cache_hit')
+
+        def _call(c):
             if _is_default_device(device):
                 # `device` IS where jax would place this anyway, so the
                 # default_device context is a no-op — and it must be
@@ -1649,10 +2024,29 @@ class Executor(object):
                 # every later call miss jit's C++ fast path on the
                 # config mismatch and re-enter the python dispatch
                 # (~ms), which is exactly the host cost this path kills
-                out = compiled(self._step, state, data)
-            else:
-                with jax.default_device(device):
-                    out = compiled(self._step, state, data)
+                return c(self._step, state, data)
+            with jax.default_device(device):
+                return c(self._step, state, data)
+
+        try:
+            if first_run:
+                # the first call of a jitted segment traces + compiles
+                # synchronously (only execution is async), so timing it
+                # is the per-segment compile-latency histogram
+                t0 = _time_mod.perf_counter()
+            try:
+                out = _call(compiled)
+            except TypeError:
+                if first_run or not (plane.active and not auto):
+                    raise
+                # an AOT executable is shape/tree-exact; an argument
+                # kind it cannot absorb (exotic array subclass, odd
+                # scalar) falls back to the shape-polymorphic jit —
+                # correctness over the cached-compile win
+                monitor.add('executor/compile_cache_fallbacks')
+                compiled = seg.compiled[skey] = _jit_segment(
+                    seg, auto, whole_program_grad=wpg)
+                out = _call(compiled)
             if first_run:
                 monitor.observe('executor/segment_compile_seconds',
                                 _time_mod.perf_counter() - t0)

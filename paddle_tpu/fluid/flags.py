@@ -41,6 +41,26 @@ _DEFAULTS = {
     # ineligible segments (recompute programs, consumed intermediate
     # grads, split forwards) automatically keep the per-op path.
     'FLAGS_whole_program_grad': True,
+    # AOT compile plane (compile_cache.py): a directory here turns on
+    # the persistent on-disk segment-executable store AND the run
+    # path's AOT compilation (jit(fn).lower(specs).compile()), so a
+    # restarted process reloads executables instead of recompiling.
+    # PADDLE_TPU_COMPILE_CACHE_DIR is the friendlier spelling of the
+    # same knob; FLAGS_compile_cache_dir env/set_flags wins when both
+    # are set.  Empty (the default) leaves the plane off — the PR-2
+    # steady-state fast path is then byte-identical.
+    'FLAGS_compile_cache_dir':
+        os.environ.get('PADDLE_TPU_COMPILE_CACHE_DIR', ''),
+    # background compile pool width for Executor.warmup / background
+    # segment compilation; 0 = min(4, cpu_count)
+    'FLAGS_compile_threads': 0,
+    # LRU capacities for the long-running-service caches (0 = unbounded,
+    # the pre-PR-3 behavior): per-program plan cache, per-segment
+    # executable cache (per-shape AOT entries + bucket executables),
+    # and the plane's process-wide fingerprint->executable map
+    'FLAGS_plan_cache_capacity': 64,
+    'FLAGS_segment_cache_capacity': 32,
+    'FLAGS_compile_cache_memory_capacity': 256,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

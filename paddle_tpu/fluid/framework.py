@@ -155,6 +155,18 @@ def grad_var_name(name):
     return name + "@GRAD"
 
 
+def _new_exec_cache():
+    """Program execution-plan cache, LRU-capped for long-running
+    services (a service cycling feed keysets / fetch lists / executors
+    would otherwise grow plans — and the segment executables they pin —
+    without bound).  FLAGS_plan_cache_capacity=0 restores the unbounded
+    pre-cap behavior."""
+    from .compile_cache import LRUCache
+    from .flags import get_flag
+    return LRUCache(lambda: get_flag('FLAGS_plan_cache_capacity', 64),
+                    'executor/plan_cache_evictions')
+
+
 class Operator(object):
     """Reference: python/paddle/fluid/framework.py:1701 + OpDesc
     (framework/framework.proto:173). inputs/outputs map slot -> [var names].
@@ -386,7 +398,7 @@ class Program(object):
         self._version = 0
         self._op_seed_counter = [0]
         self._seed_base = np.random.randint(0, 2 ** 31 - 1)
-        self._exec_cache = {}
+        self._exec_cache = _new_exec_cache()
         self._current_role = 'forward'
 
     @contextlib.contextmanager
@@ -456,7 +468,7 @@ class Program(object):
         p._version = 0
         p._op_seed_counter = list(self._op_seed_counter)
         p._seed_base = self._seed_base
-        p._exec_cache = {}
+        p._exec_cache = _new_exec_cache()
         p._current_role = 'forward'
         p.current_block_idx = self.current_block_idx
         p.blocks = []

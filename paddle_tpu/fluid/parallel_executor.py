@@ -16,10 +16,17 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import compile_cache
 from . import core
 from . import monitor
 from .executor import (_Segment, _SegmentBinder, FetchHandle,
-                       _make_segment_fn, _add_note)
+                       _make_segment_fn, _add_note,
+                       _lowering_flag_items)
+
+
+def _mesh_fingerprint_key(mesh):
+    return (tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(mesh.axis_names), tuple(mesh.devices.shape))
 
 
 def _bind_segment_args(seg, feed, scope):
@@ -343,8 +350,21 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
                          for n in seg.state_names},
                         {n: data_shard(n, data[n]) for n in
                          seg.input_names})
-        compiled = seg.compiled['parallel'] = jax.jit(
-            fn, in_shardings=in_shardings, donate_argnums=(1,))
+        # the jit object is shared through the compile plane: a
+        # re-built CompiledProgram (plan-cache churn, program version
+        # bumps) with a content-identical segment + mesh + shardings
+        # reuses the existing traced jit instead of re-tracing, and
+        # with FLAGS_compile_cache_dir the underlying XLA compile
+        # dedupes across processes via jax's persistent cache
+        fp = compile_cache.fingerprint(
+            seg.ops,
+            (_mesh_fingerprint_key(mesh), repr(in_shardings)),
+            _lowering_flag_items(False, False),
+            donate=True, purpose='parallel')
+        compiled = compile_cache.plane().shared_jit(
+            fp, lambda: jax.jit(fn, in_shardings=in_shardings,
+                                donate_argnums=(1,)))
+        seg.compiled['parallel'] = compiled
     if first_run:
         t0 = _time_mod.perf_counter()
     out = compiled(executor._step, state, data)
@@ -427,10 +447,22 @@ def run_collective(executor, program, feed, fetch_list, scope,
                         {n: P() for n in seg.state_names},
                         data_specs)
             out_specs = {n: P() for n in seg.output_names}
-            sm = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_vma=False)
-            compiled = seg.compiled['collective'] = jax.jit(
-                sm, donate_argnums=(1,))
+            # shared through the compile plane, same contract as the
+            # data-parallel runner above
+            fp = compile_cache.fingerprint(
+                seg.ops,
+                (_mesh_fingerprint_key(mesh), repr(in_specs),
+                 repr(out_specs)),
+                _lowering_flag_items(False, False),
+                donate=True, purpose='collective')
+
+            def _build(_fn=fn, _in=in_specs, _out=out_specs):
+                sm = jax.shard_map(_fn, mesh=mesh, in_specs=_in,
+                                   out_specs=_out, check_vma=False)
+                return jax.jit(sm, donate_argnums=(1,))
+
+            compiled = compile_cache.plane().shared_jit(fp, _build)
+            seg.compiled['collective'] = compiled
         if jax.process_count() > 1:
             # a process-local scalar would carry an inconsistent
             # single-device sharding across processes; replicate it

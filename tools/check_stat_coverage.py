@@ -33,6 +33,26 @@ REQUIRED = [
     ('paddle_tpu/fluid/executor.py', 'executor/h2d_bytes_async'),
     ('paddle_tpu/fluid/executor.py', 'executor/fetch_blocked_seconds'),
     ('paddle_tpu/fluid/executor.py', 'executor/plan_cache_bypass'),
+    # AOT compile plane (PR 3): content-addressed executable reuse
+    # across processes, background warmup, bounded in-memory caches —
+    # tools/check_compile_cache.py exercises the cross-process path
+    ('paddle_tpu/fluid/compile_cache.py',
+     'executor/compile_cache_disk_hit'),
+    ('paddle_tpu/fluid/compile_cache.py',
+     'executor/compile_cache_disk_miss'),
+    ('paddle_tpu/fluid/compile_cache.py',
+     'executor/compile_cache_memory_hit'),
+    ('paddle_tpu/fluid/compile_cache.py',
+     'executor/compile_cache_corrupt'),
+    ('paddle_tpu/fluid/executor.py', 'executor/aot_compiles'),
+    ('paddle_tpu/fluid/executor.py', 'executor/warmup_seconds'),
+    ('paddle_tpu/fluid/executor.py', 'executor/warmup_segments'),
+    ('paddle_tpu/fluid/executor.py',
+     'executor/segment_cache_evictions'),
+    ('paddle_tpu/fluid/framework.py',
+     'executor/plan_cache_evictions'),
+    ('paddle_tpu/fluid/executor.py',
+     'executor/compile_cache_fallbacks'),
     # data-parallel / collective runners
     ('paddle_tpu/fluid/parallel_executor.py', 'parallel/device_count'),
     ('paddle_tpu/fluid/parallel_executor.py',
