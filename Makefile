@@ -47,12 +47,15 @@ bench:
 
 # gates: the monitor instrument points the observability contract
 # depends on must stay in the source, the steady-state step fast
-# path must stay within its per-step counter budgets, and the
-# persistent compile cache must carry executables across processes
+# path must stay within its per-step counter budgets, the persistent
+# compile cache must carry executables across processes, and the
+# trace plane must decompose a real step (merged host+device export,
+# >=80% phase coverage) without costing anything when disabled
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
 	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
+	JAX_PLATFORMS=cpu python tools/check_trace.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -123,6 +123,16 @@ def _chip_peak():
 # reports achieved TFLOP/s and MFU (round-4 VERDICT item 2)
 LAST_PERF = {}
 
+# set by _timed_steps from fluid.trace's flight recorder over the timed
+# window: the per-step phase breakdown (bind / feed_h2d / dispatch /
+# state_release / fetch_d2h ms) + wall percentiles, so a BENCH file
+# EXPLAINS a regression (which phase grew) instead of just reporting it
+LAST_PHASES = {}
+
+
+def _step_phase_fields():
+    return {'step_phases': LAST_PHASES} if LAST_PHASES else {}
+
 
 def _monitor_fields():
     """Always-on runtime-stats subset recorded alongside throughput, so
@@ -193,6 +203,7 @@ def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
     # transfer (the chip is remote-attached, so per-step feeds would
     # dominate small models)
     import jax
+    from paddle_tpu.fluid import trace as pt_trace
     feed = {k: jax.device_put(v) for k, v in feed.items()}
     for _ in range(warmup):
         exe.run(main_prog, feed=feed, fetch_list=[])
@@ -200,6 +211,13 @@ def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
     np.asarray(l)
     if TRACE_LOGDIR:
         jax.profiler.start_trace(TRACE_LOGDIR)
+    # flight recorder over the timed window only (a few us/step): the
+    # entry's JSON then carries the step-phase breakdown.  An ALREADY
+    # enabled tracer (FLAGS_trace=1 posture) keeps its own ring size —
+    # resizing it would silently discard the user's retained steps
+    trace_was_on = pt_trace.is_active()
+    if not trace_was_on:
+        pt_trace.enable(buffer_steps=steps)
     try:
         t0 = time.time()
         for _ in range(steps - 1):
@@ -210,6 +228,21 @@ def _timed_steps(exe, main_prog, feed, loss, steps=20, warmup=3):
     finally:
         if TRACE_LOGDIR:
             jax.profiler.stop_trace()
+        global LAST_PHASES
+        try:
+            roll = pt_trace.step_report(last=steps)['rollup']
+            LAST_PHASES = {
+                'wall_p50_ms': round(roll['wall_p50_ms'], 3),
+                'wall_p99_ms': round(roll['wall_p99_ms'], 3),
+                'phases_ms_per_step': {
+                    n: round(v / max(roll['count'], 1), 3)
+                    for n, v in roll['phases_ms'].items()},
+            }
+        except Exception:
+            LAST_PHASES = {}
+        if not trace_was_on:
+            pt_trace.disable()
+            pt_trace.reset()
     global LAST_PERF
     try:
         cost = exe.program_cost(main_prog, feed, fetch_list=[loss])
@@ -247,7 +280,8 @@ def bench_bert(batch=32, seq_len=128, steps=20, cfg=None):
                  % (batch, seq_len),
                  'value': round(dt * 1000, 2), 'unit': 'ms/step',
                  'seq_per_sec': round(batch / dt, 1)},
-                **LAST_PERF, **_monitor_fields())
+                **LAST_PERF, **_step_phase_fields(),
+                **_monitor_fields())
 
 
 def bench_bert_long(batch=4, seq_len=2048, steps=10):
@@ -357,7 +391,8 @@ def bench_wide_deep(batch=2048, steps=30, is_sparse=False):
                  % (batch, '_sparse' if is_sparse else ''),
                  'value': round(batch / dt, 1),
                  'unit': 'examples/sec'},
-                **LAST_PERF, **_monitor_fields())
+                **LAST_PERF, **_step_phase_fields(),
+                **_monitor_fields())
 
 
 def bench_wide_deep_sparse(batch=2048, steps=30):
@@ -457,7 +492,8 @@ def bench_transformer(batch=32, src_len=64, tgt_len=64, steps=20):
                  'value': round(batch * tgt_len / dt, 1),
                  'unit': 'tokens/sec',
                  'step_ms': round(dt * 1000, 2)},
-                **LAST_PERF, **_monitor_fields())
+                **LAST_PERF, **_step_phase_fields(),
+                **_monitor_fields())
 
 
 def bench_resnet50_hostfed(batch=128, steps=20, warmup=3,
@@ -574,7 +610,8 @@ def bench_lenet(batch=512, steps=30, conv_precision=None):
     return dict({'metric': 'lenet_mnist_images_per_sec_b%d' % batch,
                  'value': round(batch / dt, 1),
                  'unit': 'images/sec'},
-                **LAST_PERF, **_monitor_fields())
+                **LAST_PERF, **_step_phase_fields(),
+                **_monitor_fields())
 
 
 def bench_dispatch(depth=6, width=8, batch=4, steps=300, warmup=8):
@@ -806,7 +843,8 @@ def main():
                 'metric': 'resnet50_train_images_per_sec_chip',
                 'value': round(ips, 2), 'unit': 'images/sec',
                 'vs_baseline': round(ips / 365.0, 3)},
-                **LAST_PERF, **_monitor_fields())))
+                **LAST_PERF, **_step_phase_fields(),
+                **_monitor_fields())))
         else:
             print(json.dumps(
                 globals()['bench_' + sys.argv[2]](**kwargs)))

@@ -8,6 +8,7 @@ optional).
 """
 
 from . import monitor  # dependency-free; first so every layer can use it
+from . import trace    # span tracer: needs only monitor + flags
 from . import core
 from .core import (CPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
                    LoDTensor, SelectedRows, Scope, global_scope,
@@ -61,6 +62,7 @@ __all__ = [
     'Executor', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
     'ParamAttr', 'CompiledProgram', 'BuildStrategy', 'io', 'metrics',
     'dygraph', 'DataFeeder', 'scope_guard', 'global_scope', 'monitor',
+    'trace',
 ]
 from . import dataset
 from .dataset import DatasetFactory

@@ -48,6 +48,7 @@ import tempfile
 import threading
 
 from . import monitor
+from . import trace as _trace
 from .flags import get_flag
 
 # bump when the entry layout or fingerprint recipe changes: old entries
@@ -386,7 +387,8 @@ class CompilePlane(object):
         try:
             from jax.experimental.serialize_executable import (
                 serialize, deserialize_and_load)
-            payload, in_tree, out_tree = serialize(compiled)
+            with _trace.span('cache_serialize', fp=fp[:12]):
+                payload, in_tree, out_tree = serialize(compiled)
             # round-trip proof BEFORE publishing: an executable that
             # .compile() itself re-loaded from the XLA-level persistent
             # cache serializes to a payload whose symbols cannot be
@@ -422,17 +424,18 @@ class CompilePlane(object):
         if path is None or not os.path.exists(path):
             return None
         try:
-            with open(path, 'rb') as f:
-                blob = f.read()
-            if not blob.startswith(_PICKLE_MAGIC):
-                raise ValueError('bad magic')
-            rec = pickle.loads(blob[len(_PICKLE_MAGIC):])
-            if rec.get('fp') != fp:
-                raise ValueError('fingerprint mismatch')
-            from jax.experimental.serialize_executable import \
-                deserialize_and_load
-            compiled = deserialize_and_load(
-                rec['payload'], rec['in_tree'], rec['out_tree'])
+            with _trace.span('cache_deserialize', fp=fp[:12]):
+                with open(path, 'rb') as f:
+                    blob = f.read()
+                if not blob.startswith(_PICKLE_MAGIC):
+                    raise ValueError('bad magic')
+                rec = pickle.loads(blob[len(_PICKLE_MAGIC):])
+                if rec.get('fp') != fp:
+                    raise ValueError('fingerprint mismatch')
+                from jax.experimental.serialize_executable import \
+                    deserialize_and_load
+                compiled = deserialize_and_load(
+                    rec['payload'], rec['in_tree'], rec['out_tree'])
             if with_specs:
                 return compiled, rec.get('out_specs')
             return compiled

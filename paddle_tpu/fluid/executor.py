@@ -27,6 +27,7 @@ from . import compile_cache
 from . import core
 from . import framework
 from . import monitor
+from . import trace as _trace
 from ..ops import registry
 
 
@@ -235,8 +236,9 @@ class _SegmentBinder(object):
         for n in tab.data_feed:
             v = feed[n]
             data[n] = _normalize_feed_value(v) if raw else v
-        monitor.observe('executor/bind_seconds',
-                        _time_mod.perf_counter() - t0)
+        t1 = _time_mod.perf_counter()
+        monitor.observe('executor/bind_seconds', t1 - t0)
+        _trace.record('bind', t0, t1)
         return state, data
 
 
@@ -285,13 +287,33 @@ class FetchHandle(object):
                         'step that donates the fetched var, or fetch '
                         'with return_numpy=True.') from e
                 raise
-            monitor.observe('executor/fetch_blocked_seconds',
-                            _time_mod.perf_counter() - t0)
+            t1 = _time_mod.perf_counter()
+            monitor.observe('executor/fetch_blocked_seconds', t1 - t0)
+            _trace.record('fetch_d2h', t0, t1)
         return self._np
 
     def __array__(self, dtype=None):
         arr = self.as_numpy()
         return arr.astype(dtype) if dtype is not None else arr
+
+
+def _release_donated_state(state):
+    """Drop the LAST references to a step's donated state buffers,
+    visibly.  Once the outputs are published to the scope, this dict is
+    all that keeps the previous step's donated buffers alive — and
+    dropping a donated buffer whose defining execution is still in
+    flight blocks in the runtime's deleter until the step completes
+    (measured ~the whole step on the CPU backend).  Left to frame
+    teardown, that wait bills to no statement at all: it was THE
+    unattributed gap between dispatch and fetch this tracer was built
+    to expose.  Same work either way; now it has a name, a histogram
+    and a span.  Shared by the single-device executor and the
+    parallel/collective runners."""
+    t0 = _time_mod.perf_counter()
+    state.clear()
+    t1 = _time_mod.perf_counter()
+    monitor.observe('executor/state_release_seconds', t1 - t0)
+    _trace.record('state_release', t0, t1)
 
 
 def _op_reads(op):
@@ -949,10 +971,11 @@ def _aot_build(seg, wpg, state_specs, data_specs, device=None):
             _step_spec(), state_specs, data_specs)
         out_info = lowered.out_info
         compiled = lowered.compile()
+    t1 = _time_mod.perf_counter()
     monitor.add('executor/aot_compiles')
     monitor.add('executor/segments_lowered')
-    monitor.observe('executor/segment_compile_seconds',
-                    _time_mod.perf_counter() - t0)
+    monitor.observe('executor/segment_compile_seconds', t1 - t0)
+    _trace.record('compile', t0, t1, {'ops': len(seg.ops)})
     out_specs = {n: (tuple(int(s) for s in v.shape),
                      _np.dtype(v.dtype).str)
                  for n, v in out_info.items()}
@@ -1084,9 +1107,10 @@ class CompiledPipeline(object):
         exe = self._exe
         exe._step += 1
         t0 = _time_mod.perf_counter()
-        out = exe._run_plan(self._program, self._plan, feed or {},
-                            self.fetch_names, scope, return_numpy)
-        exe._post_step(self._program, scope)
+        with _trace.step_span(exe._step):
+            out = exe._run_plan(self._program, self._plan, feed or {},
+                                self.fetch_names, scope, return_numpy)
+            exe._post_step(self._program, scope)
         # same instrumentation as Executor.run: this is the other
         # per-step entry point, monitor dumps must cover both
         monitor.add('executor/run_calls')
@@ -1367,7 +1391,8 @@ class Executor(object):
                 monitor.add('executor/segments_lowered')
                 fn = _make_segment_fn(seg, seg.prefer_test,
                                       whole_program_grad=wpg)
-                with _dev_ctx():
+                with _dev_ctx(), _trace.span('warmup_lower',
+                                             ops=len(seg.ops)):
                     lowered = jax.jit(fn, donate_argnums=(1,)).lower(
                         _step_spec(), state_specs, data_specs)
                 out_specs = {
@@ -1381,10 +1406,13 @@ class Executor(object):
                     t0 = _time_mod.perf_counter()
                     with _ctx():
                         compiled = _lowered.compile()
+                    t1 = _time_mod.perf_counter()
                     monitor.add('executor/aot_compiles')
                     monitor.observe(
-                        'executor/segment_compile_seconds',
-                        _time_mod.perf_counter() - t0)
+                        'executor/segment_compile_seconds', t1 - t0)
+                    # background-pool span: thread-aware, shows the
+                    # warmup futures overlapping the first steps
+                    _trace.record('warmup_compile', t0, t1)
                     return compiled, _specs
 
                 fut = plane.submit(fp, build)
@@ -1450,9 +1478,10 @@ class Executor(object):
                               use_cache=use_program_cache)
         self._step += 1
         t0 = _time_mod.perf_counter()
-        out = self._run_plan(program, plan, feed, fetch_names, scope,
-                             return_numpy)
-        self._post_step(program, scope)
+        with _trace.step_span(self._step):
+            out = self._run_plan(program, plan, feed, fetch_names,
+                                 scope, return_numpy)
+            self._post_step(program, scope)
         # dispatch-side wall time: jit dispatch is async, so this is the
         # host cost of one step (compiles land here on cold caches)
         monitor.add('executor/run_calls')
@@ -1798,7 +1827,9 @@ class Executor(object):
             nbytes += float(a.nbytes)
         monitor.add('executor/feed_vars', float(len(feed)))
         if host_part:
-            put = jax.device_put(host_part, device)
+            with _trace.span('feed_h2d', nbytes=nbytes,
+                             vars=len(host_part)):
+                put = jax.device_put(host_part, device)
             monitor.add('executor/h2d_bytes_async', nbytes)
             for k, a in put.items():
                 # pointer-donation claim only where the plan proves a
@@ -1827,12 +1858,14 @@ class Executor(object):
             if isinstance(item, _Segment):
                 self._run_segment(item, feed, scope, device, fetched)
             elif item[0] == 'bucket':
-                self._run_bucket_count(item[1], feed, scope, device,
-                                       prefer_test)
+                with _trace.span('bucket_count', op=item[1].type):
+                    self._run_bucket_count(item[1], feed, scope,
+                                           device, prefer_test)
             else:
                 op = item[1]
                 monitor.add('executor/host_ops_run')
-                registry.get(op.type).fn(self, scope, op)
+                with _trace.span('host_op', op=op.type):
+                    registry.get(op.type).fn(self, scope, op)
             if prof:
                 if isinstance(item, _Segment):
                     # host-time to COMPLETION, not dispatch
@@ -1864,8 +1897,10 @@ class Executor(object):
             if return_numpy:
                 t0 = _time_mod.perf_counter()
                 val = np.asarray(val)
+                t1 = _time_mod.perf_counter()
                 monitor.observe('executor/fetch_blocked_seconds',
-                                _time_mod.perf_counter() - t0)
+                                t1 - t0)
+                _trace.record('fetch_d2h', t0, t1)
             results.append(val)
         if fetch_names:
             monitor.add('executor/fetch_vars', float(len(fetch_names)))
@@ -2032,10 +2067,17 @@ class Executor(object):
             if first_run:
                 # the first call of a jitted segment traces + compiles
                 # synchronously (only execution is async), so timing it
-                # is the per-segment compile-latency histogram
+                # is the per-segment compile-latency histogram — and the
+                # step's 'compile' phase span; steady-state calls are
+                # the async 'dispatch' phase
                 t0 = _time_mod.perf_counter()
             try:
-                out = _call(compiled)
+                # no span kwargs on this per-step site: disabled-mode
+                # cost must stay one call + one global load, allocation
+                # free (the merged timeline names the segment anyway
+                # via the jit scope)
+                with _trace.span('compile' if first_run else 'dispatch'):
+                    out = _call(compiled)
             except TypeError:
                 if first_run or not (plane.active and not auto):
                     raise
@@ -2046,7 +2088,8 @@ class Executor(object):
                 monitor.add('executor/compile_cache_fallbacks')
                 compiled = seg.compiled[skey] = _jit_segment(
                     seg, auto, whole_program_grad=wpg)
-                out = _call(compiled)
+                with _trace.span('compile', ops=len(seg.ops)):
+                    out = _call(compiled)
             if first_run:
                 monitor.observe('executor/segment_compile_seconds',
                                 _time_mod.perf_counter() - t0)
@@ -2054,12 +2097,17 @@ class Executor(object):
             note = _feed_mismatch_note(seg.ops[0].block.program, feed)
             if note:
                 _add_note(e, note)
+            dump = _trace.dump_on_error('segfail_step%d' % self._step)
+            if dump:
+                _add_note(e, 'trace flight recorder (last %d steps) '
+                          'dumped to %s' % (len(_trace.steps()), dump))
             raise
         if get_flag('FLAGS_check_nan_inf'):
             self._check_nan_inf(out)
         for n, v in out.items():
             scope.set_var(n, v)
             fetched[n] = v
+        _release_donated_state(state)
 
     def _check_nan_inf(self, out):
         """Reference: CheckVarHasNanOrInf per-op sweep
@@ -2081,9 +2129,18 @@ class Executor(object):
                     verdicts.append((n, np.isfinite(arr).all()))
         for n, ok in verdicts:
             if not bool(ok):
-                raise FloatingPointError(
+                err = FloatingPointError(
                     'nan/inf detected in var %s (step %d)'
                     % (n, self._step))
+                # incident capture: the flight recorder holds the last
+                # N steps' spans — exactly the window that produced the
+                # NaN — dump it before the step loop unwinds
+                dump = _trace.dump_on_error('nan_step%d' % self._step)
+                if dump:
+                    _add_note(err, 'trace flight recorder (last %d '
+                              'steps) dumped to %s'
+                              % (len(_trace.steps()), dump))
+                raise err
 
 
 def _as_numpy(v):

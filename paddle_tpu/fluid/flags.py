@@ -61,6 +61,15 @@ _DEFAULTS = {
     'FLAGS_plan_cache_capacity': 64,
     'FLAGS_segment_cache_capacity': 32,
     'FLAGS_compile_cache_memory_capacity': 256,
+    # span tracer / flight recorder (fluid/trace.py): FLAGS_trace=1
+    # enables span recording at import (the always-on production
+    # posture); off, every trace.span() site costs one function call +
+    # one global load.  FLAGS_trace_buffer_steps bounds the flight
+    # recorder: the last N executor steps' span records are retained
+    # for dump()/step_report() (dumped automatically on NaN-check or
+    # dispatch failure), older steps evict ('trace/steps_dropped').
+    'FLAGS_trace': False,
+    'FLAGS_trace_buffer_steps': 16,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

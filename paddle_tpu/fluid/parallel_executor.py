@@ -19,9 +19,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import compile_cache
 from . import core
 from . import monitor
+from . import trace as _trace
 from .executor import (_Segment, _SegmentBinder, FetchHandle,
                        _make_segment_fn, _add_note,
-                       _lowering_flag_items)
+                       _lowering_flag_items, _release_donated_state)
 
 
 def _mesh_fingerprint_key(mesh):
@@ -270,21 +271,23 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
                 return P(zero_axis)
             return None
     batch_feeds = _batch_feed_names(program, feed)
-    for item in plan:
-        if isinstance(item, _Segment):
-            _run_segment_parallel(executor, item, feed, scope, mesh, ndev,
-                                  fetched, param_rule, batch_feeds,
-                                  hints)
-        else:
-            from ..ops import registry
-            op = item[1]
-            registry.get(op.type).fn(executor, scope, op)
-    results = []
-    for name in fetch_names:
-        val = fetched.get(name)
-        if val is None:
-            val = core.as_array(scope.find_var(name))
-        results.append(_resolve_fetch(val, return_numpy))
+    with _trace.step_span(executor._step):
+        for item in plan:
+            if isinstance(item, _Segment):
+                _run_segment_parallel(executor, item, feed, scope, mesh,
+                                      ndev, fetched, param_rule,
+                                      batch_feeds, hints)
+            else:
+                from ..ops import registry
+                op = item[1]
+                with _trace.span('host_op', op=op.type):
+                    registry.get(op.type).fn(executor, scope, op)
+        results = []
+        for name in fetch_names:
+            val = fetched.get(name)
+            if val is None:
+                val = core.as_array(scope.find_var(name))
+            results.append(_resolve_fetch(val, return_numpy))
     # dispatch-side wall time: this runner is an Executor.run entry
     # point too (CompiledProgram path), so it records the same counters
     monitor.add('executor/run_calls')
@@ -367,13 +370,15 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         seg.compiled['parallel'] = compiled
     if first_run:
         t0 = _time_mod.perf_counter()
-    out = compiled(executor._step, state, data)
+    with _trace.span('compile' if first_run else 'dispatch'):
+        out = compiled(executor._step, state, data)
     if first_run:
         monitor.observe('parallel/segment_compile_seconds',
                         _time_mod.perf_counter() - t0)
     for n, v in out.items():
         scope.set_var(n, v)
         fetched[n] = v
+    _release_donated_state(state)
 
 
 def run_collective(executor, program, feed, fetch_list, scope,
@@ -382,7 +387,6 @@ def run_collective(executor, program, feed, fetch_list, scope,
     GradAllReduce mode): the program's c_allreduce_* ops lower to
     jax.lax collectives over the 'dp' mesh axis; each mesh device runs
     the trainer-local program on its batch shard."""
-    import jax.numpy as jnp
     from . import core as _core
     from . import framework
     scope = scope or _core.global_scope()
@@ -415,10 +419,33 @@ def run_collective(executor, program, feed, fetch_list, scope,
         for k, v in feed.items():
             scope.set_var(k, v.data if isinstance(v, _core.LoDTensor)
                           else v)
+    with _trace.step_span(executor._step):
+        _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
+                             batch_feeds, fetched)
+        # fetch resolution inside the step span, same as run_parallel:
+        # a blocking D2H here is step time the report must attribute
+        results = []
+        for name in fetch_names:
+            val = fetched.get(name)
+            if val is None:
+                val = _core.as_array(scope.find_var(name))
+            results.append(_resolve_fetch(val, return_numpy))
+    monitor.add('executor/run_calls')
+    monitor.observe('executor/run_seconds',
+                    _time_mod.perf_counter() - t_run0)
+    return results
+
+
+def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
+                         batch_feeds, fetched):
+    """run_collective's per-item plan walk, under the step's trace
+    span: segment binds/dispatches and host ops record as phases."""
+    import jax.numpy as jnp
     for item in plan:
         if not isinstance(item, _Segment):
             from ..ops import registry
-            registry.get(item[1].type).fn(executor, scope, item[1])
+            with _trace.span('host_op', op=item[1].type):
+                registry.get(item[1].type).fn(executor, scope, item[1])
             continue
         seg = item
         state, data = _bind_segment_args(seg, feed, scope)
@@ -473,7 +500,8 @@ def run_collective(executor, program, feed, fetch_list, scope,
         try:
             if first_run:
                 t0 = _time_mod.perf_counter()
-            out = compiled(step, state, data)
+            with _trace.span('compile' if first_run else 'dispatch'):
+                out = compiled(step, state, data)
             if first_run:
                 monitor.observe('parallel/segment_compile_seconds',
                                 _time_mod.perf_counter() - t0)
@@ -490,16 +518,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
         for n, v in out.items():
             scope.set_var(n, v)
             fetched[n] = v
-    results = []
-    for name in fetch_names:
-        val = fetched.get(name)
-        if val is None:
-            val = _core.as_array(scope.find_var(name))
-        results.append(_resolve_fetch(val, return_numpy))
-    monitor.add('executor/run_calls')
-    monitor.observe('executor/run_seconds',
-                    _time_mod.perf_counter() - t_run0)
-    return results
+        _release_donated_state(state)
 
 
 class ParallelExecutor(object):

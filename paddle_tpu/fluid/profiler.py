@@ -114,16 +114,21 @@ def attribute_trace_events(events, op_types=None):
     the first path component that names a registered op type; kernels
     with no such component (copies, infeed, grad-only glue) land under
     'unattributed/<hlo name>'.  Returns {name: [calls, total_s, max_s,
-    min_s]}."""
+    min_s]}.
+
+    Tolerant by contract: real captures contain malformed rows (counter
+    events without dur, instant events, non-string tf_op metadata,
+    null fields) — those are skipped or zero-timed, never raised on,
+    so one odd event cannot lose a whole profile."""
     op_types = op_types or _registered_op_types()
     recs = {}
     cache = {}
     for e in events:
-        if e.get('ph') != 'X':
+        if not isinstance(e, dict) or e.get('ph') != 'X':
             continue
         args = e.get('args') or {}
-        tf_op = args.get('tf_op')
-        if not tf_op:
+        tf_op = args.get('tf_op') if isinstance(args, dict) else None
+        if not tf_op or not isinstance(tf_op, str):
             continue
         name = cache.get(tf_op)
         if name is None:
@@ -143,8 +148,11 @@ def attribute_trace_events(events, op_types=None):
         if name is None:
             # per-HLO-name bucket; NOT cached on tf_op — distinct
             # kernels can share a scope path
-            name = 'unattributed/' + e.get('name', '?').split('.')[0]
-        sec = float(e.get('dur', 0)) * 1e-6
+            name = 'unattributed/' + str(e.get('name', '?')).split('.')[0]
+        try:
+            sec = float(e.get('dur') or 0) * 1e-6
+        except (TypeError, ValueError):
+            sec = 0.0
         rec = recs.get(name)
         if rec is None:
             recs[name] = [1, sec, sec, sec]
@@ -168,6 +176,20 @@ def _load_trace_events(logdir):
         return json.load(f).get('traceEvents', [])
 
 
+def _attach_span_tracer():
+    """Auto-attach the fluid.trace span tracer to a starting device
+    capture, and emit the paired clock-sync annotation (the device
+    trace records 'pt_clock_sync' on ITS clock while the tracer notes
+    the host epoch-us — tools/timeline.py merges on that offset)."""
+    from . import trace as trace_mod
+    trace_mod.attach_capture()
+    try:
+        with jax.profiler.TraceAnnotation('pt_clock_sync'):
+            trace_mod.mark_clock_sync()
+    except Exception:
+        pass
+
+
 def start_profiler(state='All', tracer_option='Serial'):
     """Enable profiling (reference EnableProfiler).  `state` kept for
     API parity; on TPU there is no CPU/GPU split to select.
@@ -186,7 +208,13 @@ def start_profiler(state='All', tracer_option='Serial'):
         # mode switch without stop): close it or the device trace runs
         # forever and the next start_trace raises
         import shutil
-        jax.profiler.stop_trace()
+        from . import trace as trace_mod
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # drop the rider, restore its state — even when the jax
+            # stop raises, or the tracer stays force-enabled forever
+            trace_mod.detach_capture()
         shutil.rmtree(_prof_trace_dir, ignore_errors=True)
         _prof_trace_dir = None
     _mode = 'Serial' if tracer_option == 'Serial' else 'Default'
@@ -194,6 +222,9 @@ def start_profiler(state='All', tracer_option='Serial'):
         import tempfile
         _prof_trace_dir = tempfile.mkdtemp(prefix='pt_prof_')
         jax.profiler.start_trace(_prof_trace_dir)
+        # one capture yields host AND device events: the span tracer
+        # rides along so stop_profiler can write the merged timeline
+        _attach_span_tracer()
     _enabled = True
 
 
@@ -220,17 +251,30 @@ def _fold_into_monitor():
 def stop_profiler(sorted_key='total', profile_path=None):
     """Disable profiling and print the sorted per-op table (reference
     DisableProfiler).  profile_path, when given, receives the table as
-    a text file.  Returns the table string, folds the per-op records
+    a text file — and, after a 'Default' (device-trace) profile, the
+    MERGED host+device chrome-trace timeline lands next to it as
+    '<table path>.timeline.json' (a directory profile_path gets
+    'profile_summary.txt' + 'profile_summary.txt.timeline.json'
+    inside), so one profile yields both the table and the step
+    timeline.  Returns the table string, folds the per-op records
     into fluid.monitor under 'profiler/…' keys, and resets the tracer
     mode to 'Serial' so a later bare start_profiler()/is_enabled()
     sequence never inherits a stale 'Default' trace mode."""
     global _enabled, _mode, _prof_trace_dir
     _enabled = False
+    device_events = []
+    host_cap = None
     if _mode == 'Default' and _prof_trace_dir is not None:
         import shutil
-        jax.profiler.stop_trace()
-        events = _load_trace_events(_prof_trace_dir)
-        _records.update(attribute_trace_events(events))
+        from . import trace as trace_mod
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # detach even when the jax stop raises, or the attached
+            # capture keeps recording (and buffering) forever
+            host_cap = trace_mod.detach_capture()
+        device_events = _load_trace_events(_prof_trace_dir)
+        _records.update(attribute_trace_events(device_events))
         shutil.rmtree(_prof_trace_dir, ignore_errors=True)
         _prof_trace_dir = None
     _mode = 'Serial'
@@ -249,6 +293,14 @@ def stop_profiler(sorted_key='total', profile_path=None):
             os.makedirs(d, exist_ok=True)
         with open(profile_path, 'w') as f:
             f.write(table + '\n')
+        if host_cap is not None:
+            from . import trace as trace_mod
+            merged = trace_mod.merge_device_trace(
+                trace_mod.chrome_events(host_cap['events']),
+                device_events, sync_host_us=host_cap['sync_us'],
+                capture_t0_us=host_cap['t0_us'])
+            trace_mod.write_chrome(profile_path + '.timeline.json',
+                                   merged)
     return table
 
 
@@ -274,6 +326,10 @@ def cuda_profiler(*a, **k):
 
 def start_trace(logdir='/tmp/profile'):
     """Device-trace capture (Perfetto/XPlane) — the DeviceTracer leg.
+    The fluid.trace span tracer auto-attaches, so ONE capture yields
+    host phase spans AND device kernels; stop_trace writes the host
+    side as 'host_trace.json' next to the device dump and
+    tools/timeline.py merges the two into one Perfetto file.
 
     Like start_profiler, double-starts fail with a clear error instead
     of jax's raw 'profiler already started' (only one device trace can
@@ -290,12 +346,29 @@ def start_trace(logdir='/tmp/profile'):
     os.makedirs(logdir, exist_ok=True)
     jax.profiler.start_trace(logdir)
     _trace_path = logdir
+    _attach_span_tracer()
 
 
 def stop_trace():
+    """Stop the device capture; returns the logdir.  The attached span
+    tracer's host events persist as '<logdir>/host_trace.json' for the
+    timeline merger."""
     global _trace_path
-    jax.profiler.stop_trace()
+    from . import trace as trace_mod
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        # detach even when the jax stop raises (trace already stopped
+        # by code driving jax.profiler directly), or the rider stays
+        # force-enabled and its capture buffer grows unboundedly
+        host_cap = trace_mod.detach_capture()
     path, _trace_path = _trace_path, None
+    if path is not None and host_cap is not None:
+        try:
+            trace_mod.write_host_trace(
+                os.path.join(path, 'host_trace.json'), host_cap)
+        except OSError:
+            pass  # read-only logdir: device trace still usable
     return path
 
 

@@ -26,6 +26,7 @@ import numpy as np
 
 from . import core
 from . import monitor
+from . import trace as _trace
 
 
 class _AsyncBatchIterator(object):
@@ -113,7 +114,8 @@ class _AsyncBatchIterator(object):
             # it), so the executor must keep its defensive copy if one
             # of these ever binds to a donated state slot.
             monitor.add('reader/bytes_staged', nbytes)
-            out.update(jax.device_put(host_part, self._device))
+            with _trace.span('reader_h2d', nbytes=nbytes):
+                out.update(jax.device_put(host_part, self._device))
         return out
 
     def _fill_window(self):
@@ -131,8 +133,10 @@ class _AsyncBatchIterator(object):
                 # A healthy pipeline keeps this histogram's sum near 0
                 t0 = _time.perf_counter()
                 item = self._q.get()
+                t1 = _time.perf_counter()
                 monitor.observe('reader/consume_blocked_seconds',
-                                _time.perf_counter() - t0)
+                                t1 - t0)
+                _trace.record('reader_wait', t0, t1)
             if item is self._END:
                 self._done = True
                 self._stop.set()

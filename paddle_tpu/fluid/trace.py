@@ -1,0 +1,655 @@
+"""fluid.trace — always-available structured step timeline.
+
+Reference: platform/profiler.h RecordEvent + tools/timeline.py turned
+the C++ runtime's host spans and the CUPTI device trace into one
+chrome://tracing file.  paddle_tpu had the two ends (fluid.monitor
+counters; jax.profiler device capture) but nothing that says WHERE
+inside a step the milliseconds go — the host/device interleavings
+between bind, H2D staging, dispatch, compile and D2H fetch.
+
+This module is the span plane between the two:
+
+- ``span(name, **args)`` / ``record(name, t0, t1)`` / ``@traced``:
+  named, thread-aware host spans on the monotonic clock.  DISABLED (the
+  default) a call site costs one function call + one global load;
+  nothing locks, and the hottest per-step sites (bind, dispatch,
+  fetch, state_release, step) pass no kwargs so they do not even
+  allocate — branch-gated sites (H2D staging, host ops, reader) pass
+  cheap kwargs evaluated call-side.  The PR-2 hot-path budgets hold
+  either way (tools/check_trace.py gates this against
+  check_hot_path.py).
+
+- a **ring-buffer flight recorder**: while enabled, every executor step
+  closes one step record (its spans, wall time) into a deque holding
+  the last ``FLAGS_trace_buffer_steps`` steps.  ``dump()`` writes them
+  as chrome-trace JSON on demand; the executor dumps automatically when
+  FLAGS_check_nan_inf trips or a segment dispatch fails, so the last N
+  steps before an incident are always recoverable (``dump_on_error``).
+
+- a **chrome-trace/Perfetto exporter + device-trace merger**:
+  ``chrome_events()`` renders host spans as trace events;
+  ``merge_device_trace()`` folds them into a jax.profiler device
+  capture on a shared clock (a ``pt_clock_sync`` annotation emitted at
+  capture start pins the offset; session-relative device clocks fall
+  back to capture-start alignment).  ``fluid.profiler.start_trace`` /
+  ``stop_trace`` auto-attach this tracer, so one capture yields the
+  combined host+device timeline (tools/timeline.py writes it).
+
+- a **per-step report**: ``step_report()`` breaks each recorded step
+  into its top-level phases (bind / feed_h2d / dispatch / compile /
+  reader_wait / fetch_d2h / host_op), with p50/p99 and slowest-step
+  rollups — ``tools/stat_summary.py --steps`` renders it.
+
+Hot-path discipline mirrors fluid.monitor: plain list appends under the
+GIL (losing a span to a racing step swap is a stats-grade race, never
+corruption), NO jax imports at module level, and every recording site
+also keeps its existing monitor counter so the two planes agree.
+"""
+
+import collections
+import os
+import threading
+import time
+
+from . import monitor
+from .flags import get_flag
+
+__all__ = [
+    'enable', 'disable', 'is_active', 'reset', 'span', 'record',
+    'traced', 'step_span', 'steps', 'step_report', 'report_from_records',
+    'format_step_report', 'chrome_events', 'merge_device_trace',
+    'write_chrome', 'dump', 'dump_on_error', 'now_us',
+]
+
+# monotonic->epoch anchor: every span stores perf_counter floats; the
+# exporter translates them to epoch microseconds with ONE fixed pair so
+# all host events share a clock (and NTP steps mid-run cannot skew it)
+_P0 = time.perf_counter()
+_T0 = time.time()
+
+_active = False
+_events = []        # finished spans of the current step window
+_steps = None       # deque of closed step records (the flight recorder)
+_capture = None     # device-capture session: {'t0_us', 'sync_us', 'events'}
+_tls = threading.local()
+_lock = threading.RLock()
+
+# span tuple layout: (name, t0, t1, tid, depth, args_or_None)
+
+
+def now_us(t=None):
+    """Epoch microseconds of perf_counter time `t` (default: now)."""
+    if t is None:
+        t = time.perf_counter()
+    return (_T0 + (t - _P0)) * 1e6
+
+
+def is_active():
+    return _active
+
+
+def enable(buffer_steps=None):
+    """Turn the span tracer + flight recorder on.  `buffer_steps`
+    overrides FLAGS_trace_buffer_steps for the ring capacity;
+    re-enabling with the same (or no explicit) capacity keeps the
+    buffer untouched, and an explicit resize keeps the NEWEST records,
+    counting any it discards in trace/steps_dropped — never a silent
+    loss of the retained incident window."""
+    global _active, _steps
+    with _lock:
+        if buffer_steps is None:
+            buffer_steps = int(get_flag('FLAGS_trace_buffer_steps', 16)
+                               or 16)
+        n = max(1, int(buffer_steps))
+        if _steps is None or _steps.maxlen != n:
+            old = list(_steps or ())
+            dropped = len(old) - n
+            if dropped > 0:
+                monitor.add('trace/steps_dropped', float(dropped))
+            _steps = collections.deque(old, maxlen=n)
+        _active = True
+
+
+def disable():
+    """Stop recording; retained step records stay readable until
+    reset()."""
+    global _active
+    _active = False
+
+
+def reset():
+    """Drop every recorded span/step (tests, bench entry isolation).
+    An ACTIVE tracer keeps recording into a fresh ring of the same
+    capacity — reset must never silently kill the flight recorder —
+    and an attached device-capture session keeps its identity (events
+    cleared): detach_capture() must still run and restore the
+    pre-capture enabled state."""
+    global _events, _steps
+    with _lock:
+        _events = []
+        if _capture is not None:
+            _capture['events'] = []
+        if _active:
+            n = _steps.maxlen if _steps is not None else max(
+                1, int(get_flag('FLAGS_trace_buffer_steps', 16) or 16))
+            _steps = collections.deque(maxlen=n)
+        else:
+            _steps = None
+
+
+def _depth():
+    return getattr(_tls, 'depth', 0)
+
+
+# bound on the OPEN span window and on a capture session's event list:
+# the step ring bounds sealed records, but an always-on tracer driving
+# stepless work (standalone reader loops, ad-hoc spans) — or a capture
+# never stopped — would otherwise grow these lists for the life of the
+# process.  Overflow drops the oldest half and counts it.
+_WINDOW_CAP = 65536
+
+
+def _trim(ev):
+    if len(ev) > _WINDOW_CAP:
+        n = _WINDOW_CAP // 2
+        del ev[:n]
+        monitor.add('trace/window_spans_dropped', float(n))
+
+
+def _emit(rec):
+    ev = _events
+    ev.append(rec)
+    _trim(ev)
+    cap = _capture
+    if cap is not None:
+        cap['events'].append(rec)
+        _trim(cap['events'])
+    monitor.add('trace/spans_recorded')
+
+
+def record(name, t0, t1, args=None):
+    """Record one finished span from explicit perf_counter times — for
+    sites that already time themselves (binder, blocked fetch).  No-op
+    when the tracer is off."""
+    if not _active:
+        return
+    _emit((name, t0, t1, threading.get_ident(), _depth(), args))
+
+
+class _NullSpan(object):
+    """Shared no-op span: the disabled-mode fast path allocates
+    nothing."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span(object):
+    __slots__ = ('name', 'args', '_t0', '_depth')
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        d = _depth()
+        self._depth = d
+        _tls.depth = d + 1
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        _tls.depth = self._depth
+        if _active:
+            _emit((self.name, self._t0, t1, threading.get_ident(),
+                   self._depth, self.args or None))
+        return False
+
+
+def span(name, **args):
+    """Context manager timing one named span.  Off: returns a shared
+    null object (one global load, no allocation)."""
+    if not _active:
+        return _NULL
+    return _Span(name, args)
+
+
+def traced(name=None):
+    """Decorator form of span(): ``@traced('phase')`` or bare
+    ``@traced()`` (uses the function name)."""
+    def deco(fn):
+        label = name or fn.__name__
+
+        def wrapper(*a, **k):
+            if not _active:
+                return fn(*a, **k)
+            with _Span(label, None):
+                return fn(*a, **k)
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+    return deco
+
+
+class _StepSpan(object):
+    """Span over one executor step; closing it seals the current span
+    window into a flight-recorder step record."""
+
+    __slots__ = ('step', '_t0', '_depth', '_nested')
+
+    def __init__(self, step):
+        self.step = step
+
+    def __enter__(self):
+        # nested step spans (a pipeline step driving an inner run)
+        # degrade to plain spans: only the outermost seals the record
+        self._nested = getattr(_tls, 'in_step', False)
+        _tls.in_step = True
+        d = _depth()
+        self._depth = d
+        _tls.depth = d + 1
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        global _events
+        t1 = time.perf_counter()
+        _tls.depth = self._depth
+        _tls.in_step = self._nested
+        if not _active:
+            return False
+        if self._nested:
+            _emit(('step', self._t0, t1, threading.get_ident(),
+                   self._depth, {'step': self.step}))
+            return False
+        ev = _events
+        _events = []    # swap: a racing append lands in the old list
+        cap = _capture
+        if cap is not None:
+            cap['events'].append(('step', self._t0, t1,
+                                  threading.get_ident(), self._depth,
+                                  {'step': self.step}))
+        with _lock:
+            if _steps is not None:
+                if _steps.maxlen and len(_steps) == _steps.maxlen:
+                    monitor.add('trace/steps_dropped')
+                _steps.append({'step': self.step, 't0': self._t0,
+                               't1': t1,
+                               'tid': threading.get_ident(),
+                               'spans': ev})
+        monitor.add('trace/steps_recorded')
+        return False
+
+
+def step_span(step):
+    """Executor entry: wraps one step and seals its flight-recorder
+    record on exit.  Off: the shared null span."""
+    if not _active:
+        return _NULL
+    return _StepSpan(step)
+
+
+def steps():
+    """The flight recorder's retained step records, oldest first."""
+    with _lock:
+        return list(_steps or ())
+
+
+# ---------------------------------------------------------------- report
+def _span_fields(s):
+    """(name, t0, t1, tid, depth, args) from a tuple or a JSON list."""
+    return s[0], float(s[1]), float(s[2]), s[3], s[4], s[5]
+
+
+def _top_level(spans):
+    """Spans not strictly contained in a LONGER span of the same
+    thread: the step's phase decomposition (nested detail — a compile
+    inside a dispatch retry — stays out of the sums, so phases never
+    double count).  Sorted interval sweep, O(n log n): incident dumps
+    can hold a _WINDOW_CAP-sized partial record and a pairwise scan
+    would take hours there."""
+    by_tid = {}
+    for s in spans:
+        name, t0, t1, tid, _d, args = _span_fields(s)
+        by_tid.setdefault(tid, []).append((name, t0, t1, tid, args))
+    out = []
+    for tid, rows in by_tid.items():
+        # start asc, end desc: any container sorts before its contents
+        rows.sort(key=lambda r: (r[1], -r[2]))
+        max_end = None       # furthest end among earlier-starting spans
+        max_end_start = None  # start of the span that set it
+        for row in rows:
+            _name, t0, t1, _tid, _args = row
+            contained = max_end is not None and (
+                max_end > t1 or (max_end == t1 and max_end_start < t0))
+            if not contained:
+                out.append(row)
+            if max_end is None or t1 > max_end:
+                max_end, max_end_start = t1, t0
+    return out
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def report_from_records(records):
+    """Build the per-step report from step records (live tuples or the
+    JSON lists a dump() file holds).
+
+    Attribution convention: a step's record holds every span sealed
+    since the PREVIOUS step — so work done between steps (reader
+    waits, async-fetch resolution in user code) bills its full
+    duration to the phase table of the step it delayed, the standard
+    dataloader-time convention.  `coverage`/`accounted_ms` count only
+    in-window time, so such spans widen the phase table without
+    inflating coverage."""
+    steps_out = []
+    for rec in records:
+        t0, t1 = float(rec['t0']), float(rec['t1'])
+        wall = t1 - t0
+        tid = rec.get('tid')
+        phases = {}
+        per_tid = {}
+        for name, s0, s1, stid, _args in _top_level(rec['spans']):
+            phases[name] = phases.get(name, 0.0) + (s1 - s0)
+            # coverage counts ONE thread's spans clipped to the step
+            # window: concurrent reader/compile threads must not push
+            # "accounted" past 100%
+            overlap = max(0.0, min(s1, t1) - max(s0, t0))
+            per_tid[stid] = per_tid.get(stid, 0.0) + overlap
+        if tid is not None:
+            accounted = per_tid.get(tid, 0.0)
+        else:
+            # tid-less (partial/incident) record: take the busiest
+            # single thread, still bounded by the window
+            accounted = max(per_tid.values()) if per_tid else 0.0
+        steps_out.append({
+            'step': rec.get('step'),
+            'wall_ms': wall * 1e3,
+            'phases_ms': {n: v * 1e3 for n, v in sorted(phases.items())},
+            'accounted_ms': accounted * 1e3,
+            'coverage': (accounted / wall) if wall > 0 else 0.0,
+        })
+    walls = sorted(s['wall_ms'] for s in steps_out)
+    phase_tot = {}
+    for s in steps_out:
+        for n, v in s['phases_ms'].items():
+            phase_tot[n] = phase_tot.get(n, 0.0) + v
+    slowest = max(steps_out, key=lambda s: s['wall_ms']) \
+        if steps_out else None
+    return {
+        'steps': steps_out,
+        'rollup': {
+            'count': len(steps_out),
+            'wall_p50_ms': _pct(walls, 0.50),
+            'wall_p99_ms': _pct(walls, 0.99),
+            'wall_max_ms': walls[-1] if walls else 0.0,
+            'phases_ms': {n: v for n, v in sorted(phase_tot.items())},
+            'slowest': slowest,
+        },
+    }
+
+
+def step_report(last=None):
+    """Report over the flight recorder's retained steps (`last` limits
+    to the most recent N)."""
+    recs = steps()
+    if last:
+        recs = recs[-int(last):]
+    return report_from_records(recs)
+
+
+def format_step_report(report=None):
+    """Render a report (default: the live one) as the per-step table
+    tools/stat_summary.py --steps prints."""
+    rep = report if report is not None else step_report()
+    roll = rep['rollup']
+    lines = ['steps: %d   wall p50 %.3f ms   p99 %.3f ms   max %.3f ms'
+             % (roll['count'], roll['wall_p50_ms'], roll['wall_p99_ms'],
+                roll['wall_max_ms'])]
+    names = sorted(roll['phases_ms'],
+                   key=lambda n: -roll['phases_ms'][n])
+    lines.append('%-6s %10s %8s  %s'
+                 % ('step', 'wall(ms)', 'cov%', 'phases(ms)'))
+    for s in rep['steps']:
+        ph = '  '.join('%s=%.3f' % (n, s['phases_ms'][n])
+                       for n in names if n in s['phases_ms'])
+        lines.append('%-6s %10.3f %7.0f%%  %s'
+                     % (s['step'], s['wall_ms'],
+                        100.0 * s['coverage'], ph))
+    slow = roll.get('slowest')
+    if slow is not None:
+        lines.append('slowest: step %s at %.3f ms'
+                     % (slow['step'], slow['wall_ms']))
+    return '\n'.join(lines)
+
+
+# ---------------------------------------------------------- chrome export
+def _json_safe(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def chrome_events(span_tuples=None, pid=0):
+    """Host spans -> chrome-trace 'X' events (epoch microseconds) plus
+    process/thread metadata.  Default source: every span retained by
+    the flight recorder + the current window."""
+    if span_tuples is None:
+        span_tuples = []
+        for rec in steps():
+            span_tuples.extend(rec['spans'])
+            span_tuples.append(('step', rec['t0'], rec['t1'],
+                                rec.get('tid'), 0,
+                                {'step': rec.get('step')}))
+        span_tuples.extend(list(_events))
+    out = [{'ph': 'M', 'pid': pid, 'tid': 0, 'cat': 'pt_host',
+            'name': 'process_name',
+            'args': {'name': 'paddle_tpu host'}}]
+    tid_map = {}
+    for s in span_tuples:
+        name, t0, t1, tid, _depth, args = _span_fields(s)
+        if tid not in tid_map:
+            tid_map[tid] = len(tid_map)
+            out.append({'ph': 'M', 'pid': pid, 'tid': tid_map[tid],
+                        'cat': 'pt_host', 'name': 'thread_name',
+                        'args': {'name': 'host thread %d'
+                                 % tid_map[tid]}})
+        ev = {'ph': 'X', 'pid': pid, 'tid': tid_map[tid],
+              'ts': now_us(t0), 'dur': max(0.0, (t1 - t0) * 1e6),
+              'name': name, 'cat': 'pt_host'}
+        if args:
+            ev['args'] = {str(k): _json_safe(v) for k, v in args.items()}
+        out.append(ev)
+    return out
+
+
+def merge_device_trace(host_events, device_events, sync_host_us=None,
+                       capture_t0_us=None):
+    """Merge host chrome events with a jax.profiler device trace onto
+    one clock.  Device timestamps are shifted into the host epoch-us
+    clock: a 'pt_clock_sync' annotation in the device trace pins the
+    offset exactly; otherwise a session-relative device clock (small
+    ts values) is aligned to the capture start; epoch-like device
+    clocks pass through.  Host events are re-homed onto a pid above
+    every device pid so processes never collide."""
+    device_events = [e for e in device_events if isinstance(e, dict)]
+    ts_vals = [e['ts'] for e in device_events
+               if isinstance(e.get('ts'), (int, float))]
+    offset = 0.0
+    sync_ev = None
+    if sync_host_us is not None:
+        for e in device_events:
+            if 'pt_clock_sync' in str(e.get('name', '')) and \
+                    isinstance(e.get('ts'), (int, float)):
+                sync_ev = e
+                break
+    if sync_ev is not None:
+        offset = float(sync_host_us) - float(sync_ev['ts'])
+    elif ts_vals and min(ts_vals) < 1e14:
+        # session-relative device clock (epoch-us today is ~1.7e15)
+        anchor = capture_t0_us
+        if anchor is None:
+            host_ts = [e['ts'] for e in host_events
+                       if isinstance(e.get('ts'), (int, float))]
+            anchor = min(host_ts) if host_ts else min(ts_vals)
+        offset = float(anchor) - min(ts_vals)
+    merged = []
+    for e in device_events:
+        if offset and isinstance(e.get('ts'), (int, float)):
+            e = dict(e)
+            e['ts'] = e['ts'] + offset
+        merged.append(e)
+    dev_pids = [e.get('pid') for e in device_events
+                if isinstance(e.get('pid'), int)]
+    host_pid = (max(dev_pids) + 1) if dev_pids else 1
+    for e in host_events:
+        e = dict(e)
+        e['pid'] = host_pid
+        merged.append(e)
+    return merged
+
+
+def write_chrome(path, events):
+    """Write a chrome://tracing / Perfetto-loadable JSON file."""
+    import json
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'w') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    return path
+
+
+# ------------------------------------------------------- flight recorder
+def dump(path=None):
+    """Write the flight recorder (last N steps) as chrome-trace JSON;
+    the same file carries the raw step records under 'ptSteps' so
+    stat_summary.py --steps can rebuild the report offline.  The step
+    IN FLIGHT (spans recorded since the last step sealed — exactly the
+    step that failed, in the on-error path) is included as a partial
+    record."""
+    import json
+    if path is None:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            'pt_trace_%d.json' % os.getpid())
+    recs = steps()
+    open_spans = list(_events)
+    if open_spans:
+        recs.append({'step': 'partial',
+                     't0': min(s[1] for s in open_spans),
+                     't1': max(s[2] for s in open_spans),
+                     'tid': None, 'spans': open_spans})
+    def safe_args(a):
+        if not a:
+            return None
+        return {str(k): _json_safe(v) for k, v in a.items()}
+
+    payload = {
+        'traceEvents': chrome_events(),
+        'displayTimeUnit': 'ms',
+        'ptSteps': [{'step': r['step'], 't0': r['t0'], 't1': r['t1'],
+                     'tid': r.get('tid'),
+                     'spans': [[s[0], s[1], s[2], s[3], s[4],
+                                safe_args(s[5])]
+                               for s in r['spans']]}
+                    for r in recs],
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # serialize BEFORE opening, write atomically: an incident dump
+    # must never leave a truncated JSON at the target path
+    blob = json.dumps(payload)
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    monitor.add('trace/dumps_written')
+    return path
+
+
+def dump_on_error(tag):
+    """Incident hook (NaN-check trip, segment dispatch failure): dump
+    the last N steps if the tracer is live.  Returns the path or None;
+    never raises — the original error must surface."""
+    if not _active:
+        return None
+    try:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            'pt_trace_%d_%s.json'
+                            % (os.getpid(), str(tag)))
+        return dump(path)
+    except Exception:
+        return None
+
+
+# ------------------------------------------------- device-capture attach
+def attach_capture():
+    """Called by fluid.profiler when a device trace starts: record
+    every span from here to detach (independent of ring eviction) so
+    the merged export covers the whole capture.  Enables the tracer if
+    it was off; detach restores that."""
+    global _capture
+    with _lock:
+        if _capture is not None:
+            return _capture
+        _capture = {'t0_us': now_us(), 'sync_us': None, 'events': [],
+                    'was_active': _active}
+        if not _active:
+            enable()
+        return _capture
+
+
+def mark_clock_sync():
+    """Record the host clock at the instant the paired 'pt_clock_sync'
+    device annotation is emitted (profiler.start_trace does both)."""
+    cap = _capture
+    if cap is not None:
+        cap['sync_us'] = now_us()
+
+
+def detach_capture():
+    """End the capture session: returns {'events', 'sync_us', 't0_us'}
+    (or None if no capture was attached) and restores the tracer's
+    pre-capture enabled state."""
+    global _capture, _active
+    with _lock:
+        cap, _capture = _capture, None
+        if cap is None:
+            return None
+        if not cap.pop('was_active'):
+            _active = False
+        return cap
+
+
+def write_host_trace(path, capture):
+    """Persist a capture session next to the device trace (stop_trace
+    does this) so tools/timeline.py can merge them offline."""
+    import json
+    with open(path, 'w') as f:
+        json.dump({'ptHostEvents': chrome_events(capture['events']),
+                   'ptSync': capture['sync_us'],
+                   'ptCaptureT0': capture['t0_us']}, f)
+    return path
+
+
+# FLAGS_trace=1 in the environment turns the flight recorder on at
+# import — the always-available production posture
+if get_flag('FLAGS_trace'):
+    enable()

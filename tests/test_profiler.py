@@ -127,6 +127,37 @@ def test_attribute_trace_events_maps_kernels_to_ops():
     assert top == 'mul'
 
 
+def test_attribute_trace_events_tolerates_malformed_events():
+    """Real captures carry counter rows without dur, instant events,
+    null args and non-string tf_op metadata — attribution must skip or
+    zero-time them, never raise (surfaced while wiring the host+device
+    timeline merger)."""
+    ev = [
+        # well-formed anchor
+        {'ph': 'X', 'name': 'fusion.1', 'dur': 100.0,
+         'args': {'tf_op': 'jit_seg/mul/dot_general:'}},
+        # missing dur / null dur / junk dur -> zero-timed, still counted
+        {'ph': 'X', 'name': 'fusion.2',
+         'args': {'tf_op': 'jit_seg/mul/dot_general:'}},
+        {'ph': 'X', 'name': 'fusion.3', 'dur': None,
+         'args': {'tf_op': 'jit_seg/mul/dot_general:'}},
+        {'ph': 'X', 'name': 'fusion.4', 'dur': 'n/a',
+         'args': {'tf_op': 'jit_seg/mul/dot_general:'}},
+        # non-string / non-dict metadata -> skipped
+        {'ph': 'X', 'name': 'fusion.5', 'dur': 5.0,
+         'args': {'tf_op': 123}},
+        {'ph': 'X', 'name': 'fusion.6', 'dur': 5.0, 'args': 'oops'},
+        # unknown op path + missing name -> unattributed bucket
+        {'ph': 'X', 'dur': 7.0, 'args': {'tf_op': 'jit_seg/mystery'}},
+        # non-dict rows in the list -> skipped
+        None, 'garbage', 42,
+    ]
+    recs = profiler.attribute_trace_events(ev, op_types={'mul'})
+    assert recs['mul'][0] == 4
+    assert abs(recs['mul'][1] - 100e-6) < 1e-12
+    assert recs['unattributed/?'][0] == 1
+
+
 def test_profiler_default_mode_keeps_fused_plan():
     """tracer_option='Default' must NOT re-segment the program: the
     executor's plan stays the production (fused) one."""

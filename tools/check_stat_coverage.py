@@ -85,6 +85,23 @@ REQUIRED = [
     # profiler fold-in + bench export
     ('paddle_tpu/fluid/profiler.py', "profiler/%s/calls"),
     ('bench.py', '_monitor_fields'),
+    # span tracer / flight recorder (fluid/trace.py): its own counters
+    # keep the trace plane observable through the monitor plane, and
+    # the phase-span instrument sites across the hot path feed the
+    # step_report() contract tools/check_trace.py gates end to end
+    ('paddle_tpu/fluid/trace.py', 'trace/spans_recorded'),
+    ('paddle_tpu/fluid/trace.py', 'trace/steps_recorded'),
+    ('paddle_tpu/fluid/trace.py', 'trace/steps_dropped'),
+    ('paddle_tpu/fluid/trace.py', 'trace/dumps_written'),
+    ('paddle_tpu/fluid/executor.py', "_trace.span('feed_h2d'"),
+    ('paddle_tpu/fluid/executor.py', "_trace.record('bind'"),
+    ('paddle_tpu/fluid/executor.py', "else 'dispatch'"),
+    ('paddle_tpu/fluid/executor.py', "_trace.record('fetch_d2h'"),
+    ('paddle_tpu/fluid/executor.py', 'executor/state_release_seconds'),
+    ('paddle_tpu/fluid/reader.py', "_trace.record('reader_wait'"),
+    ('paddle_tpu/fluid/parallel_executor.py', "_trace.step_span"),
+    ('paddle_tpu/fluid/compile_cache.py', "'cache_deserialize'"),
+    ('bench.py', '_step_phase_fields'),
 ]
 
 

@@ -1,0 +1,163 @@
+"""Trace-plane gate: the step timeline must actually decompose a real
+step, and must cost nothing when off (the fluid.trace analog of
+check_hot_path.py's counter budgets).
+
+Runs a real LeNet training step in three postures:
+
+  1. traced, under a jax.profiler device capture: the flight recorder
+     must hold spans for bind / dispatch / feed_h2d / fetch_d2h (>= 4
+     distinct host phases), and the merged host+device export must be
+     valid chrome-trace JSON (loadable, consistent event schema, host
+     spans on their own pid next to the device events);
+  2. report: step_report() phase sums must account for >= 80% of the
+     traced steady step's wall time — the "where did the millisecond
+     go" contract;
+  3. disabled: with the tracer off (the default), the steady-state
+     hot-path budgets of tools/check_hot_path.py must still hold — a
+     span site that allocates or locks on the disabled path shows up
+     there.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import sys
+
+COVERAGE_MIN = float(os.environ.get('PADDLE_TPU_TRACE_COVERAGE', 0.8))
+REQUIRED_PHASES = ('bind', 'dispatch', 'feed_h2d', 'fetch_d2h')
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    import tempfile
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import monitor, profiler, trace
+    from paddle_tpu import models
+
+    failures = []
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_p, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(64, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (64, 1)).astype('int64')}
+
+    logdir = tempfile.mkdtemp(prefix='pt_check_trace_')
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        # warm up: compiles land OUTSIDE the traced window so the
+        # traced step is the steady state the report must explain
+        for _ in range(3):
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        assert not trace.is_active(), 'tracer must default OFF'
+        profiler.start_trace(logdir)
+        for _ in range(3):
+            l, = exe.run(main_p, feed=feed, fetch_list=[loss])
+            np.asarray(l)
+        profiler.stop_trace()
+    assert not trace.is_active(), 'stop_trace must detach the tracer'
+
+    # -- 1. host phases recorded ------------------------------------
+    recs = trace.steps()
+    if not recs:
+        failures.append('no step records in the flight recorder')
+    names = set()
+    for r in recs:
+        names.update(s[0] for s in r['spans'])
+    missing = [p for p in REQUIRED_PHASES if p not in names]
+    if missing:
+        failures.append('host phase spans missing: %r (saw %r)'
+                        % (missing, sorted(names)))
+    if len(names) < 4:
+        failures.append('fewer than 4 distinct host phases: %r'
+                        % sorted(names))
+
+    # -- 2. merged export is valid chrome-trace JSON -----------------
+    sys.path.insert(0, os.path.join(root, 'tools'))
+    import timeline
+    out_path = os.path.join(logdir, 'merged_timeline.json')
+    src = timeline.find_trace(logdir)
+    host_path = timeline.find_host_trace(logdir)
+    if host_path is None:
+        failures.append('stop_trace wrote no host_trace.json')
+    else:
+        timeline.merge(src, host_path, out_path)
+        with open(out_path) as f:
+            doc = json.load(f)
+        evs = doc.get('traceEvents')
+        if not isinstance(evs, list) or not evs:
+            failures.append('merged export has no traceEvents')
+        else:
+            host_evs = [e for e in evs if e.get('cat') == 'pt_host'
+                        and e.get('ph') == 'X']
+            dev_evs = [e for e in evs if e.get('cat') != 'pt_host']
+            bad = [e for e in evs
+                   if e.get('ph') == 'X' and not (
+                       isinstance(e.get('name'), str) and
+                       isinstance(e.get('ts'), (int, float)) and
+                       isinstance(e.get('dur'), (int, float)) and
+                       isinstance(e.get('pid'), int))]
+            if bad:
+                failures.append('%d merged events violate the '
+                                'chrome-trace X schema (e.g. %r)'
+                                % (len(bad), bad[0]))
+            host_names = set(e['name'] for e in host_evs)
+            if len(host_names) < 4:
+                failures.append('merged export has < 4 distinct host '
+                                'phases: %r' % sorted(host_names))
+            if not dev_evs:
+                failures.append('merged export lost the device events')
+            host_pids = set(e['pid'] for e in host_evs)
+            dev_pids = set(e.get('pid') for e in dev_evs
+                           if isinstance(e.get('pid'), int))
+            if host_pids & dev_pids:
+                failures.append('host and device events share pids %r'
+                                % (host_pids & dev_pids))
+            print('merged export: %d device + %d host events, host '
+                  'phases %s' % (len(dev_evs), len(host_evs),
+                                 sorted(host_names)))
+
+    # -- 3. step report explains the step --------------------------
+    rep = trace.step_report()
+    steady = rep['steps'][1:] if len(rep['steps']) > 1 else rep['steps']
+    if not steady:
+        failures.append('step_report returned no steps')
+    else:
+        best = max(s['coverage'] for s in steady)
+        print('step report: %d steps, wall p50 %.2f ms, best steady '
+              'coverage %.0f%%'
+              % (rep['rollup']['count'], rep['rollup']['wall_p50_ms'],
+                 100 * best))
+        print(trace.format_step_report(rep))
+        if best < COVERAGE_MIN:
+            failures.append(
+                'phase sums account for %.0f%% of step wall time '
+                '(need >= %.0f%%)' % (100 * best, 100 * COVERAGE_MIN))
+
+    trace.reset()
+    monitor.reset()
+
+    # -- 4. disabled tracer keeps the hot-path budgets ---------------
+    import check_hot_path
+    rc = check_hot_path.main()
+    if rc != 0:
+        failures.append('check_hot_path budgets violated with the '
+                        'tracer disabled (rc=%d)' % rc)
+
+    if failures:
+        for f in failures:
+            print('TRACE GATE  ' + f)
+        return 1
+    print('trace plane: ok')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

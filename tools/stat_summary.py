@@ -1,20 +1,28 @@
-"""Render or diff fluid.monitor JSONL dumps.
+"""Render or diff fluid.monitor JSONL dumps, or a fluid.trace step
+report.
 
 Usage:
   python tools/stat_summary.py run.jsonl            # render last line
   python tools/stat_summary.py before.jsonl after.jsonl   # diff
   python tools/stat_summary.py --live               # snapshot of THIS
                                                     # process's registry
+  python tools/stat_summary.py --steps dump.json    # per-step phase
+                                                    # report from a
+                                                    # trace.dump() file
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
 for counters and histograms — the per-interval rates a trajectory of
 dump_jsonl() lines is for (e.g. diffing two BENCH rounds' monitor
-sections).  Companion of tools/timeline.py (traces) and the profiler
-table: this one reads the ALWAYS-ON stats.
+sections).  --steps reads the flight-recorder dump fluid.trace.dump()
+writes (its 'ptSteps' records) and prints the bind / feed_h2d /
+dispatch / fetch_d2h breakdown per step with p50/p99/slowest rollups.
+Companion of tools/timeline.py (traces) and the profiler table: this
+one reads the ALWAYS-ON stats.
 """
 
 import json
+import os
 import sys
 
 
@@ -49,14 +57,16 @@ def _fmt(v):
     return '%.6g' % v
 
 
-def render(rec, out=sys.stdout):
+def render(rec, out=None):
+    out = out if out is not None else sys.stdout
     out.write('%-52s %-10s %14s\n' % ('stat', 'kind', 'value'))
     for n, kind, v in _rows(rec):
         out.write('%-52s %-10s %14s\n' % (n, kind, _fmt(v)))
 
 
-def diff(before, after, out=sys.stdout):
+def diff(before, after, out=None):
     """after − before for cumulative stats; gauges show both levels."""
+    out = out if out is not None else sys.stdout
     b = dict((n, v) for n, k, v in _rows(before) if k != 'gauge')
     out.write('%-52s %14s\n' % ('stat', 'delta'))
     for n, kind, v in _rows(after):
@@ -71,10 +81,34 @@ def diff(before, after, out=sys.stdout):
                      _fmt(ga.get(n, 0.0))))
 
 
+def steps_report(path, out=None):
+    """Per-step phase table from a fluid.trace.dump() file."""
+    # resolve stdout at CALL time: the module may be imported while a
+    # test harness has stdout captured, and a def-time default would
+    # pin that (soon-closed) stream
+    out = out if out is not None else sys.stdout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.fluid import trace as pt_trace
+    with open(path) as f:
+        recs = json.load(f).get('ptSteps', [])
+    if not recs:
+        out.write('no step records in %s (was the tracer enabled?)\n'
+                  % path)
+        return 1
+    rep = pt_trace.report_from_records(recs)
+    out.write(pt_trace.format_step_report(rep) + '\n')
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == '--steps':
+        if len(argv) != 2:
+            sys.stderr.write(__doc__)
+            return 2
+        return steps_report(argv[1])
     if argv == ['--live']:
-        import os
         sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')

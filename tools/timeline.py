@@ -1,18 +1,26 @@
-"""Export a chrome://tracing file from a captured profile.
+"""Export a chrome://tracing file from a captured profile, merging the
+fluid.trace host spans with the device trace when both exist.
 
 Reference: tools/timeline.py converts the profiler's protobuf dump into
 chrome-trace JSON.  The jax profiler (fluid.profiler wraps it) already
 emits a gzipped chrome trace inside its plugin directory; this tool
 locates it and writes a plain .json chrome://tracing / Perfetto can
-open directly.
+open directly.  Since the fluid.trace PR, `fluid.profiler.start_trace`
+also rides the span tracer along and `stop_trace` drops the host spans
+as `<logdir>/host_trace.json` — when that file is present (or passed
+via --host_trace), the output is ONE merged timeline: device kernels
+on their original pids, host phase spans (bind / feed_h2d / dispatch /
+compile / reader_wait / fetch_d2h) on a 'paddle_tpu host' process,
+aligned on the pt_clock_sync annotation the capture emitted.
 
 Usage: python tools/timeline.py --profile_path /tmp/profile \
-           --timeline_path /tmp/timeline.json
+           --timeline_path /tmp/timeline.json [--host_trace host.json]
 """
 
 import argparse
 import glob
 import gzip
+import json
 import os
 import shutil
 import sys
@@ -23,7 +31,8 @@ def find_trace(profile_path):
             os.path.join(profile_path, '**', '*.trace.json')]
     hits = []
     for p in pats:
-        hits.extend(glob.glob(p, recursive=True))
+        hits.extend(h for h in glob.glob(p, recursive=True)
+                    if not h.endswith('host_trace.json'))
     if not hits:
         raise SystemExit(
             'no trace found under %s — capture one with '
@@ -32,12 +41,53 @@ def find_trace(profile_path):
     return max(hits, key=os.path.getmtime)
 
 
+def find_host_trace(profile_path):
+    hits = glob.glob(os.path.join(profile_path, '**', 'host_trace.json'),
+                     recursive=True)
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def load_device_events(src):
+    opener = gzip.open if src.endswith('.gz') else open
+    with opener(src, 'rt') as f:
+        return json.load(f).get('traceEvents', [])
+
+
+def merge(src, host_path, out_path):
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from paddle_tpu.fluid import trace as pt_trace
+    with open(host_path) as f:
+        host = json.load(f)
+    merged = pt_trace.merge_device_trace(
+        host.get('ptHostEvents', []), load_device_events(src),
+        sync_host_us=host.get('ptSync'),
+        capture_t0_us=host.get('ptCaptureT0'))
+    pt_trace.write_chrome(out_path, merged)
+    n_host = sum(1 for e in host.get('ptHostEvents', [])
+                 if e.get('ph') == 'X')
+    return n_host
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--profile_path', default='/tmp/profile')
     ap.add_argument('--timeline_path', default='/tmp/timeline.json')
+    ap.add_argument('--host_trace', default=None,
+                    help='host_trace.json written by fluid.profiler.'
+                         'stop_trace (default: auto-discover under '
+                         'profile_path)')
     args = ap.parse_args()
     src = find_trace(args.profile_path)
+    host_path = args.host_trace or find_host_trace(args.profile_path)
+    if host_path:
+        n_host = merge(src, host_path, args.timeline_path)
+        print('merged chrome trace written to %s (%d host spans + '
+              'device events; open in chrome://tracing or '
+              'https://ui.perfetto.dev)'
+              % (args.timeline_path, n_host))
+        return 0
+    # device-only capture: passthrough, byte-identical to the source
     if src.endswith('.gz'):
         with gzip.open(src, 'rb') as f_in, \
                 open(args.timeline_path, 'wb') as f_out:
