@@ -48,14 +48,18 @@ bench:
 # gates: the monitor instrument points the observability contract
 # depends on must stay in the source, the steady-state step fast
 # path must stay within its per-step counter budgets, the persistent
-# compile cache must carry executables across processes, and the
-# trace plane must decompose a real step (merged host+device export,
-# >=80% phase coverage) without costing anything when disabled
+# compile cache must carry executables across processes, the trace
+# plane must decompose a real step (merged host+device export,
+# >=80% phase coverage) without costing anything when disabled, and
+# the health plane must serve lint-clean /metrics + schema-stable
+# /healthz//statusz off a live executor with zero hot-path cost when
+# tensor-health summaries are off
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
 	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
 	JAX_PLATFORMS=cpu python tools/check_trace.py
+	JAX_PLATFORMS=cpu python tools/check_health.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -775,7 +775,77 @@ def _run_cold(cache_dir=None, out_path=None):
     return None
 
 
+def bench_health_overhead(depth=4, width=64, batch=32, steps=60,
+                          warmup=8):
+    """FLAGS_health_summaries on/off A/B on one small MLP: the BENCH
+    JSON records the per-step cost of the opt-in tensor-health
+    reductions AND enforces the 'costs nothing when off' claim — the
+    off posture must match the plain dispatch profile (summaries
+    record zero health counters), and the on posture's overhead is
+    published so a regression (e.g. a reduction that starts blocking
+    per param) is visible in the trajectory, not just in a gate."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import health, monitor
+
+    def build(seed):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[width], dtype='float32')
+            h = x
+            for _ in range(depth):
+                h = fluid.layers.fc(h, size=width, act='relu')
+            loss = fluid.layers.reduce_mean(fluid.layers.square(h))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        return main, startup, loss
+
+    feed = {'x': jax.device_put(np.ones((batch, width), 'float32'))}
+
+    def timed(flag_on, seed):
+        # the flag keys the PLAN (param grads surface as segment
+        # outputs), so each posture builds its own program
+        fluid.flags.set_flags({'FLAGS_health_summaries': flag_on})
+        health.reset_state()
+        try:
+            main, startup, loss = build(seed)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.XLAPlace(0))
+                exe.run(startup)
+                for _ in range(warmup):
+                    exe.run(main, feed=feed, fetch_list=[])
+                pname = main.all_parameters()[0].name
+                jax.block_until_ready(scope.find_var(pname))
+                t0 = time.time()
+                for _ in range(steps):
+                    exe.run(main, feed=feed, fetch_list=[])
+                    jax.block_until_ready(scope.find_var(pname))
+                return (time.time() - t0) / steps
+        finally:
+            fluid.flags.set_flags({'FLAGS_health_summaries': False})
+
+    off_s = timed(False, 42)
+    recorded_off = monitor.counter_value('health/summary_steps')
+    on_s = timed(True, 42)
+    recorded_on = monitor.counter_value('health/summary_steps') - \
+        recorded_off
+    return dict({'metric': 'health_overhead_us_per_step_d%d' % depth,
+                 'value': round((on_s - off_s) * 1e6, 1),
+                 'unit': 'us/step',
+                 'health_overhead': {
+                     'off_us_per_step': round(off_s * 1e6, 1),
+                     'on_us_per_step': round(on_s * 1e6, 1),
+                     'overhead_pct': round(
+                         100.0 * (on_s - off_s) / max(off_s, 1e-12),
+                         1),
+                     'summaries_recorded_off': recorded_off,
+                     'summaries_recorded_on': recorded_on}},
+                **_monitor_fields())
+
+
 SMOKE_BENCHES = (('dispatch', {}),
+                 ('health_overhead', {}),
                  ('lenet', {'batch': 64, 'steps': 30}))
 
 

@@ -30,6 +30,14 @@ def _parse_args():
     p.add_argument('--nproc_per_node', type=int, default=1,
                    help='processes per host (1 for TPU SPMD)')
     p.add_argument('--log_dir', type=str, default=None)
+    p.add_argument('--status_port', type=int,
+                   default=int(os.environ.get(
+                       'PADDLE_TPU_STATUS_PORT_BASE', 0)),
+                   help='base port for the fluid.health status plane: '
+                        'worker RANK serves /metrics//healthz//statusz '
+                        'on status_port+rank and rank 0 aggregates the '
+                        'job, so scraping status_port covers every '
+                        'worker; 0 (default) disables')
     p.add_argument('training_script', type=str)
     p.add_argument('training_script_args', nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -42,11 +50,36 @@ def launch():
     node_id = ips.index(args.node_ip) if args.node_ip in ips else 0
     coordinator = '%s:%d' % (ips[0], args.started_port)
 
+    # fluid.health status plane: every worker gets its own port
+    # (status_port + global rank) and the full worker map; rank 0's
+    # server aggregates, making the job ONE scrape target
+    status_workers = ''
+    if args.status_port:
+        status_workers = ','.join(
+            '%d=%s:%d' % (ip_i * args.nproc_per_node + r, ip,
+                          args.status_port +
+                          ip_i * args.nproc_per_node + r)
+            for ip_i, ip in enumerate(ips)
+            for r in range(args.nproc_per_node))
+
     procs = []
     for local_rank in range(args.nproc_per_node):
         rank = node_id * args.nproc_per_node + local_rank
         world = nnodes * args.nproc_per_node
         env = dict(os.environ)
+        if args.status_port:
+            env.update({
+                'FLAGS_status_port': str(args.status_port + rank),
+                'PADDLE_TPU_STATUS_WORKERS': status_workers,
+                'PADDLE_TPU_STATUS_AGGREGATE':
+                    '1' if rank == 0 else '0',
+            })
+            if any(ip not in ('127.0.0.1', 'localhost')
+                   for ip in ips):
+                # the worker map advertises real-IP endpoints: a
+                # loopback-bound server would refuse every aggregator
+                # scrape (single-node real-IP launches included)
+                env.setdefault('PADDLE_TPU_STATUS_HOST', '0.0.0.0')
         env.update({
             'PADDLE_TRAINER_ID': str(rank),
             'PADDLE_TRAINERS_NUM': str(world),

@@ -9,6 +9,7 @@ optional).
 
 from . import monitor  # dependency-free; first so every layer can use it
 from . import trace    # span tracer: needs only monitor + flags
+from . import health   # HTTP status plane: needs only monitor + trace
 from . import core
 from .core import (CPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
                    LoDTensor, SelectedRows, Scope, global_scope,

@@ -47,7 +47,7 @@ def _stat_nbytes(v):
 class _Segment(object):
     __slots__ = ('ops', 'input_names', 'state_names', 'output_names',
                  'compiled', 'bucket_ops', 'prefer_test', 'binder',
-                 'pbinder')
+                 'pbinder', 'health_params')
 
     def __init__(self, ops):
         self.ops = ops
@@ -73,6 +73,9 @@ class _Segment(object):
         # `pbinder` the parallel/collective runners (raw feeds)
         self.binder = None
         self.pbinder = None
+        # (param names this segment updates, param->grad map) for the
+        # FLAGS_health_summaries reductions; resolved lazily
+        self.health_params = None
 
 
 class _Plan(list):
@@ -314,6 +317,32 @@ def _release_donated_state(state):
     t1 = _time_mod.perf_counter()
     monitor.observe('executor/state_release_seconds', t1 - t0)
     _trace.record('state_release', t0, t1)
+
+
+def _survivable_copy(v):
+    """A copy of a segment argument that survives the step: state
+    buffers are DONATED to the executable (deleted once it runs), so
+    NaN-provenance replay and update-ratio summaries must snapshot
+    them beforehand.  Device values copy on device (async — the copy
+    dispatches ahead of the step and never blocks it); everything else
+    is already host-owned."""
+    if isinstance(v, jax.Array):
+        try:
+            return jax.numpy.array(v, copy=True)
+        except Exception:
+            return np.asarray(v)
+    return v
+
+
+def _segment_health_names(seg):
+    """(params this segment updates, param->grad name map) for the
+    tensor-health summaries — resolved once per segment from the
+    owning program."""
+    program = seg.ops[0].block.program
+    pnames = set(p.name for p in program.all_parameters())
+    gmap = getattr(program, '_grad_name_map', {})
+    updated = sorted(pnames & set(seg.output_names))
+    return (updated, {p: g for p, g in gmap.items() if p in pnames})
 
 
 def _op_reads(op):
@@ -609,10 +638,21 @@ def _lower_conditional_block(op, env, step, prefer_test):
 
 
 def _add_note(e, note):
-    """Attach context to an exception (PEP 678); no-op fallback on
-    interpreters without add_note so the real error is never masked."""
+    """Attach context to an exception (PEP 678).  Interpreters without
+    add_note (< 3.11) get the same `__notes__` list stamped directly —
+    tooling (pytest, the error-context tests, incident reports) reads
+    the attribute, even though the 3.10 traceback renderer won't print
+    it.  Never raises: the real error must never be masked."""
     if hasattr(e, 'add_note'):
         e.add_note(note)
+        return
+    try:
+        notes = getattr(e, '__notes__', None)
+        if notes is None:
+            notes = e.__notes__ = []
+        notes.append(note)
+    except Exception:
+        pass
 
 
 def _op_error_context(op, ins):
@@ -1116,6 +1156,8 @@ class CompiledPipeline(object):
         monitor.add('executor/run_calls')
         monitor.observe('executor/run_seconds',
                         _time_mod.perf_counter() - t0)
+        monitor.set_gauge('executor/last_step_unix_ts',
+                          _time_mod.time())
         return out
 
 
@@ -1125,6 +1167,11 @@ class Executor(object):
     def __init__(self, place=None):
         self.place = place or core.XLAPlace(0)
         self._step = 0
+        # FLAGS_status_port: the status/metrics HTTP plane starts with
+        # the first executor (no-op when the flag is 0 or a server is
+        # already up)
+        from . import health as _health
+        _health.ensure_serving()
 
     def close(self):
         pass
@@ -1487,6 +1534,10 @@ class Executor(object):
         monitor.add('executor/run_calls')
         monitor.observe('executor/run_seconds',
                         _time_mod.perf_counter() - t0)
+        # /healthz readiness staleness: when did this process last
+        # complete a step (one clock read + dict store)
+        monitor.set_gauge('executor/last_step_unix_ts',
+                          _time_mod.time())
         return out
 
     def program_cost(self, program, feed, fetch_list=None, scope=None):
@@ -1679,6 +1730,18 @@ class Executor(object):
         # extra outputs: vars consumed outside the program by host
         # protocols (e.g. async-PS grad push), exempt from DCE
         extra_outputs = set(getattr(program, '_extra_output_names', ()))
+        from .flags import get_flag
+        if get_flag('FLAGS_health_summaries'):
+            # tensor-health grad norms need the PARAM gradients
+            # observable at the segment boundary (activation grads stay
+            # DCE-able — materializing those would defeat fusion).
+            # Plans are cached: set the flag before the first run of a
+            # program for its grads to surface.
+            gmap = getattr(program, '_grad_name_map', {})
+            if gmap:
+                pnames = set(p.name for p in program.all_parameters())
+                extra_outputs |= set(g for p, g in gmap.items()
+                                     if p in pnames)
         # reads of later items, computed backwards
         later_reads = [set()] * len(items)
         acc = set()
@@ -1994,6 +2057,33 @@ class Executor(object):
         if binder is None:
             binder = seg.binder = _SegmentBinder(seg)
         state, data = binder.bind(feed, scope)
+        check_nan = bool(get_flag('FLAGS_check_nan_inf'))
+        health_on = bool(get_flag('FLAGS_health_summaries'))
+        replay = None
+        if check_nan and get_flag('FLAGS_nan_replay', True):
+            # the op-by-op provenance replay needs the segment inputs
+            # AS FED; state buffers are donated (deleted by the step),
+            # so snapshot them now — async device copies, debug-mode
+            # only (data args are not donated: pointers suffice)
+            with _trace.span('nan_snapshot'):
+                replay = ({n: _survivable_copy(v)
+                           for n, v in state.items()}, dict(data))
+        prev_params = None
+        hp = None
+        if health_on:
+            hp = seg.health_params
+            if hp is None:
+                hp = seg.health_params = _segment_health_names(seg)
+            if hp[0]:
+                # update ratios compare against the pre-step weights,
+                # which the donated step deletes — same snapshot rule;
+                # a live nan-replay snapshot already paid for these
+                # copies, reuse it instead of copying params twice
+                src = replay[0] if replay is not None else None
+                prev_params = {
+                    n: (src[n] if src is not None and n in src
+                        else _survivable_copy(state[n]))
+                    for n in hp[0] if n in state}
         plane = compile_cache.plane()
         first_run = False
         if plane.active and not auto:
@@ -2102,21 +2192,31 @@ class Executor(object):
                 _add_note(e, 'trace flight recorder (last %d steps) '
                           'dumped to %s' % (len(_trace.steps()), dump))
             raise
-        if get_flag('FLAGS_check_nan_inf'):
-            self._check_nan_inf(out)
+        if check_nan:
+            self._check_nan_inf(out, seg=seg, replay=replay)
+        if health_on and hp is not None and hp[0]:
+            from . import health as _health
+            _health.summarize_step(self._step, out, prev_params or {},
+                                   hp[0], hp[1])
         for n, v in out.items():
             scope.set_var(n, v)
             fetched[n] = v
         _release_donated_state(state)
 
-    def _check_nan_inf(self, out):
+    def _check_nan_inf(self, out, seg=None, replay=None):
         """Reference: CheckVarHasNanOrInf per-op sweep
         (framework/details/nan_inf_utils.h:28) — here per segment
         output, which is where values become observable.  The isfinite
         reduction runs ON DEVICE; only the per-var scalar verdict
         crosses to the host (the old path np.asarray'd every full
         output tensor every step).  All reductions dispatch before the
-        first verdict blocks, so the device sweeps them in one wave."""
+        first verdict blocks, so the device sweeps them in one wave —
+        and since every verdict is already in flight, the error
+        reports EVERY non-finite var of the step, not just the first.
+        A trip then replays the segment op-by-op against the recorded
+        inputs (fluid.health.nan_provenance) to name the op desc that
+        first went non-finite — the reference's per-op sweep
+        granularity, paid only post-mortem."""
         import jax.numpy as jnp
         verdicts = []
         for n, v in out.items():
@@ -2127,20 +2227,40 @@ class Executor(object):
                 arr = np.asarray(core.as_array(v))
                 if np.issubdtype(arr.dtype, np.floating):
                     verdicts.append((n, np.isfinite(arr).all()))
-        for n, ok in verdicts:
-            if not bool(ok):
-                err = FloatingPointError(
-                    'nan/inf detected in var %s (step %d)'
-                    % (n, self._step))
-                # incident capture: the flight recorder holds the last
-                # N steps' spans — exactly the window that produced the
-                # NaN — dump it before the step loop unwinds
-                dump = _trace.dump_on_error('nan_step%d' % self._step)
-                if dump:
-                    _add_note(err, 'trace flight recorder (last %d '
-                              'steps) dumped to %s'
-                              % (len(_trace.steps()), dump))
-                raise err
+        bad = [n for n, ok in verdicts if not bool(ok)]
+        if not bad:
+            return
+        monitor.add('health/nan_trips')
+        from . import health as _health
+        parts = ['nan/inf detected in %d var(s) [%s] (step %d)'
+                 % (len(bad), ', '.join(bad), self._step)]
+        report = None
+        if seg is not None and replay is not None:
+            with _trace.span('nan_replay', ops=len(seg.ops)):
+                report = _health.nan_provenance(
+                    seg.ops, replay[0], replay[1], self._step,
+                    seg.prefer_test)
+            parts.append(_health.format_provenance(report))
+        # incident capture: the flight recorder holds the last N
+        # steps' spans — exactly the window that produced the NaN —
+        # dump it (with the provenance report embedded) before the
+        # step loop unwinds
+        extra = {'kind': 'nan_check', 'step': self._step,
+                 'bad_vars': bad}
+        if report is not None:
+            extra['provenance'] = report
+        dump = _trace.dump_on_error('nan_step%d' % self._step,
+                                    extra=extra)
+        if dump:
+            parts.append('trace flight recorder (last %d steps) '
+                         'dumped to %s' % (len(_trace.steps()), dump))
+        # the provenance/dump notes go INTO the message (this
+        # interpreter may predate PEP 678 add_note) and as notes for
+        # 3.11+ tooling that renders them separately
+        err = FloatingPointError('\n'.join(parts))
+        for p in parts[1:]:
+            _add_note(err, p)
+        raise err
 
 
 def _as_numpy(v):

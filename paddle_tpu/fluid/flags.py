@@ -70,6 +70,44 @@ _DEFAULTS = {
     # dispatch failure), older steps evict ('trace/steps_dropped').
     'FLAGS_trace': False,
     'FLAGS_trace_buffer_steps': 16,
+    # fluid.health status plane (fluid/health.py): a nonzero port
+    # starts the background HTTP status server at the first Executor
+    # construction, exposing /metrics (Prometheus), /healthz
+    # (liveness+readiness), /statusz (JSON runtime report) and
+    # /trace/dump (on-demand flight-recorder dump).  0 (the default)
+    # leaves the plane off; monitor.serve(port)/health.serve(port)
+    # start it explicitly (port=0 there picks an ephemeral port).
+    'FLAGS_status_port': 0,
+    # readiness staleness bound: with steps recorded, /healthz reports
+    # not-ready when the last step is older than this many seconds
+    # (0 disables the age check — batch jobs legitimately pause)
+    'FLAGS_status_ready_max_step_age': 0.0,
+    # aggregator probe cadence AND per-worker scrape timeout for the
+    # rank-0 merged status plane (distributed/launch.py wires the
+    # worker endpoints): a dead worker flips aggregated readiness
+    # within one interval
+    'FLAGS_health_heartbeat_seconds': 2.0,
+    # opt-in per-step tensor-health summaries (fluid/health.py): fused
+    # on-device reductions — global grad norm, per-param weight/grad/
+    # update norms, update ratios — dispatched in one wave with
+    # scalar-only host transfer, recorded into monitor histograms and
+    # trace spans.  Off (the default) adds ZERO per-step host cost
+    # (tools/check_health.py gates this via check_hot_path).
+    'FLAGS_health_summaries': False,
+    # spike detector: a global grad norm this many times above its
+    # running EMA auto-dumps the flight recorder (health/grad_spikes)
+    'FLAGS_health_spike_factor': 10.0,
+    # zero-update detector: this many consecutive steps with a zero
+    # max update ratio auto-dump the flight recorder
+    # (health/zero_update_trips); 0 disables
+    'FLAGS_health_zero_update_steps': 3,
+    # NaN provenance (executor._check_nan_inf): with
+    # FLAGS_check_nan_inf on, keep per-step device copies of segment
+    # state so a tripped verdict can replay the segment op-by-op and
+    # name the op that first produced a non-finite value.  On by
+    # default (it only costs while nan-checking, itself a debug mode);
+    # turn off to nan-check huge models without the state copies.
+    'FLAGS_nan_replay': True,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

@@ -38,7 +38,9 @@ import time
 __all__ = [
     'add', 'set_gauge', 'observe', 'counter_value', 'gauge_value',
     'histogram_value', 'reset', 'set_enabled', 'snapshot', 'flat',
-    'dump_jsonl', 'prometheus_text', 'TIME_BUCKETS', 'SIZE_BUCKETS',
+    'dump_jsonl', 'prometheus_text', 'raw_state', 'serve',
+    'prom_escape_help', 'prom_escape_label', 'prom_sample',
+    'TIME_BUCKETS', 'SIZE_BUCKETS', 'NORM_BUCKETS',
 ]
 
 # histogram edge presets: seconds (compile/run/blocked latencies span
@@ -46,6 +48,10 @@ __all__ = [
 TIME_BUCKETS = (0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
                 1.0, 5.0, 10.0, 30.0, 60.0, 300.0)
 SIZE_BUCKETS = (1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10)
+# norms/ratios (tensor-health summaries span ~1e-8 dead params to
+# ~1e4 exploding grads)
+NORM_BUCKETS = (1e-8, 1e-6, 1e-4, 1e-2, 0.1, 0.5, 1.0, 2.0, 5.0,
+                10.0, 100.0, 1e3, 1e4)
 
 _enabled = True
 _counters = {}   # name -> float
@@ -159,6 +165,21 @@ def flat():
     return out
 
 
+def raw_state():
+    """JSON-able copy of the whole registry with RAW histogram buckets
+    (edges + per-bucket counts, not the cumulative rendering) — the
+    merge-friendly form fluid.health's aggregator ships between
+    processes: counters/bucket counts/sums add, gauges keep per-worker
+    identity."""
+    return {
+        'counters': dict(_counters),
+        'gauges': dict(_gauges),
+        'hists': {n: {'edges': list(h[0]), 'counts': list(h[1]),
+                      'sum': h[2], 'count': h[3]}
+                  for n, h in _hists.items()},
+    }
+
+
 def dump_jsonl(path, step=None, extra=None):
     """Append ONE json line holding the full registry — call once per
     step (or per bench entry) to build a trajectory file that
@@ -188,23 +209,69 @@ def _prom_num(v):
     return '%.10g' % v
 
 
+def prom_escape_help(text):
+    """HELP-line escaping per the text exposition format: backslash and
+    newline must be escaped or a multi-line help string corrupts the
+    whole scrape."""
+    return str(text).replace('\\', '\\\\').replace('\n', '\\n')
+
+
+def prom_escape_label(value):
+    """Label-VALUE escaping (backslash, double-quote, newline) — the
+    rule the aggregator's worker/endpoint labels and any future
+    user-supplied label must go through; an unescaped quote in a label
+    value truncates the series at scrape time."""
+    return (str(value).replace('\\', '\\\\').replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def prom_sample(name, labels, value):
+    """One exposition sample line with escaped label values; `labels`
+    is a (key, value) sequence (ordered — prometheus treats label
+    order as irrelevant but the lint wants deterministic output)."""
+    if labels:
+        body = ','.join('%s="%s"' % (_PROM_BAD.sub('_', str(k)),
+                                     prom_escape_label(v))
+                        for k, v in labels)
+        return '%s{%s} %s' % (name, body, _prom_num(value))
+    return '%s %s' % (name, _prom_num(value))
+
+
+def _prom_block(lines, m, kind, help_text, seen):
+    """Emit the # HELP / # TYPE preamble once per metric family.  Two
+    registry names CAN sanitize to one exposition name ('a/b-c' and
+    'a/b_c'); the second family must not re-emit the preamble — the
+    fluid.health lint flags duplicate metadata as a scrape error."""
+    if m in seen:
+        return False
+    seen.add(m)
+    lines.append('# HELP %s %s' % (m, prom_escape_help(help_text)))
+    lines.append('# TYPE %s %s' % (m, kind))
+    return True
+
+
 def prometheus_text(prefix='paddle_tpu'):
-    """Prometheus text exposition format (one # TYPE line per metric;
-    histograms emit cumulative le-labelled buckets, _sum and _count) —
-    serve it from any HTTP handler to scrape the process."""
+    """Prometheus text exposition format (one # HELP + # TYPE line per
+    metric; histograms emit cumulative le-labelled buckets, _sum and
+    _count) — fluid.health serves it at /metrics; any HTTP handler can
+    serve it to scrape the process."""
     lines = []
+    seen = set()
     for n in sorted(_counters):
         m = _prom_name(n, prefix)
-        lines.append('# TYPE %s counter' % m)
+        _prom_block(lines, m, 'counter',
+                    'paddle_tpu runtime counter %s' % n, seen)
         lines.append('%s %s' % (m, _prom_num(_counters[n])))
     for n in sorted(_gauges):
         m = _prom_name(n, prefix)
-        lines.append('# TYPE %s gauge' % m)
+        _prom_block(lines, m, 'gauge',
+                    'paddle_tpu runtime gauge %s' % n, seen)
         lines.append('%s %s' % (m, _prom_num(_gauges[n])))
     for n in sorted(_hists):
         edges, counts, total, cnt = _hists[n]
         m = _prom_name(n, prefix)
-        lines.append('# TYPE %s histogram' % m)
+        _prom_block(lines, m, 'histogram',
+                    'paddle_tpu runtime histogram %s' % n, seen)
         cum = 0
         for edge, c in zip(edges, counts):
             cum += c
@@ -213,3 +280,12 @@ def prometheus_text(prefix='paddle_tpu'):
         lines.append('%s_sum %s' % (m, _prom_num(total)))
         lines.append('%s_count %d' % (m, cnt))
     return '\n'.join(lines) + '\n'
+
+
+def serve(port=None, host=None):
+    """Start the HTTP status plane serving this registry (plus
+    /healthz, /statusz, /trace/dump) on a background thread; returns
+    the fluid.health server handle (`.port` holds the bound port —
+    pass port=0 for an ephemeral one).  Idempotent per process."""
+    from . import health
+    return health.serve(port=port, host=host)

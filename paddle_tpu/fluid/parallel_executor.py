@@ -293,6 +293,7 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     monitor.add('executor/run_calls')
     monitor.observe('executor/run_seconds',
                     _time_mod.perf_counter() - t_run0)
+    monitor.set_gauge('executor/last_step_unix_ts', _time_mod.time())
     return results
 
 
@@ -368,13 +369,22 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
             fp, lambda: jax.jit(fn, in_shardings=in_shardings,
                                 donate_argnums=(1,)))
         seg.compiled['parallel'] = compiled
-    if first_run:
-        t0 = _time_mod.perf_counter()
-    with _trace.span('compile' if first_run else 'dispatch'):
-        out = compiled(executor._step, state, data)
-    if first_run:
-        monitor.observe('parallel/segment_compile_seconds',
-                        _time_mod.perf_counter() - t0)
+    try:
+        if first_run:
+            t0 = _time_mod.perf_counter()
+        with _trace.span('compile' if first_run else 'dispatch'):
+            out = compiled(executor._step, state, data)
+        if first_run:
+            monitor.observe('parallel/segment_compile_seconds',
+                            _time_mod.perf_counter() - t0)
+    except Exception as e:
+        # same incident contract as the single-device executor: the
+        # flight recorder holds the steps that led here — dump it
+        dump = _trace.dump_on_error('segfail_step%d' % executor._step)
+        if dump:
+            _add_note(e, 'trace flight recorder (last %d steps) '
+                      'dumped to %s' % (len(_trace.steps()), dump))
+        raise
     for n, v in out.items():
         scope.set_var(n, v)
         fetched[n] = v
@@ -433,6 +443,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
     monitor.add('executor/run_calls')
     monitor.observe('executor/run_seconds',
                     _time_mod.perf_counter() - t_run0)
+    monitor.set_gauge('executor/last_step_unix_ts', _time_mod.time())
     return results
 
 
@@ -484,8 +495,9 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                 donate=True, purpose='collective')
 
             def _build(_fn=fn, _in=in_specs, _out=out_specs):
-                sm = jax.shard_map(_fn, mesh=mesh, in_specs=_in,
-                                   out_specs=_out, check_vma=False)
+                from ..compat import shard_map
+                sm = shard_map(_fn, mesh=mesh, in_specs=_in,
+                               out_specs=_out)
                 return jax.jit(sm, donate_argnums=(1,))
 
             compiled = compile_cache.plane().shared_jit(fp, _build)
@@ -514,6 +526,11 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                         getattr(v, 'dtype', '?'),
                         getattr(v, 'sharding', type(v).__name__)))
             _add_note(e, 'segment inputs:\n  ' + '\n  '.join(detail))
+            dump = _trace.dump_on_error(
+                'segfail_step%d' % executor._step)
+            if dump:
+                _add_note(e, 'trace flight recorder (last %d steps) '
+                          'dumped to %s' % (len(_trace.steps()), dump))
             raise
         for n, v in out.items():
             scope.set_var(n, v)

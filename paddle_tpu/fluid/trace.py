@@ -535,13 +535,15 @@ def write_chrome(path, events):
 
 
 # ------------------------------------------------------- flight recorder
-def dump(path=None):
+def dump(path=None, extra=None):
     """Write the flight recorder (last N steps) as chrome-trace JSON;
     the same file carries the raw step records under 'ptSteps' so
     stat_summary.py --steps can rebuild the report offline.  The step
     IN FLIGHT (spans recorded since the last step sealed — exactly the
     step that failed, in the on-error path) is included as a partial
-    record."""
+    record.  `extra` (a JSON-able dict — e.g. the executor's NaN
+    provenance report) is embedded under 'ptIncident' so the dump that
+    captures an incident also carries its diagnosis."""
     import json
     if path is None:
         import tempfile
@@ -569,6 +571,8 @@ def dump(path=None):
                                for s in r['spans']]}
                     for r in recs],
     }
+    if extra:
+        payload['ptIncident'] = extra
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -583,10 +587,11 @@ def dump(path=None):
     return path
 
 
-def dump_on_error(tag):
-    """Incident hook (NaN-check trip, segment dispatch failure): dump
-    the last N steps if the tracer is live.  Returns the path or None;
-    never raises — the original error must surface."""
+def dump_on_error(tag, extra=None):
+    """Incident hook (NaN-check trip, segment dispatch failure, health
+    detectors): dump the last N steps if the tracer is live.  Returns
+    the path or None; never raises — the original error must
+    surface."""
     if not _active:
         return None
     try:
@@ -594,7 +599,7 @@ def dump_on_error(tag):
         path = os.path.join(tempfile.gettempdir(),
                             'pt_trace_%d_%s.json'
                             % (os.getpid(), str(tag)))
-        return dump(path)
+        return dump(path, extra=extra)
     except Exception:
         return None
 

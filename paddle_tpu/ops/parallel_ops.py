@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map as _shard_map
+
 from .registry import register
 
 
@@ -115,15 +117,13 @@ def ring_attention_op(ctx, ins, attrs):
                              dropout_seed=seed_,
                              dropout_g_offset=g_off)
 
-            f = jax.shard_map(
+            f = _shard_map(
                 wrapped, mesh=mesh,
-                in_specs=(spec, spec, spec, P()), out_specs=spec,
-                check_vma=False)
+                in_specs=(spec, spec, spec, P()), out_specs=spec)
             return {'Out': [f(q, k, v, seed)]}
-        f = jax.shard_map(
+        f = _shard_map(
             functools.partial(inner, axis_name=axis, causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return {'Out': [f(q, k, v)]}
     if use_flash:
         from .pallas.flash_attention import flash_attention
@@ -190,10 +190,10 @@ def moe_ffn_op(ctx, ins, attrs):
                 aux = jax.lax.pmean(aux, ax)
             return out.reshape(b_loc, t_loc, d), aux
 
-        f = jax.shard_map(
+        f = _shard_map(
             inner, mesh=mesh,
             in_specs=(xspec, P(), P(axis), P(axis)),
-            out_specs=(xspec, P()), check_vma=False)
+            out_specs=(xspec, P()))
         out, aux = f(x, wg, w1, w2)
         return {'Out': [out], 'AuxLoss': [aux]}
     out, aux = reference_moe_ffn(x, wg, w1, w2, capacity_factor=cf,

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map as _shard_map
+
 
 def top1_gating(x, wg, n_experts, capacity):
     """Top-1 gating (Switch-style) producing dense dispatch/combine
@@ -133,10 +135,10 @@ def moe_ffn(x, wg, w1, w2, mesh, axis='ep', capacity_factor=2.0,
                                  axis, capacity_factor, top_k)
         return out.reshape(b_loc, t, d), jax.lax.pmean(aux, axis)
 
-    f = jax.shard_map(
+    f = _shard_map(
         inner, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
-        out_specs=(P(axis), P()), check_vma=False)
+        out_specs=(P(axis), P()))
     return f(x, wg, w1, w2)
 
 

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map as _shard_map
+
 
 def pipeline_apply_inner(stage_fn, params, x_micro, axis_name):
     """Inside shard_map.
@@ -77,8 +79,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis='pp',
         params = jax.tree.map(lambda p: p[0], params)
         return pipeline_apply_inner(stage_fn, params, xm, axis)
 
-    f = jax.shard_map(inner, mesh=mesh,
-                      in_specs=(param_specs, P()), out_specs=P(),
-                      check_vma=False)
+    f = _shard_map(inner, mesh=mesh,
+                      in_specs=(param_specs, P()), out_specs=P())
     out = f(stage_params, x_micro)
     return out.reshape((b,) + out.shape[2:])

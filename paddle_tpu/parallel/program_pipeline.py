@@ -34,6 +34,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import registry
 
+from ..compat import shard_map as _shard_map
+
 
 def _cut_groups(cut_list):
     return [[c] if isinstance(c, str) else list(c) for c in cut_list]
@@ -314,10 +316,10 @@ def pipeline_forward_hetero(raw_fns, stage_params, x, mesh, alive,
         out = jnp.where(mask, out, jnp.zeros_like(out))
         return jax.lax.psum(out, axis)
 
-    f = jax.shard_map(
+    f = _shard_map(
         inner, mesh=mesh,
         in_specs=(pspec_trees, xspec),
-        out_specs=xspec, check_vma=False)
+        out_specs=xspec)
     out = f(tuple(stage_params), x_micro)
     return out.reshape((b,) + out.shape[2:])
 

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..compat import shard_map as _shard_map
+
 
 def _block_attend(q, k, v, m, l, acc, q_off, k_off, scale, causal,
                   dropout_rate=0.0, dropout_seed=None,
@@ -91,11 +93,10 @@ def ring_attention(q, k, v, mesh, axis='sp', causal=False):
     """q,k,v: GLOBAL [B,T,H,D] arrays; returns [B,T,H,D].  Shards T over
     `axis` and runs the ring."""
     spec = P(None, axis, None, None)
-    f = jax.shard_map(
+    f = _shard_map(
         functools.partial(ring_attention_inner, axis_name=axis,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return f(q, k, v)
 
 
@@ -180,11 +181,10 @@ def ring_flash_attention_inner(q, k, v, axis_name, causal=False,
 def ring_flash_attention(q, k, v, mesh, axis='sp', causal=False):
     """Global-array wrapper for ring_flash_attention_inner."""
     spec = P(None, axis, None, None)
-    f = jax.shard_map(
+    f = _shard_map(
         functools.partial(ring_flash_attention_inner, axis_name=axis,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return f(q, k, v)
 
 

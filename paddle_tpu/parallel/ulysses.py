@@ -16,6 +16,8 @@ from jax.sharding import PartitionSpec as P
 
 from .ring_attention import reference_attention
 
+from ..compat import shard_map as _shard_map
+
 
 def ulysses_attention_inner(q, k, v, axis_name, causal=False):
     """Inside shard_map: q,k,v [B, T_loc, H, D] sequence-sharded;
@@ -39,9 +41,8 @@ def ulysses_attention_inner(q, k, v, axis_name, causal=False):
 
 def ulysses_attention(q, k, v, mesh, axis='sp', causal=False):
     spec = P(None, axis, None, None)
-    f = jax.shard_map(
+    f = _shard_map(
         functools.partial(ulysses_attention_inner, axis_name=axis,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return f(q, k, v)

@@ -1,0 +1,49 @@
+"""Subprocess worker for the fluid.health aggregator tests: boots a
+REAL executor on one tiny program, steps it in a loop, and serves the
+status plane on the port given in argv[1] (the parent sets
+PADDLE_TRAINER_ID / PADDLE_TPU_STATUS_WORKERS / aggregation env the
+way distributed/launch.py would).  Prints READY once the first step
+completed; runs until killed or the argv[2] deadline (seconds)."""
+
+import os
+import sys
+import time
+
+
+def main():
+    port = int(sys.argv[1])
+    run_for = float(sys.argv[2]) if len(sys.argv) > 2 else 60.0
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, monitor
+
+    fluid.set_flags({'FLAGS_status_port': port})
+    rank = os.environ.get('PADDLE_TRAINER_ID', '0')
+    # a per-rank marker counter: the parent asserts the AGGREGATED
+    # /metrics carries every worker's series
+    monitor.add('health/test_marker_rank%s' % rank)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main_p, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        h = layers.fc(x, 8, act='relu')
+        loss = layers.reduce_mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.XLAPlace(0))  # starts the status server
+    exe.run(startup)
+    feed = {'x': np.ones((4, 8), 'float32')}
+    exe.run(main_p, feed=feed, fetch_list=[loss])
+    print('READY', flush=True)
+    deadline = time.time() + run_for
+    while time.time() < deadline:
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        time.sleep(0.05)
+
+
+if __name__ == '__main__':
+    main()

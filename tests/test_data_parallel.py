@@ -203,11 +203,11 @@ def test_collective_ops_semantics():
             ctx, {'X': [xs]}, {'ring_id': 0, 'root': 2})['Out'][0]
         return ar, mx, ag, bc
 
-    f = jax.jit(jax.shard_map(
+    from paddle_tpu.compat import shard_map
+    f = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P('dp'),),
-        out_specs=(P(), P(), P(), P('dp')),
-        check_vma=False))
+        out_specs=(P(), P(), P(), P('dp'))))
     ar, mx, ag, bc = f(x)
     np.testing.assert_allclose(np.asarray(ar).reshape(3), x.sum(0))
     np.testing.assert_allclose(np.asarray(mx).reshape(3), x.max(0))

@@ -114,14 +114,20 @@ def test_prometheus_text_round_trips_counters():
         exe.run(main, feed={'x': x}, fetch_list=[out])
         snap = monitor.snapshot()['executor']
         text = monitor.prometheus_text()
-    # every line is valid text exposition format
+    # every line is valid text exposition format (incl. HELP metadata)
     line_re = re.compile(
         r'^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* '
         r'(counter|gauge|histogram)'
+        r'|# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*'
         r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? -?[0-9.e+-]+'
         r'(inf)?)$')
     for line in text.strip().splitlines():
         assert line_re.match(line), line
+    # and the lint-level contract holds (fluid.health.prom_lint is the
+    # exhaustive check: HELP/TYPE per family, no duplicate series,
+    # histogram bucket/_sum/_count consistency)
+    from paddle_tpu.fluid import health
+    assert health.prom_lint(text) == []
     # the cache counters round-trip by value
     parsed = {}
     for line in text.splitlines():

@@ -706,11 +706,11 @@ def test_collective_variant_ops():
         sp = run('c_split', cat, nranks=n)      # undo the concat
         return mn, pr, mp_sum, ident, cat, sc1, sp
 
-    f = jax.jit(jax.shard_map(
+    from paddle_tpu.compat import shard_map
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P('dp'),),
         out_specs=(P(), P(), P(), P('dp'), P('dp'), P('dp'),
-                   P('dp')),
-        check_vma=False))
+                   P('dp'))))
     mn, pr, mp_sum, ident, cat, sc1, sp = f(x)
     np.testing.assert_allclose(np.asarray(mn).reshape(4), x.min(0),
                                rtol=1e-6)
@@ -744,8 +744,9 @@ def test_c_reducescatter():
         return registry.get('c_reducescatter').fn(
             ctx, {'X': [xs]}, {'ring_id': 0})['Out'][0]
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P('dp'),),
-                              out_specs=P('dp'), check_vma=False))
+    from paddle_tpu.compat import shard_map
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P('dp'),),
+                          out_specs=P('dp')))
     got = np.asarray(f(x))
     want = x.reshape(n, n, 3).sum(0)
     np.testing.assert_allclose(got, want, rtol=1e-5)

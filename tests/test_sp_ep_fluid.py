@@ -141,10 +141,11 @@ def test_moe_op_sharded_matches_library_path():
             a = jax.lax.pmean(a, ax)
         return o.reshape(b_loc, t_loc, DIM), a
 
-    f = jax.shard_map(
+    from paddle_tpu.compat import shard_map
+    f = shard_map(
         inner, mesh=mesh,
         in_specs=(P('dp', ('sp', 'ep'), None), P(), P('ep'), P('ep')),
-        out_specs=(P('dp', ('sp', 'ep'), None), P()), check_vma=False)
+        out_specs=(P('dp', ('sp', 'ep'), None), P()))
     lib, laux = f(jnp.asarray(x), jnp.asarray(wg), jnp.asarray(w1),
                   jnp.asarray(w2))
     np.testing.assert_allclose(got, np.asarray(lib), rtol=2e-4,

@@ -1,0 +1,162 @@
+"""Health-plane gate: the status endpoints must serve a REAL process's
+data correctly, and the opt-in tensor-health summaries must cost
+nothing when off (the fluid.health analog of check_trace.py's gate).
+
+Runs one in-process sequence:
+
+  1. boot a real executor, train a tiny program, start the status
+     server on an ephemeral port, and curl /healthz //metrics
+     //statusz //trace/dump: /metrics must pass the fluid.health
+     prom_lint (HELP/TYPE per family, no duplicate series, histogram
+     bucket consistency), /healthz must report ready with recent step
+     age, /statusz must carry the step report / cache stats / flags /
+     versions schema;
+  2. FLAGS_health_summaries on: a fresh program's steps must record
+     the health/* histograms (grad norm, update ratio, global grad
+     norm) with zero summary errors;
+  3. FLAGS_health_summaries off (the default posture): the
+     steady-state hot-path budgets of tools/check_hot_path.py must
+     still hold — the "opt-in costs nothing when off" claim.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode('utf-8')
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode('utf-8')
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import health, layers, monitor, trace
+
+    failures = []
+
+    def build(seed=5):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main_p, startup):
+            x = layers.data('x', shape=[16], dtype='float32')
+            h = layers.fc(x, 16)
+            loss = layers.reduce_mean(layers.square(h))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        return main_p, startup, loss
+
+    # -- 1. endpoints over a live executor ---------------------------
+    main_p, startup, loss = build()
+    feed = {'x': np.ones((8, 16), 'float32')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        trace.enable(buffer_steps=8)
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        srv = health.serve(port=0)
+        try:
+            code, text = _get(srv.url + '/metrics')
+            problems = health.prom_lint(text)
+            if code != 200:
+                failures.append('/metrics returned %d' % code)
+            if problems:
+                failures.append('/metrics lint: %s'
+                                % '; '.join(problems[:5]))
+            if 'paddle_tpu_executor_run_calls' not in text:
+                failures.append('/metrics missing executor counters')
+
+            code, body = _get(srv.url + '/healthz')
+            doc = json.loads(body)
+            if code != 200 or not doc.get('ready'):
+                failures.append('/healthz not ready on a stepping '
+                                'process: %d %r' % (code, doc))
+            for key in ('alive', 'ready', 'steps', 'last_step_age_s',
+                        'pid', 'uptime_s'):
+                if key not in doc:
+                    failures.append('/healthz missing %r' % key)
+
+            code, body = _get(srv.url + '/statusz')
+            doc = json.loads(body)
+            if code != 200:
+                failures.append('/statusz returned %d' % code)
+            if 'rollup' not in doc.get('step_report', {}):
+                failures.append('/statusz missing step_report.rollup')
+            if 'segment_cache_hit' not in doc.get('caches', {}):
+                failures.append('/statusz missing cache stats')
+            if 'FLAGS_health_summaries' not in doc.get('flags', {}):
+                failures.append('/statusz missing flags')
+            if not doc.get('versions', {}).get('jax'):
+                failures.append('/statusz missing jax version')
+
+            code, body = _get(srv.url + '/trace/dump')
+            doc = json.loads(body)
+            if code != 200 or not doc.get('ptSteps'):
+                failures.append('/trace/dump empty on a traced step')
+            elif not os.path.exists(doc.get('ptDumpPath', '')):
+                failures.append('/trace/dump wrote no file')
+            print('endpoints: /metrics %dB lint-clean, healthz ready, '
+                  'statusz schema ok, trace dump %d steps'
+                  % (len(text), len(doc.get('ptSteps', []))))
+        finally:
+            srv.stop()
+    trace.disable()
+    trace.reset()
+
+    # -- 2. summaries on: health histograms recorded -----------------
+    fluid.set_flags({'FLAGS_health_summaries': True})
+    health.reset_state()
+    try:
+        main2, startup2, loss2 = build(seed=6)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup2)
+            for _ in range(4):
+                exe.run(main2, feed=feed, fetch_list=[loss2])
+        for name in ('health/grad_norm', 'health/update_ratio',
+                     'health/global_grad_norm'):
+            h = monitor.histogram_value(name)
+            if not h or h['count'] < 4:
+                failures.append('summaries on: %s not recorded (%r)'
+                                % (name, h))
+        errs = monitor.counter_value('health/summary_errors')
+        if errs:
+            failures.append('summaries on: %g summary errors' % errs)
+        print('summaries: %d steps, global grad norm %.4f'
+              % (int(monitor.counter_value('health/summary_steps')),
+                 monitor.gauge_value('health/last_global_grad_norm')))
+    finally:
+        fluid.set_flags({'FLAGS_health_summaries': False})
+        health.reset_state()
+
+    # -- 3. summaries off: hot-path budgets unchanged ----------------
+    monitor.reset()
+    sys.path.insert(0, os.path.join(root, 'tools'))
+    import check_hot_path
+    rc = check_hot_path.main()
+    if rc != 0:
+        failures.append('check_hot_path budgets violated with health '
+                        'summaries disabled (rc=%d)' % rc)
+
+    if failures:
+        for f in failures:
+            print('HEALTH GATE  ' + f)
+        return 1
+    print('health plane: ok')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

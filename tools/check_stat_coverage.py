@@ -102,6 +102,24 @@ REQUIRED = [
     ('paddle_tpu/fluid/parallel_executor.py', "_trace.step_span"),
     ('paddle_tpu/fluid/compile_cache.py', "'cache_deserialize'"),
     ('bench.py', '_step_phase_fields'),
+    # health plane (fluid/health.py): the HTTP status surface, the
+    # aggregator's worker probes, the tensor-health summaries and the
+    # NaN/divergence detectors — tools/check_health.py exercises the
+    # endpoints end to end, this audit keeps the instrument points
+    ('paddle_tpu/fluid/health.py', 'health/http_requests'),
+    ('paddle_tpu/fluid/health.py', 'health/scrapes'),
+    ('paddle_tpu/fluid/health.py', 'health/worker_up'),
+    ('paddle_tpu/fluid/health.py', 'health/summary_steps'),
+    ('paddle_tpu/fluid/health.py', 'health/global_grad_norm'),
+    ('paddle_tpu/fluid/health.py', 'health/update_ratio'),
+    ('paddle_tpu/fluid/health.py', 'health/grad_spikes'),
+    ('paddle_tpu/fluid/health.py', 'health/zero_update_trips'),
+    ('paddle_tpu/fluid/health.py', 'health/detector_dumps'),
+    ('paddle_tpu/fluid/executor.py', 'health/nan_trips'),
+    ('paddle_tpu/fluid/executor.py', 'executor/last_step_unix_ts'),
+    ('paddle_tpu/fluid/monitor.py', '# HELP'),
+    ('paddle_tpu/distributed/launch.py', 'PADDLE_TPU_STATUS_WORKERS'),
+    ('bench.py', 'health_overhead'),
 ]
 
 
