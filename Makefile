@@ -50,16 +50,19 @@ bench:
 # path must stay within its per-step counter budgets, the persistent
 # compile cache must carry executables across processes, the trace
 # plane must decompose a real step (merged host+device export,
-# >=80% phase coverage) without costing anything when disabled, and
-# the health plane must serve lint-clean /metrics + schema-stable
+# >=80% phase coverage) without costing anything when disabled, the
+# health plane must serve lint-clean /metrics + schema-stable
 # /healthz//statusz off a live executor with zero hot-path cost when
-# tensor-health summaries are off
+# tensor-health summaries are off, and the serving plane must batch
+# a real two-thread soak bitwise-correctly with zero post-warmup
+# retraces and lint-clean serving metrics
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
 	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
 	JAX_PLATFORMS=cpu python tools/check_trace.py
 	JAX_PLATFORMS=cpu python tools/check_health.py
+	JAX_PLATFORMS=cpu python tools/check_serving.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -775,6 +775,166 @@ def _run_cold(cache_dir=None, out_path=None):
     return None
 
 
+def bench_serving(feeders=4, requests_per_feeder=100, max_batch=32,
+                  burst=16):
+    """Multi-client serving soak: N concurrent feeders, two resident
+    programs (different input widths — mixed shapes), mixed row
+    counts, through fluid.serving's continuous batcher — against a
+    SEQUENTIAL baseline (one request at a time through Executor.run,
+    the pre-serving posture).  Reports requests/sec for both arms,
+    the speedup, per-request p50/p99 admission-to-completion latency,
+    mean batch occupancy, and the post-warmup retrace count (must be
+    0: every bucket comes from the warmed AOT ladder).  Step wall
+    percentiles come straight out of trace.step_report() over the
+    tenant-tagged serving steps."""
+    import threading
+    import jax  # noqa: F401 — device init before the timed regions
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import monitor, serving
+    from paddle_tpu.fluid import trace as pt_trace
+
+    def build(in_w, hid_w, seed):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[in_w], dtype='float32')
+            h = fluid.layers.fc(x, hid_w, act='relu')
+            y = fluid.layers.fc(h, 10, act='softmax')
+        return main, startup, y
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    tenants = {}
+    for name, (in_w, hid_w, seed) in (('small', (16, 64, 21)),
+                                      ('wide', (32, 96, 22))):
+        mp, sp, y = build(in_w, hid_w, seed)
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(sp)
+        tenants[name] = (mp, sc, y, in_w)
+    rows_cycle = (1, 1, 2, 1, 4, 1)   # mostly single requests
+    total_requests = feeders * requests_per_feeder
+
+    def request_stream(seed):
+        rng = np.random.RandomState(seed)
+        for i in range(requests_per_feeder):
+            name = ('small', 'wide')[(seed + i) % 2]
+            rows = rows_cycle[i % len(rows_cycle)]
+            in_w = tenants[name][3]
+            yield name, rng.randn(rows, in_w).astype('float32')
+
+    # -- sequential baseline: one blocking request at a time ---------
+    for name, (mp, sc, y, in_w) in tenants.items():
+        with fluid.scope_guard(sc):   # warm every shape out of band
+            for rows in sorted(set(rows_cycle)):
+                exe.run(mp, feed={'x': np.zeros((rows, in_w),
+                                                'float32')},
+                        fetch_list=[y])
+    t0 = time.time()
+    n_seq = 0
+    for fid in range(feeders):
+        for name, xv in request_stream(fid):
+            mp, sc, y, _ = tenants[name]
+            with fluid.scope_guard(sc):
+                out, = exe.run(mp, feed={'x': xv}, fetch_list=[y])
+            np.asarray(out)
+            n_seq += 1
+    seq_dt = time.time() - t0
+    seq_rps = n_seq / seq_dt
+
+    # -- continuous-batching soak ------------------------------------
+    srv = serving.ServingExecutor(max_batch=max_batch, executor=exe)
+    for name, (mp, sc, y, _w) in tenants.items():
+        srv.add_program(name, mp, ['x'], [y], scope=sc)
+    srv.warmup(wait=True)
+    lowered0 = monitor.counter_value('executor/segments_lowered')
+    trace_was_on = pt_trace.is_active()
+    if not trace_was_on:
+        pt_trace.enable(buffer_steps=2 * total_requests)
+    latencies = []
+    lat_lock = threading.Lock()
+
+    def feeder(fid):
+        pending = []
+        for name, xv in request_stream(fid):
+            t_sub = time.perf_counter()
+            fut = srv.submit(name, {'x': xv})
+            fut.add_done_callback(
+                lambda _f, _t=t_sub: _record(_t))
+            pending.append(fut)
+            if len(pending) >= burst:
+                for f in pending:   # pipelined: burst stays in flight
+                    f.result(300)
+                pending = []
+        for f in pending:
+            f.result(300)
+
+    def _record(t_sub):
+        done = time.perf_counter()
+        with lat_lock:
+            latencies.append(done - t_sub)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=feeder, args=(fid,))
+               for fid in range(feeders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    soak_dt = time.time() - t0
+    retraces = monitor.counter_value(
+        'executor/segments_lowered') - lowered0
+    try:
+        rep = pt_trace.step_report()
+        srv_steps = [s for s in rep['steps'] if s.get('tags')]
+        walls = sorted(s['wall_ms'] for s in srv_steps)
+        step_walls = {
+            'count': len(walls),
+            'wall_p50_ms': round(walls[len(walls) // 2], 3)
+            if walls else 0.0,
+            'wall_p99_ms': round(
+                walls[min(len(walls) - 1,
+                          int(0.99 * len(walls)))], 3)
+            if walls else 0.0,
+        }
+    except Exception:
+        step_walls = {}
+    if not trace_was_on:
+        pt_trace.disable()
+        pt_trace.reset()
+    srv_rps = len(latencies) / soak_dt
+    occ = monitor.histogram_value('serving/batch_occupancy') or {}
+    lat_sorted = sorted(latencies)
+    srv.close()
+    return dict({
+        'metric': 'serving_requests_per_sec',
+        'value': round(srv_rps, 1),
+        'unit': 'req/s',
+        'feeders': feeders,
+        'programs': len(tenants),
+        'requests': len(latencies),
+        'sequential_rps': round(seq_rps, 1),
+        'vs_sequential': round(srv_rps / max(seq_rps, 1e-9), 2),
+        'latency_p50_ms': round(
+            1e3 * _pct_of(lat_sorted, 0.50), 2),
+        'latency_p99_ms': round(
+            1e3 * _pct_of(lat_sorted, 0.99), 2),
+        'mean_batch_occupancy': round(
+            occ.get('sum', 0.0) / max(occ.get('count', 1), 1), 3),
+        'batches': monitor.counter_value('serving/batches'),
+        'pad_waste_bytes': monitor.counter_value(
+            'serving/bucket_pad_waste_bytes'),
+        'retraces_post_warmup': retraces,
+        'serving_step_walls': step_walls,
+    }, **_monitor_fields())
+
+
+def _pct_of(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(round(q * (len(sorted_vals) - 1))))]
+
+
 def bench_health_overhead(depth=4, width=64, batch=32, steps=60,
                           warmup=8):
     """FLAGS_health_summaries on/off A/B on one small MLP: the BENCH
@@ -869,6 +1029,7 @@ ALL_BENCHES = (
     ('transformer', ({},)),
     ('resnet_infer', ({}, {'batch': 64})),
     ('resnet50_hostfed', ({},)),
+    ('serving', ({},)),
 )
 
 
@@ -925,6 +1086,20 @@ def main():
         # Baseline recorded in BENCH_compile_cache.json.
         out = sys.argv[2] if len(sys.argv) > 2 else None
         _run_cold(out_path=out)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--serving':
+        # multi-client serving soak (continuous batching vs
+        # sequential single requests).  Baseline recorded in
+        # BENCH_serving.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'BENCH_serving.json')
+        rec = bench_serving()
+        print(json.dumps(rec))
+        with open(out, 'w') as f:
+            json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
+                              '--serving',
+                       'entries': [rec]}, f, indent=1, sort_keys=True)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--smoke':
         # CPU-friendly minutes-scale sweep: the dispatch micro-bench

@@ -63,7 +63,7 @@ __all__ = [
     'Executor', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
     'ParamAttr', 'CompiledProgram', 'BuildStrategy', 'io', 'metrics',
     'dygraph', 'DataFeeder', 'scope_guard', 'global_scope', 'monitor',
-    'trace',
+    'trace', 'serving',
 ]
 from . import dataset
 from .dataset import DatasetFactory
@@ -71,3 +71,16 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import flags
 from .flags import get_flags, set_flags
+
+
+def __getattr__(name):
+    # fluid.serving loads lazily (PEP 562): plain trainers never
+    # import the serving plane, so health.status()'s sys.modules probe
+    # only finds it in processes that actually serve.  (importlib, not
+    # `from . import`: the latter re-enters this __getattr__ through
+    # _handle_fromlist and recurses.)
+    if name == 'serving':
+        import importlib
+        return importlib.import_module(__name__ + '.serving')
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

@@ -536,6 +536,29 @@ class CompilePlane(object):
         self.pool().submit(run)
         return fut
 
+    def entry_count(self):
+        """Resident executable-map entries (compiled or in flight)."""
+        with self._lock:
+            return len(self._mem)
+
+    def stats(self):
+        """One JSON-able snapshot of the plane for status surfaces
+        (fluid.health /statusz, fluid.serving resident report):
+        residency plus the hit/miss/compile counters."""
+        return {
+            'memory_entries': self.entry_count(),
+            'cache_dir': self.cache_dir(),
+            'warmed': self._warmed,
+            'memory_hits': monitor.counter_value(
+                'executor/compile_cache_memory_hit'),
+            'disk_hits': monitor.counter_value(
+                'executor/compile_cache_disk_hit'),
+            'disk_misses': monitor.counter_value(
+                'executor/compile_cache_disk_miss'),
+            'aot_compiles': monitor.counter_value(
+                'executor/aot_compiles'),
+        }
+
     def shared_jit(self, fp, make_fn):
         """One process-wide jit callable per fingerprint, for the
         shape-polymorphic users (CompiledStep, parallel runners): the

@@ -201,9 +201,19 @@ class _SegmentBinder(object):
         """One step's (state, data) argument dicts for the segment."""
         t0 = _time_mod.perf_counter()
         keyset = frozenset(feed) if feed else self._EMPTY
-        tab = self._tables.get(keyset)
+        # tables key on (feed keyset, scope identity): a multi-tenant
+        # server alternating per-tenant scopes over ONE resident
+        # program must keep each tenant's resolved owner slots — a
+        # keyset-only table would re-walk the scope chain on every
+        # tenant switch.  id() reuse after a scope dies is caught by
+        # the weakref revalidation below; the table map itself is
+        # bounded so a scope-churning caller cannot grow it forever.
+        tkey = (keyset, id(scope))
+        tab = self._tables.get(tkey)
         if tab is None:
-            tab = self._tables[keyset] = _BindTable(self._seg, keyset)
+            if len(self._tables) >= 256:
+                self._tables.clear()
+            tab = self._tables[tkey] = _BindTable(self._seg, keyset)
         ref = tab.scope_ref
         if ref is not None and ref() is scope and \
                 tab.token == scope._chain_token():

@@ -100,6 +100,15 @@ def status():
         ready = False
         reasons.append('last step %.1fs ago exceeds max age %.1fs'
                        % (age, max_age))
+    serving_ready = None
+    srv = _serving_module()
+    if srv is not None:
+        # a serving replica is ready only once its bucket ladder is
+        # warm: routing to it earlier would trace on the first request
+        serving_ready, s_reasons = srv.readiness()
+        if serving_ready is False:
+            ready = False
+            reasons.extend(s_reasons)
     return {
         'alive': True,
         'ready': ready,
@@ -109,8 +118,16 @@ def status():
         'uptime_s': round(now - _BIRTH, 3),
         'steps': run_calls,
         'warmed': warmed,
+        'serving_ready': serving_ready,
         'last_step_age_s': (round(age, 3) if age is not None else None),
     }
+
+
+def _serving_module():
+    """fluid.serving, if this process imported it — consulted lazily so
+    plain trainers never pay for (or import) the serving plane."""
+    import sys as _sys
+    return _sys.modules.get(__package__ + '.serving')
 
 
 def statusz():
@@ -133,10 +150,19 @@ def statusz():
     try:
         from . import compile_cache
         plane = compile_cache.plane()
-        caches['compile_cache_memory_entries'] = len(plane._mem)
+        caches['compile_cache_memory_entries'] = plane.entry_count()
         caches['compile_cache_dir'] = plane.cache_dir()
     except Exception:
         pass
+    serving_section = None
+    srv = _serving_module()
+    if srv is not None:
+        try:
+            rep = srv.resident_report()
+            if rep:
+                serving_section = rep
+        except Exception:
+            pass
     versions = {}
     try:
         import jax
@@ -156,6 +182,7 @@ def statusz():
         'status': status(),
         'step_report': trace.step_report(),
         'caches': caches,
+        'serving': serving_section,
         'flags': _all_flags(),
         'versions': versions,
         'trace_active': trace.is_active(),

@@ -181,6 +181,43 @@ class _AsyncBatchIterator(object):
 
 
 
+def pow2_bucket_ladder(max_size, start=1):
+    """Power-of-two bucket boundaries covering sizes up to `max_size`:
+    [start, 2*start, ...] ending at the first power >= max_size.  The
+    ladder the bucketed loader applies to sequence LENGTHS and the
+    serving plane applies to BATCH rows — one AOT executable per rung,
+    O(log max) executables total."""
+    out = []
+    b = max(1, int(start))
+    top = max(1, int(max_size))
+    while b < top:
+        out.append(b)
+        b *= 2
+    out.append(b)
+    return out
+
+
+def bucket_for(size, boundaries):
+    """The smallest boundary >= `size` (the BucketedGeneratorLoader
+    rule, shared with fluid.serving's batch coalescer).  `boundaries`
+    must be sorted ascending."""
+    for b in boundaries:
+        if size <= b:
+            return int(b)
+    raise ValueError(
+        'size %d exceeds the largest bucket boundary %d'
+        % (size, boundaries[-1]))
+
+
+def mask_name(name, mask_map=None):
+    """The '@MASK' companion-feed convention: the mask feed name for a
+    padded field (sequence ops consume it as their Mask input; the
+    serving plane emits row masks under the same names)."""
+    if mask_map:
+        return mask_map.get(name, name + '@MASK')
+    return name + '@MASK'
+
+
 class DataLoader(object):
     @staticmethod
     def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
@@ -391,15 +428,15 @@ class BucketedGeneratorLoader(GeneratorLoader):
                 'with lod_level>0 or pass ragged_fields=[names]')
 
     def _bucket_of(self, length):
-        for b in self.boundaries:
-            if length <= b:
-                return b
-        raise ValueError(
-            'sample length %d exceeds the largest bucket boundary %d'
-            % (length, self.boundaries[-1]))
+        try:
+            return bucket_for(length, self.boundaries)
+        except ValueError:
+            raise ValueError(
+                'sample length %d exceeds the largest bucket boundary '
+                '%d' % (length, self.boundaries[-1]))
 
     def _mask_name(self, var):
-        return self._mask_map.get(var.name, var.name + '@MASK')
+        return mask_name(var.name, self._mask_map)
 
     def _pad_batch(self, samples, boundary):
         out = {}

@@ -56,9 +56,10 @@ from .flags import get_flag
 
 __all__ = [
     'enable', 'disable', 'is_active', 'reset', 'span', 'record',
-    'traced', 'step_span', 'steps', 'step_report', 'report_from_records',
-    'format_step_report', 'chrome_events', 'merge_device_trace',
-    'write_chrome', 'dump', 'dump_on_error', 'now_us',
+    'traced', 'step_span', 'step_tags', 'steps', 'step_report',
+    'report_from_records', 'format_step_report', 'chrome_events',
+    'merge_device_trace', 'write_chrome', 'dump', 'dump_on_error',
+    'now_us',
 ]
 
 # monotonic->epoch anchor: every span stores perf_counter floats; the
@@ -239,6 +240,38 @@ def traced(name=None):
     return deco
 
 
+class _StepTags(object):
+    """Ambient per-thread tags merged into every step record sealed
+    while the context is open — the serving plane wraps each coalesced
+    batch's executor run in one so `step_report()` / the flight
+    recorder attribute the step to its tenant and batch size."""
+
+    __slots__ = ('_tags', '_prev')
+
+    def __init__(self, tags):
+        self._tags = tags
+
+    def __enter__(self):
+        prev = getattr(_tls, 'step_tags', None)
+        self._prev = prev
+        merged = dict(prev) if prev else {}
+        merged.update(self._tags)
+        _tls.step_tags = merged
+        return self
+
+    def __exit__(self, *exc):
+        _tls.step_tags = self._prev
+        return False
+
+
+def step_tags(**tags):
+    """Tag the step records sealed inside the context (nests: inner
+    tags shadow outer ones).  Off: the shared null span."""
+    if not _active:
+        return _NULL
+    return _StepTags(tags)
+
+
 class _StepSpan(object):
     """Span over one executor step; closing it seals the current span
     window into a flight-recorder step record."""
@@ -272,19 +305,24 @@ class _StepSpan(object):
             return False
         ev = _events
         _events = []    # swap: a racing append lands in the old list
+        tags = getattr(_tls, 'step_tags', None)
+        step_args = {'step': self.step}
+        if tags:
+            step_args.update(tags)
         cap = _capture
         if cap is not None:
             cap['events'].append(('step', self._t0, t1,
                                   threading.get_ident(), self._depth,
-                                  {'step': self.step}))
+                                  step_args))
         with _lock:
             if _steps is not None:
                 if _steps.maxlen and len(_steps) == _steps.maxlen:
                     monitor.add('trace/steps_dropped')
-                _steps.append({'step': self.step, 't0': self._t0,
-                               't1': t1,
-                               'tid': threading.get_ident(),
-                               'spans': ev})
+                rec = {'step': self.step, 't0': self._t0, 't1': t1,
+                       'tid': threading.get_ident(), 'spans': ev}
+                if tags:
+                    rec['tags'] = dict(tags)
+                _steps.append(rec)
         monitor.add('trace/steps_recorded')
         return False
 
@@ -376,13 +414,17 @@ def report_from_records(records):
             # tid-less (partial/incident) record: take the busiest
             # single thread, still bounded by the window
             accounted = max(per_tid.values()) if per_tid else 0.0
-        steps_out.append({
+        entry = {
             'step': rec.get('step'),
             'wall_ms': wall * 1e3,
             'phases_ms': {n: v * 1e3 for n, v in sorted(phases.items())},
             'accounted_ms': accounted * 1e3,
             'coverage': (accounted / wall) if wall > 0 else 0.0,
-        })
+        }
+        tags = rec.get('tags')
+        if tags:
+            entry['tags'] = dict(tags)
+        steps_out.append(entry)
     walls = sorted(s['wall_ms'] for s in steps_out)
     phase_tot = {}
     for s in steps_out:
@@ -427,6 +469,10 @@ def format_step_report(report=None):
     for s in rep['steps']:
         ph = '  '.join('%s=%.3f' % (n, s['phases_ms'][n])
                        for n in names if n in s['phases_ms'])
+        tags = s.get('tags')
+        if tags:
+            ph += '  [%s]' % ' '.join(
+                '%s=%s' % (k, tags[k]) for k in sorted(tags))
         lines.append('%-6s %10.3f %7.0f%%  %s'
                      % (s['step'], s['wall_ms'],
                         100.0 * s['coverage'], ph))
@@ -452,9 +498,10 @@ def chrome_events(span_tuples=None, pid=0):
         span_tuples = []
         for rec in steps():
             span_tuples.extend(rec['spans'])
+            step_args = {'step': rec.get('step')}
+            step_args.update(rec.get('tags') or {})
             span_tuples.append(('step', rec['t0'], rec['t1'],
-                                rec.get('tid'), 0,
-                                {'step': rec.get('step')}))
+                                rec.get('tid'), 0, step_args))
         span_tuples.extend(list(_events))
     out = [{'ph': 'M', 'pid': pid, 'tid': 0, 'cat': 'pt_host',
             'name': 'process_name',
@@ -565,7 +612,7 @@ def dump(path=None, extra=None):
         'traceEvents': chrome_events(),
         'displayTimeUnit': 'ms',
         'ptSteps': [{'step': r['step'], 't0': r['t0'], 't1': r['t1'],
-                     'tid': r.get('tid'),
+                     'tid': r.get('tid'), 'tags': r.get('tags'),
                      'spans': [[s[0], s[1], s[2], s[3], s[4],
                                 safe_args(s[5])]
                                for s in r['spans']]}

@@ -4,7 +4,9 @@ import numpy as np
 
 from ..fluid import core
 from ..fluid import io as fluid_io
+from ..fluid import serving as fluid_serving
 from ..fluid.executor import Executor
+from ..fluid.reader import bucket_for, pow2_bucket_ladder
 
 
 class AnalysisConfig(object):
@@ -16,6 +18,12 @@ class AnalysisConfig(object):
         self.params_filename = params_file
         self._use_xla = True
         self._switch_ir_optim = True
+        # batch-bucket routing (the serving plane's pad/mask/slice
+        # path): single-shot run() pads odd batch sizes up to the next
+        # power-of-two bucket so the predictor compiles O(log max)
+        # executables instead of one per distinct client batch size
+        self._serving_buckets = True
+        self._serving_max_batch = 64
 
     def set_model(self, model_dir, params_file=None):
         self.model_dir = model_dir
@@ -29,6 +37,13 @@ class AnalysisConfig(object):
 
     def switch_ir_optim(self, x=True):
         self._switch_ir_optim = x
+
+    def switch_serving_buckets(self, on=True, max_batch=64):
+        """Toggle batch-bucket padding on run()/run_dict() (on by
+        default).  Off, every distinct client batch size compiles its
+        own executable — the pre-serving behavior."""
+        self._serving_buckets = bool(on)
+        self._serving_max_batch = int(max_batch)
 
     def enable_memory_optim(self):
         pass
@@ -71,6 +86,15 @@ class AnalysisPredictor(object):
                 val = self._scope._vars[name]
                 if isinstance(val, np.ndarray):
                     self._scope.set_var(name, jax.device_put(val, dev))
+        self._ladder = tuple(pow2_bucket_ladder(
+            max(1, int(getattr(config, '_serving_max_batch', 64)))))
+        # bucket routing is only transparent when every fetch carries
+        # the batch dim (declared -1 leading dim) and can be sliced
+        # back: a whole-batch aggregate (static leading dim) would see
+        # the zero pad rows, so such models keep the unpadded path
+        self._bucket_ok = all(
+            getattr(v, 'shape', None) and int(v.shape[0]) < 0
+            for v in self._fetch_vars)
 
     # -- zero-copy style API ---------------------------------------------
     def get_input_names(self):
@@ -79,14 +103,58 @@ class AnalysisPredictor(object):
     def get_output_names(self):
         return [v.name for v in self._fetch_vars]
 
+    def _bucket_feed(self, feed):
+        """Route a single-shot feed through the serving plane's
+        pad/mask helper: pad the shared leading (batch) dim up to the
+        next power-of-two bucket.  Returns (feed, rows, bucket) —
+        rows is None when the feed is not batch-aligned (mismatched
+        leading dims, already-bucketed, or bigger than the ladder),
+        in which case it passes through untouched."""
+        if not getattr(self.config, '_serving_buckets', False) or \
+                not self._bucket_ok or not feed:
+            return feed, None, None
+        dims = set()
+        for v in feed.values():
+            if isinstance(v, core.LoDTensor):
+                if v.lod:
+                    # ragged rows: row-padding would break the LoD
+                    # contract — the bucketed LOADER owns that case
+                    return feed, None, None
+                v = v.data
+            shape = np.shape(v)
+            if not shape:
+                return feed, None, None
+            dims.add(int(shape[0]))
+        if len(dims) != 1:
+            return feed, None, None
+        rows = dims.pop()
+        if rows > self._ladder[-1]:
+            return feed, None, None
+        bucket = bucket_for(rows, self._ladder)
+        if bucket == rows:
+            return feed, None, None
+        padded, _waste = fluid_serving.pad_rows_to_bucket(
+            {k: (v.data if isinstance(v, core.LoDTensor) else v)
+             for k, v in feed.items()}, rows, bucket)
+        return padded, rows, bucket
+
     def run_dict(self, feed, return_numpy=True):
         """return_numpy=False keeps outputs as device arrays — the
         dispatch stays asynchronous, so a caller pipelining requests
-        does not pay a blocking device->host fetch per call."""
+        does not pay a blocking device->host fetch per call.  With
+        return_numpy=True the feed routes through the serving plane's
+        bucket-pad/slice helper (config.switch_serving_buckets), so
+        padded and unpadded calls return bitwise-identical rows."""
+        rows = None
+        if return_numpy is True:
+            feed, rows, bucket = self._bucket_feed(feed)
         with core.scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_vars,
                                  return_numpy=return_numpy)
+        if rows is not None:
+            outs = [fluid_serving.slice_rows(o, 0, rows, bucket)
+                    for o in outs]
         return outs
 
     def run(self, inputs):
@@ -98,6 +166,24 @@ class AnalysisPredictor(object):
         outs = self.run_dict(feed)
         return [PaddleTensor(o, name=v.name)
                 for o, v in zip(outs, self._fetch_vars)]
+
+    def serve(self, tenant='default', max_batch=None, warmup=True,
+              serving_executor=None):
+        """Make this model resident on a serving plane: registers the
+        loaded program (per-predictor scope = per-tenant isolation) on
+        `serving_executor` (default: a new ``ServingExecutor`` sharing
+        this predictor's Executor) and warms its bucket ladder.
+        Returns the ServingExecutor — submit requests with
+        ``srv.submit(tenant, {feed_name: batch})``."""
+        srv = serving_executor or fluid_serving.ServingExecutor(
+            max_batch=max_batch or getattr(
+                self.config, '_serving_max_batch', 64),
+            executor=self._exe)
+        srv.add_program(tenant, self._program, self._feed_names,
+                        self._fetch_vars, scope=self._scope)
+        if warmup:
+            srv.warmup(wait=True)
+        return srv
 
 
 def create_paddle_predictor(config):

@@ -1,0 +1,188 @@
+"""Serving-plane gate: a live ServingExecutor under a real concurrent
+soak must batch, isolate and observe correctly (the fluid.serving
+analog of check_health.py's endpoint gate).
+
+Runs one in-process sequence:
+
+  1. two programs resident (different widths, per-tenant scopes),
+     ``warmup()`` over the full power-of-two bucket ladder;
+  2. a TWO-THREAD soak (mixed tenants, mixed row counts) through the
+     admission queue — every per-request result must be bitwise-equal
+     to direct unbatched execution of the same rows, and the
+     post-warmup window must show ZERO serving-path retraces
+     (``executor/segments_lowered`` / ``executor/aot_compiles`` flat,
+     ``serving/retraces`` == 0);
+  3. the serving monitor points (queue depth, batch occupancy,
+     admission-to-completion latency, pad waste) must be populated and
+     ``monitor.prometheus_text()`` must pass the fluid.health
+     prom_lint;
+  4. ``/healthz`` readiness must gate on serving warmup and ``/statusz``
+     must list the resident programs.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import os
+import sys
+import threading
+
+SOAK_REQUESTS_PER_THREAD = 24
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import health, layers, monitor, serving
+
+    failures = []
+
+    def build(width, seed):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main_p, startup):
+            x = layers.data('x', shape=[16], dtype='float32')
+            h = layers.fc(x, width, act='relu')
+            y = layers.fc(h, 10, act='softmax')
+        return main_p, startup, y
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    srv = serving.ServingExecutor(max_batch=8, executor=exe)
+    tenants = {}
+    for name, (w, s) in (('alpha', (32, 11)), ('beta', (48, 12))):
+        mp, sp, y = build(w, s)
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(sp)
+        tenants[name] = (mp, sc, y)
+        srv.add_program(name, mp, ['x'], [y], scope=sc)
+
+    # -- 1. readiness gates on warmup --------------------------------
+    ready, reasons = serving.readiness()
+    if ready is not False or not reasons:
+        failures.append('pre-warmup readiness should be (False, '
+                        'reasons), got (%r, %r)' % (ready, reasons))
+    if health.status()['ready']:
+        failures.append('/healthz ready before serving warmup')
+    srv.warmup(wait=True)
+    if serving.readiness() != (True, []):
+        failures.append('post-warmup readiness %r'
+                        % (serving.readiness(),))
+    if not health.status()['ready']:
+        failures.append('/healthz not ready after serving warmup: %r'
+                        % health.status()['reasons'])
+
+    # -- 2. two-thread soak: bitwise parity, zero retraces -----------
+    lowered0 = monitor.counter_value('executor/segments_lowered')
+    aot0 = monitor.counter_value('executor/aot_compiles')
+    results = {}
+    errors = []
+
+    def feeder(tid):
+        rng = np.random.RandomState(100 + tid)
+        for i in range(SOAK_REQUESTS_PER_THREAD):
+            name = ('alpha', 'beta')[(tid + i) % 2]
+            rows = (1, 3, 2, 7, 4)[i % 5]
+            xv = rng.randn(rows, 16).astype('float32')
+            try:
+                out, = srv.infer(name, {'x': xv}, timeout=120)
+                results[(tid, i)] = (name, xv, out)
+            except Exception as e:  # noqa: BLE001
+                errors.append('feeder %d req %d: %s' % (tid, i, e))
+
+    threads = [threading.Thread(target=feeder, args=(tid,))
+               for tid in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    if errors:
+        failures.append('soak errors: %s' % '; '.join(errors[:3]))
+    if len(results) != 2 * SOAK_REQUESTS_PER_THREAD:
+        failures.append('soak served %d/%d requests'
+                        % (len(results), 2 * SOAK_REQUESTS_PER_THREAD))
+    lowered_soak = monitor.counter_value(
+        'executor/segments_lowered') - lowered0
+    aot_soak = monitor.counter_value('executor/aot_compiles') - aot0
+    if lowered_soak or aot_soak:
+        failures.append('serving soak retraced: %g lowered, %g aot '
+                        'compiles after warmup'
+                        % (lowered_soak, aot_soak))
+    if monitor.counter_value('serving/retraces'):
+        failures.append('serving/retraces = %g (want 0)'
+                        % monitor.counter_value('serving/retraces'))
+    # bitwise parity vs direct unbatched execution at the SAME bucket
+    # the request ran in (coalescing picks the bucket from the total
+    # batch rows, and XLA may accumulate a row's dot products in a
+    # different order at a different gemm shape — so the guarantee is
+    # bitwise-per-bucket, float-noise across buckets).  Every result
+    # must bitwise-match one warmed bucket's unbatched run.
+    ladder = (1, 2, 4, 8)
+    mismatches = 0
+    for (tid, i), (name, xv, out) in sorted(results.items()):
+        mp, sc, y = tenants[name]
+        rows = xv.shape[0]
+        matched = False
+        for b in [b for b in ladder if b >= rows]:
+            padded, _ = serving.pad_rows_to_bucket({'x': xv}, rows, b)
+            with fluid.scope_guard(sc):
+                direct, = exe.run(mp, feed=padded, fetch_list=[y])
+            if np.array_equal(np.asarray(direct)[:rows], out):
+                matched = True
+                break
+        if not matched:
+            mismatches += 1
+    if mismatches:
+        failures.append('%d/%d results differ bitwise from unbatched '
+                        'execution at every ladder bucket'
+                        % (mismatches, len(results)))
+
+    # -- 3. metrics populated + lint-clean ---------------------------
+    occ = monitor.histogram_value('serving/batch_occupancy')
+    lat = monitor.histogram_value('serving/admit_to_done_seconds')
+    if not occ or occ['count'] <= 0:
+        failures.append('serving/batch_occupancy not recorded')
+    if not lat or lat['count'] != 2 * SOAK_REQUESTS_PER_THREAD:
+        failures.append('serving/admit_to_done_seconds count %r != %d'
+                        % (lat and lat['count'],
+                           2 * SOAK_REQUESTS_PER_THREAD))
+    if monitor.gauge_value('serving/queue_depth/alpha', -1.0) < 0:
+        failures.append('serving/queue_depth gauge missing')
+    if monitor.counter_value('serving/bucket_pad_waste_bytes') <= 0:
+        failures.append('serving/bucket_pad_waste_bytes not recorded '
+                        '(mixed row counts must pad)')
+    problems = health.prom_lint(monitor.prometheus_text())
+    if problems:
+        failures.append('/metrics lint: %s' % '; '.join(problems[:5]))
+
+    # -- 4. /statusz resident-program section ------------------------
+    sz = health.statusz()
+    names = sorted(t['tenant'] for rep in (sz.get('serving') or [])
+                   for t in rep['tenants'])
+    if names != ['alpha', 'beta']:
+        failures.append('/statusz serving section lists %r' % names)
+    else:
+        for rep in sz['serving']:
+            for t in rep['tenants']:
+                if not t['warmed'] or t['requests_served'] <= 0 or \
+                        not t['fingerprint']:
+                    failures.append('bad tenant report %r' % t)
+
+    srv.close()
+    occupancy = occ['sum'] / occ['count'] if occ and occ['count'] else 0
+    print('serving soak: %d requests, %d batches, mean occupancy '
+          '%.2f, %g retraces'
+          % (len(results), monitor.counter_value('serving/batches'),
+             occupancy, lowered_soak))
+    if failures:
+        for f in failures:
+            print('FAIL  ' + f)
+        return 1
+    print('serving plane: OK')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

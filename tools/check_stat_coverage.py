@@ -120,6 +120,22 @@ REQUIRED = [
     ('paddle_tpu/fluid/monitor.py', '# HELP'),
     ('paddle_tpu/distributed/launch.py', 'PADDLE_TPU_STATUS_WORKERS'),
     ('bench.py', 'health_overhead'),
+    # serving plane (fluid/serving.py): continuous-batching SLO
+    # surface — per-tenant queue depth, batch occupancy,
+    # admission-to-completion latency, pad waste, and the
+    # zero-retrace-after-warmup accounting; tools/check_serving.py
+    # exercises them against a live two-thread soak
+    ('paddle_tpu/fluid/serving.py', 'serving/queue_depth'),
+    ('paddle_tpu/fluid/serving.py', 'serving/batch_occupancy'),
+    ('paddle_tpu/fluid/serving.py', 'serving/admit_to_done_seconds'),
+    ('paddle_tpu/fluid/serving.py', 'serving/bucket_pad_waste_bytes'),
+    ('paddle_tpu/fluid/serving.py', 'serving/requests'),
+    ('paddle_tpu/fluid/serving.py', 'serving/batches'),
+    ('paddle_tpu/fluid/serving.py', 'serving/retraces'),
+    ('paddle_tpu/fluid/serving.py', 'serving/warmup_buckets'),
+    ('paddle_tpu/fluid/serving.py', "_trace.step_tags"),
+    ('paddle_tpu/fluid/trace.py', 'step_tags'),
+    ('bench.py', 'serving_requests_per_sec'),
 ]
 
 
