@@ -53,9 +53,12 @@ bench:
 # >=80% phase coverage) without costing anything when disabled, the
 # health plane must serve lint-clean /metrics + schema-stable
 # /healthz//statusz off a live executor with zero hot-path cost when
-# tensor-health summaries are off, and the serving plane must batch
+# tensor-health summaries are off, the serving plane must batch
 # a real two-thread soak bitwise-correctly with zero post-warmup
-# retraces and lint-clean serving metrics
+# retraces and lint-clean serving metrics, and the job-wide
+# observability plane must merge a real two-process job into one
+# schema-valid per-rank timeline with nonzero collective telemetry
+# and a calibrated comms cost model within 2x of measured
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
@@ -63,6 +66,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_trace.py
 	JAX_PLATFORMS=cpu python tools/check_health.py
 	JAX_PLATFORMS=cpu python tools/check_serving.py
+	JAX_PLATFORMS=cpu python tools/check_comms.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -1004,6 +1004,166 @@ def bench_health_overhead(depth=4, width=64, batch=32, steps=60,
                 **_monitor_fields())
 
 
+def bench_parallel(batch=256, width=256, steps=30, warmup=5,
+                   skew_seconds=20.0):
+    """Collective-job bench (BENCH_comms.json): a GradAllReduce MLP
+    over the host's device mesh measures bytes_on_wire per step and
+    per-(collective, size-bucket) achieved bandwidth through the
+    fluid.comms telemetry; a real two-subprocess job (rank 1 fed a 4x
+    batch — a genuine straggler) then reports cross-rank skew from the
+    rank-0 aggregator and the merged job timeline from
+    trace.collect_job — so future collective PRs (ROADMAP item 3) can
+    name what they moved."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import comms, layers, monitor
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+    ndev = len(jax.devices())
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_p, startup):
+        x = layers.data('x', shape=[width], dtype='float32')
+        h = layers.fc(x, width, act='relu')
+        h = layers.fc(h, width, act='relu')
+        loss = layers.reduce_mean(layers.fc(h, 1))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    GradAllReduce().transpile(startup, main_p, 0, ['127.0.0.1:0'],
+                              '127.0.0.1:0')
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.rand(batch, width).astype('float32')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        wire0 = monitor.counter_value('comms/bytes_on_wire')
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        wall = time.perf_counter() - t0
+        wire = monitor.counter_value('comms/bytes_on_wire') - wire0
+    bw = {}
+    for (kind, bucket), samples in sorted(comms.bw_samples().items()):
+        s = sorted(samples)
+        bw['%s/%s' % (kind, bucket)] = {
+            'p50_gbps': round(s[len(s) // 2], 6),
+            'max_gbps': round(s[-1], 6),
+            'samples': len(s)}
+    rec = {
+        'metric': 'parallel_comms',
+        'value': round(steps / wall, 2),
+        'unit': 'steps/sec',
+        'devices': ndev,
+        'batch': batch,
+        'bytes_on_wire_per_step': round(wire / max(1, steps), 1),
+        'payload_bytes_total':
+            monitor.counter_value('comms/payload_bytes'),
+        'bandwidth': bw,
+    }
+    rec.update(_skew_job_fields(skew_seconds))
+    rec.update(_monitor_fields())
+    return rec
+
+
+def _skew_job_fields(run_for):
+    """The cross-rank half of bench_parallel: a real two-subprocess
+    job (tests/comms_worker.py, rank 1 with a 4x batch), scraped for
+    the aggregator's skew report and merged through collect_job.
+    Degrades to {'skew': None} if the job cannot come up — the
+    in-process comms numbers must survive a constrained container."""
+    import socket
+    import subprocess
+    import urllib.request
+
+    def free_port():
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def get(url, timeout=5):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, 'tests', 'comms_worker.py')
+    p0, p1 = free_port(), free_port()
+    spec = '0=127.0.0.1:%d,1=127.0.0.1:%d' % (p0, p1)
+    base = dict(os.environ,
+                PADDLE_TPU_STATUS_WORKERS=spec,
+                FLAGS_health_heartbeat_seconds='0.5',
+                FLAGS_trace='1')
+    procs = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(p1), str(run_for + 60), '4'],
+            env=dict(base, PADDLE_TRAINER_ID='1',
+                     PADDLE_TPU_STATUS_AGGREGATE='0'),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(p0), str(run_for + 60)],
+            env=dict(base, PADDLE_TRAINER_ID='0',
+                     PADDLE_TPU_STATUS_AGGREGATE='1'),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        agg = 'http://127.0.0.1:%d' % p0
+        deadline = time.time() + run_for + 90
+        skew = None
+        while time.time() < deadline:
+            try:
+                code, body = get(agg + '/statusz')
+                doc = json.loads(body)
+                job = doc.get('job') or {}
+                skew = job.get('skew')
+                workers = job.get('workers') or {}
+                if skew and len(workers) >= 2 and \
+                        all(w.get('up') for w in workers.values()):
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        merged = None
+        try:
+            code, body = get(agg + '/trace/collect', timeout=30)
+            doc = json.loads(body)
+            merged = {
+                'ranks': len(doc['ptJob']['workers']),
+                'events': sum(1 for e in doc['traceEvents']
+                              if e.get('ph') == 'X'),
+                'skipped': len(doc['ptJob']['skipped']),
+            }
+        except Exception:
+            pass
+        out = {'skew': None, 'job_timeline': merged}
+        if skew:
+            wall = skew['wall']
+            worst_phase = None
+            if skew.get('phases'):
+                name, ph = max(skew['phases'].items(),
+                               key=lambda kv: kv[1]['ratio'])
+                worst_phase = {'phase': name,
+                               'slowest_rank': ph['slowest_rank'],
+                               'ratio': round(ph['ratio'], 3)}
+            out['skew'] = {
+                'slowest_rank': wall['slowest_rank'],
+                'skew_ratio': round(wall['skew_ratio'], 3),
+                'max_p50_ms': round(wall['max_p50_ms'], 3),
+                'median_p50_ms': round(wall['median_p50_ms'], 3),
+                'worst_phase': worst_phase,
+            }
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
 SMOKE_BENCHES = (('dispatch', {}),
                  ('health_overhead', {}),
                  ('lenet', {'batch': 64, 'steps': 30}))
@@ -1061,6 +1221,15 @@ def _run_entry(name, kwargs, timeout=900):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == '--parallel':
+        # multi-device posture BEFORE the first jax import: the comms
+        # numbers need a real mesh (8 virtual CPU devices when the
+        # host has no accelerator platform configured)
+        flags = os.environ.get('XLA_FLAGS', '')
+        if 'xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8'
+            ).strip()
     _enable_compile_cache()
     if len(sys.argv) > 1 and sys.argv[1] == '--one' and \
             len(sys.argv) < 3:
@@ -1099,6 +1268,20 @@ def main():
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--serving',
+                       'entries': [rec]}, f, indent=1, sort_keys=True)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--parallel':
+        # collective-job comms telemetry: bytes on wire, achieved
+        # bandwidth per (collective, size bucket), cross-rank skew.
+        # Baseline recorded in BENCH_comms.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'BENCH_comms.json')
+        rec = bench_parallel()
+        print(json.dumps(rec))
+        with open(out, 'w') as f:
+            json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
+                              '--parallel',
                        'entries': [rec]}, f, indent=1, sort_keys=True)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--smoke':

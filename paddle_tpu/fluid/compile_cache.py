@@ -489,6 +489,12 @@ class CompilePlane(object):
                 # keep the recorded out specs: a later warmup() then
                 # skips the foreground re-trace of this segment
                 self.note_out_specs(fp, out_specs)
+                # a restarted process builds nothing, so the memory
+                # accounting (executor/segment_*_bytes, /statusz)
+                # must ride the disk hit or it would go dark exactly
+                # in the zero-retrace posture
+                from . import comms
+                comms.record_memory('fp:%s' % fp[:12], ex)
                 return ex
             monitor.add('executor/compile_cache_disk_miss')
         ex, out_specs = build()
@@ -522,6 +528,8 @@ class CompilePlane(object):
                         fut.set_result(ex)
                         self.store(fp, ex)
                         self.note_out_specs(fp, out_specs)
+                        from . import comms
+                        comms.record_memory('fp:%s' % fp[:12], ex)
                         return
                     monitor.add('executor/compile_cache_disk_miss')
                 ex, out_specs = build()

@@ -47,7 +47,7 @@ def _stat_nbytes(v):
 class _Segment(object):
     __slots__ = ('ops', 'input_names', 'state_names', 'output_names',
                  'compiled', 'bucket_ops', 'prefer_test', 'binder',
-                 'pbinder', 'health_params')
+                 'pbinder', 'health_params', 'comms_key')
 
     def __init__(self, ops):
         self.ops = ops
@@ -76,6 +76,10 @@ class _Segment(object):
         # (param names this segment updates, param->grad map) for the
         # FLAGS_health_summaries reductions; resolved lazily
         self.health_params = None
+        # fluid.comms registry key (the compile fingerprint the
+        # parallel/collective runners trace under): dispatches look up
+        # the segment's collective records through it
+        self.comms_key = None
 
 
 class _Plan(list):
@@ -1026,6 +1030,21 @@ def _aot_build(seg, wpg, state_specs, data_specs, device=None):
     monitor.add('executor/segments_lowered')
     monitor.observe('executor/segment_compile_seconds', t1 - t0)
     _trace.record('compile', t0, t1, {'ops': len(seg.ops)})
+    # per-segment XLA memory accounting (argument/output/temp/peak
+    # bytes): the HBM-budget input the placement planner and /statusz
+    # read; never raises, cheap (compile-time only).  The spec digest
+    # keeps bucketed/per-shape variants of one segment as DISTINCT
+    # rows — they are distinct resident executables, and the gauges
+    # sum residency
+    import hashlib as _hashlib
+    from . import comms as _comms
+    spec_tag = _hashlib.sha1(
+        repr((state_specs, data_specs)).encode()).hexdigest()[:8]
+    _comms.record_memory(
+        '%dops:%s@%s' % (len(seg.ops),
+                         ','.join(sorted(seg.output_names)[:3]),
+                         spec_tag),
+        compiled)
     out_specs = {n: (tuple(int(s) for s in v.shape),
                      _np.dtype(v.dtype).str)
                  for n, v in out_info.items()}

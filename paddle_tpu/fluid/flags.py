@@ -101,6 +101,11 @@ _DEFAULTS = {
     # max update ratio auto-dump the flight recorder
     # (health/zero_update_trips); 0 disables
     'FLAGS_health_zero_update_steps': 3,
+    # straggler detector (rank-0 aggregator): when the slowest rank's
+    # p50 step wall exceeds the cross-rank median by this factor, count
+    # comms/straggler_trips and (rate-limited, tracer live) auto-dump
+    # the flight recorder with the skew report embedded; 0 disables
+    'FLAGS_straggler_factor': 2.0,
     # NaN provenance (executor._check_nan_inf): with
     # FLAGS_check_nan_inf on, keep per-step device copies of segment
     # state so a tripped verdict can replay the segment op-by-op and

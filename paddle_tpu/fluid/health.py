@@ -177,12 +177,42 @@ def statusz():
         versions['backend'] = jax.default_backend()
     except Exception:
         pass
+    # per-segment XLA memory accounting (fluid.comms.record_memory):
+    # the HBM-budget view the placement planner reads
+    memory_section = None
+    try:
+        from . import comms
+        rows = comms.memory_report()
+        if rows:
+            memory_section = {
+                'segments': rows[:32],
+                'segment_argument_bytes': monitor.gauge_value(
+                    'executor/segment_argument_bytes'),
+                'segment_output_bytes': monitor.gauge_value(
+                    'executor/segment_output_bytes'),
+                'segment_temp_bytes': monitor.gauge_value(
+                    'executor/segment_temp_bytes'),
+                'segment_peak_bytes': monitor.gauge_value(
+                    'executor/segment_peak_bytes'),
+            }
+    except Exception:
+        pass
+    # aggregator rank: per-rank liveness + last-heartbeat skew, so one
+    # /statusz answers 'is the job healthy and who is the straggler'
+    job_section = None
+    if _server is not None and _server.aggregator is not None:
+        try:
+            job_section = _server.aggregator.job_view()
+        except Exception:
+            pass
     raw = monitor.raw_state()
     return {
         'status': status(),
         'step_report': trace.step_report(),
         'caches': caches,
         'serving': serving_section,
+        'memory': memory_section,
+        'job': job_section,
         'flags': _all_flags(),
         'versions': versions,
         'trace_active': trace.is_active(),
@@ -368,19 +398,10 @@ def render_merged(states, prefix='paddle_tpu'):
 
 
 # ----------------------------------------------------------- aggregator
-def _parse_workers(spec):
-    """'0=host:port,1=host:port' -> [(rank, endpoint), ...]."""
-    out = []
-    for part in (spec or '').split(','):
-        part = part.strip()
-        if not part:
-            continue
-        if '=' in part:
-            rank, ep = part.split('=', 1)
-        else:
-            rank, ep = str(len(out)), part
-        out.append((rank.strip(), ep.strip()))
-    return out
+# '0=host:port,1=host:port' -> [(rank, endpoint), ...]; one parser for
+# the PADDLE_TPU_STATUS_WORKERS wire format, shared with
+# trace.collect_job so the two planes can never read one spec two ways
+_parse_workers = trace._parse_worker_spec
 
 
 def _http_get(url, timeout):
@@ -398,14 +419,17 @@ class _Aggregator(object):
 
     def __init__(self, self_rank, workers, interval):
         self.self_rank = str(self_rank)
-        self.workers = [(r, ep) for r, ep in workers
+        self.all_workers = [(str(r), ep) for r, ep in workers]
+        self.workers = [(r, ep) for r, ep in self.all_workers
                         if r != self.self_rank]
         self.interval = float(interval)
         self._lock = threading.Lock()
         self._peers = {r: {'endpoint': ep, 'up': False, 'ready': False,
                            'state': None, 'status': None, 'error': None,
-                           'ts': 0.0}
+                           'rollup': None, 'ts': 0.0}
                        for r, ep in self.workers}
+        self._last_skew = None
+        self._last_straggler_dump = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name='pt_health_agg')
@@ -414,6 +438,7 @@ class _Aggregator(object):
     def _loop(self):
         while not self._stop.is_set():
             self.probe_once()
+            self.check_skew()
             self._stop.wait(self.interval)
 
     def _probe_one(self, rank, ep):
@@ -428,15 +453,87 @@ class _Aggregator(object):
                                       .get('ready')),
                         'state': doc.get('state'),
                         'status': doc.get('status'),
+                        'rollup': doc.get('step_rollup'),
                         'error': None})
         except Exception as e:
             monitor.add('health/scrape_errors')
             rec.update({'up': False, 'ready': False, 'state': None,
-                        'status': None, 'error': str(e)})
+                        'status': None, 'rollup': None,
+                        'error': str(e)})
         with self._lock:
             self._peers[rank].update(rec)
         monitor.set_gauge('health/worker_up/%s' % rank,
                           1.0 if rec['up'] else 0.0)
+
+    # ------------------------------------------- straggler / skew
+    def skew(self):
+        """Cross-rank skew report over the latest scraped step rollups
+        (plus this process's own flight recorder); None until some
+        rank has steps."""
+        rollups = {}
+        try:
+            rollups[self.self_rank] = trace.step_rollup()
+        except Exception:
+            pass
+        for r, p in self.peers().items():
+            if p.get('rollup'):
+                rollups[r] = p['rollup']
+        return trace.job_skew_report(rollups)
+
+    def check_skew(self):
+        """One detector pass (called each heartbeat): publish the
+        comms/skew_ratio gauge and, past FLAGS_straggler_factor, count
+        the trip and auto-dump the flight recorder with the skew
+        report embedded — rate-limited to one dump per ten heartbeats
+        so a persistently skewed job cannot spam /tmp.  Never
+        raises."""
+        try:
+            rep = self.skew()
+        except Exception:
+            return None
+        self._last_skew = rep
+        if rep is None:
+            return None
+        ratio = float(rep['wall']['skew_ratio'])
+        monitor.set_gauge('comms/skew_ratio', ratio)
+        factor = float(get_flag('FLAGS_straggler_factor', 0.0) or 0.0)
+        if factor > 0 and ratio >= factor:
+            monitor.add('comms/straggler_trips')
+            now = time.time()
+            if now - self._last_straggler_dump >= 10 * self.interval:
+                self._last_straggler_dump = now
+                path = trace.dump_on_error('straggler', extra={
+                    'detector': 'straggler', 'skew': rep})
+                if path:
+                    monitor.add('health/detector_dumps')
+        return rep
+
+    def job_view(self):
+        """The /statusz 'job' section: per-rank liveness + the last
+        heartbeat's skew report."""
+        own = status()
+        now = time.time()
+        workers = {self.self_rank: {
+            'up': True, 'ready': own['ready'], 'endpoint': 'local',
+            'steps': own['steps'], 'last_scrape_age_s': 0.0}}
+        for r, p in self.peers().items():
+            workers[r] = {
+                'up': p['up'], 'ready': p['ready'],
+                'endpoint': p['endpoint'], 'error': p['error'],
+                'steps': (p.get('status') or {}).get('steps'),
+                'last_scrape_age_s': (round(now - p['ts'], 3)
+                                      if p['ts'] else None)}
+        return {'workers': workers, 'skew': self._last_skew,
+                'heartbeat_seconds': self.interval}
+
+    def collect_job(self, out_path=None):
+        """Job-wide trace collection (the tentpole): pull every
+        worker's /trace/dump, fold in this process's own flight
+        recorder, return ONE merged Perfetto timeline document."""
+        return trace.collect_job(workers=self.all_workers,
+                                 local=self.self_rank,
+                                 timeout=max(self.interval, 5.0),
+                                 out_path=out_path)
 
     def probe_once(self):
         # concurrent probes: a partitioned host times out after ONE
@@ -561,7 +658,9 @@ def _make_handler(aggregator):
                 elif path == '/metrics.json':
                     self._send_json(200, {'rank': _self_rank(),
                                           'state': monitor.raw_state(),
-                                          'status': status()})
+                                          'status': status(),
+                                          'step_rollup':
+                                              trace.step_rollup()})
                 elif path == '/healthz':
                     if aggregator is not None:
                         doc = aggregator.healthz()
@@ -579,13 +678,20 @@ def _make_handler(aggregator):
                         doc = json.load(f)
                     doc['ptDumpPath'] = p
                     self._send_json(200, doc)
+                elif path == '/trace/collect':
+                    if aggregator is None:
+                        self._send_json(404, {
+                            'error': 'not the aggregator rank; '
+                                     'scrape rank 0'})
+                    else:
+                        self._send_json(200, aggregator.collect_job())
                 else:
                     self._send_json(404, {
                         'error': 'unknown path %s' % path,
                         'paths': ['/metrics', '/metrics.json',
                                   '/metrics/local', '/healthz',
                                   '/healthz/local', '/statusz',
-                                  '/trace/dump']})
+                                  '/trace/dump', '/trace/collect']})
             except Exception as e:  # a broken handler must not kill
                 monitor.add('health/http_errors')
                 try:

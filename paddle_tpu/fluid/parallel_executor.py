@@ -16,6 +16,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import comms
 from . import compile_cache
 from . import core
 from . import monitor
@@ -45,6 +46,20 @@ def _resolve_fetch(val, return_numpy):
     if return_numpy == 'async':
         return FetchHandle(val, resolver=_fetch_to_host)
     return _fetch_to_host(val) if return_numpy else val
+
+
+def _dispatch_span(name, key, records):
+    """The segment-dispatch trace span, annotated with the segment's
+    collective profile (payload/wire bytes, per-kind call counts, mesh
+    axes, participants) when it has one — comms-free segments pay one
+    truth test, and the profile itself is the memoized summary of the
+    frozen records (one dict lookup per step, not an O(records)
+    rebuild)."""
+    if records and _trace.is_active():
+        annot = comms.summary_for(key)
+        if annot:
+            return _trace.span(name, **annot)
+    return _trace.span(name)
 
 
 def _default_mesh(places=None):
@@ -369,14 +384,33 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
             fp, lambda: jax.jit(fn, in_shardings=in_shardings,
                                 donate_argnums=(1,)))
         seg.compiled['parallel'] = compiled
+        seg.comms_key = fp
+    recs = comms.records_for(seg.comms_key)
     try:
+        t0 = _time_mod.perf_counter()
         if first_run:
-            t0 = _time_mod.perf_counter()
-        with _trace.span('compile' if first_run else 'dispatch'):
-            out = compiled(executor._step, state, data)
-        if first_run:
+            # the first call runs the deferred jit trace: collect the
+            # collective records the lowerings file, keyed by the
+            # shared-jit fingerprint so reused jits keep their profile
+            with comms.collecting(seg.comms_key):
+                with _trace.span('compile'):
+                    out = compiled(executor._step, state, data)
+            recs = comms.records_for(seg.comms_key)
             monitor.observe('parallel/segment_compile_seconds',
                             _time_mod.perf_counter() - t0)
+        else:
+            with _dispatch_span('dispatch', seg.comms_key, recs):
+                out = compiled(executor._step, state, data)
+        if recs:
+            # achieved bandwidth needs the EXECUTION wall, not the
+            # async dispatch: block here — the donated-state release
+            # below would block on the in-flight execution anyway
+            # (PR 4's state_release discovery), so this only moves
+            # that sync earlier and attributes it to comms
+            jax.block_until_ready(out)
+            comms.account_dispatch(recs,
+                                   _time_mod.perf_counter() - t0,
+                                   compile_run=first_run)
     except Exception as e:
         # same incident contract as the single-device executor: the
         # flight recorder holds the steps that led here — dump it
@@ -502,6 +536,7 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
 
             compiled = compile_cache.plane().shared_jit(fp, _build)
             seg.compiled['collective'] = compiled
+            seg.comms_key = fp
         if jax.process_count() > 1:
             # a process-local scalar would carry an inconsistent
             # single-device sharding across processes; replicate it
@@ -509,14 +544,31 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                               NamedSharding(mesh, P()))
         else:
             step = jnp.asarray(executor._step)
+        recs = comms.records_for(seg.comms_key)
         try:
+            t0 = _time_mod.perf_counter()
             if first_run:
-                t0 = _time_mod.perf_counter()
-            with _trace.span('compile' if first_run else 'dispatch'):
-                out = compiled(step, state, data)
-            if first_run:
+                # first call runs the deferred jit trace: collect the
+                # collective records the c_* lowerings file, keyed by
+                # the shared-jit fingerprint
+                with comms.collecting(seg.comms_key):
+                    with _trace.span('compile'):
+                        out = compiled(step, state, data)
+                recs = comms.records_for(seg.comms_key)
                 monitor.observe('parallel/segment_compile_seconds',
                                 _time_mod.perf_counter() - t0)
+            else:
+                with _dispatch_span('dispatch', seg.comms_key, recs):
+                    out = compiled(step, state, data)
+            if recs:
+                # bandwidth needs the execution wall, not the async
+                # dispatch; the donated-state release below blocks on
+                # the in-flight execution anyway — this moves that
+                # sync earlier and attributes it to comms
+                jax.block_until_ready(out)
+                comms.account_dispatch(
+                    recs, _time_mod.perf_counter() - t0,
+                    compile_run=first_run)
         except Exception as e:
             detail = []
             for group, d in (('state', state), ('data', data)):

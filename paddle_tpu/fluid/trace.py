@@ -57,8 +57,9 @@ from .flags import get_flag
 __all__ = [
     'enable', 'disable', 'is_active', 'reset', 'span', 'record',
     'traced', 'step_span', 'step_tags', 'steps', 'step_report',
-    'report_from_records', 'format_step_report', 'chrome_events',
-    'merge_device_trace', 'write_chrome', 'dump', 'dump_on_error',
+    'step_rollup', 'report_from_records', 'format_step_report',
+    'chrome_events', 'merge_device_trace', 'write_chrome', 'dump',
+    'dump_payload', 'dump_on_error', 'collect_job', 'job_skew_report',
     'now_us',
 ]
 
@@ -454,6 +455,99 @@ def step_report(last=None):
     return report_from_records(recs)
 
 
+def step_rollup(last=None):
+    """Compact per-process rollup for cross-worker scrapes (the
+    /metrics.json form the rank-0 aggregator's skew detector reads):
+    step count, wall p50/p99/max, total phase milliseconds."""
+    recs = steps()
+    if last:
+        recs = recs[-int(last):]
+    return step_rollup_from(recs)
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _skew_reference(vals, slowest_key):
+    """The skew denominator: median over the OTHER ranks.  Including
+    the straggler itself would cap a 2-rank job's ratio at 2x no
+    matter how slow the straggler is (the median of {fast, slow}
+    contains half the straggler)."""
+    others = [v for k, v in vals.items() if k != slowest_key]
+    return _median(others) if others else vals[slowest_key]
+
+
+# skew ratio when the reference is zero but the straggler is not (a
+# phase only the straggler runs): a large FINITE sentinel — it trips
+# any FLAGS_straggler_factor, and unlike inf it survives strict-JSON
+# serialization of /statusz and collected job documents
+_SKEW_UNBOUNDED = 1e9
+
+
+def _skew_ratio(max_val, reference):
+    if reference > 0:
+        return max_val / reference
+    return _SKEW_UNBOUNDED if max_val > 0 else 1.0
+
+
+def job_skew_report(rollups):
+    """Cross-rank straggler/skew analysis over per-rank step-report
+    rollups ({rank: step_rollup()-shaped dict}).  Wall skew is the
+    slowest rank's p50 over the median p50 of the REMAINING ranks (a
+    single straggler cannot drag its own reference — see
+    _skew_reference); each phase gets the same slowest-rank
+    attribution over per-step phase milliseconds, so 'rank 3 spends
+    2.1x the median step time, and the skew lives in dispatch' is one
+    read.  Returns None when no rank has steps yet."""
+    ranks = {str(r): roll for r, roll in (rollups or {}).items()
+             if roll and roll.get('count')}
+    if not ranks:
+        return None
+    wall = {r: float(roll.get('wall_p50_ms') or 0.0)
+            for r, roll in ranks.items()}
+    slowest = max(wall, key=lambda r: wall[r])
+    med = _skew_reference(wall, slowest)
+    per_rank = {}
+    for r, roll in ranks.items():
+        p50 = float(roll.get('wall_p50_ms') or 0.0)
+        p99 = float(roll.get('wall_p99_ms') or 0.0)
+        per_rank[r] = {
+            'steps': int(roll['count']),
+            'wall_p50_ms': p50,
+            'wall_p99_ms': p99,
+            'p99_over_p50': (p99 / p50) if p50 > 0 else 1.0,
+        }
+    phase_names = set()
+    for roll in ranks.values():
+        phase_names.update(roll.get('phases_ms') or {})
+    phases = {}
+    for name in sorted(phase_names):
+        per_step = {r: float((roll.get('phases_ms') or {})
+                             .get(name, 0.0)) / max(1, roll['count'])
+                    for r, roll in ranks.items()}
+        pslow = max(per_step, key=lambda r: per_step[r])
+        pmed = _skew_reference(per_step, pslow)
+        phases[name] = {
+            'slowest_rank': pslow,
+            'max_ms': per_step[pslow],
+            'median_ms': pmed,
+            'ratio': _skew_ratio(per_step[pslow], pmed),
+        }
+    return {
+        'ranks': per_rank,
+        'wall': {
+            'slowest_rank': slowest,
+            'max_p50_ms': wall[slowest],
+            'median_p50_ms': med,
+            'skew_ratio': _skew_ratio(wall[slowest], med),
+        },
+        'phases': phases,
+    }
+
+
 def format_step_report(report=None):
     """Render a report (default: the live one) as the per-step table
     tools/stat_summary.py --steps prints."""
@@ -582,20 +676,15 @@ def write_chrome(path, events):
 
 
 # ------------------------------------------------------- flight recorder
-def dump(path=None, extra=None):
-    """Write the flight recorder (last N steps) as chrome-trace JSON;
-    the same file carries the raw step records under 'ptSteps' so
-    stat_summary.py --steps can rebuild the report offline.  The step
-    IN FLIGHT (spans recorded since the last step sealed — exactly the
-    step that failed, in the on-error path) is included as a partial
-    record.  `extra` (a JSON-able dict — e.g. the executor's NaN
-    provenance report) is embedded under 'ptIncident' so the dump that
-    captures an incident also carries its diagnosis."""
-    import json
-    if path is None:
-        import tempfile
-        path = os.path.join(tempfile.gettempdir(),
-                            'pt_trace_%d.json' % os.getpid())
+def dump_payload(extra=None):
+    """The flight-recorder dump as a dict: chrome events, raw step
+    records ('ptSteps'), this worker's rank ('ptRank') and — the
+    cross-worker merge anchor — 'ptClock': the unix wall clock and the
+    exporter's epoch-us clock read AT THE SAME INSTANT.  Exported
+    timestamps ride the (perf_counter, time.time) pair pinned at
+    import; NTP steps since then drift every worker's export clock
+    independently, so collect_job() re-homes each dump by
+    (unix_us - export_us) — no guessing, per the job-merge contract."""
     recs = steps()
     open_spans = list(_events)
     if open_spans:
@@ -611,6 +700,9 @@ def dump(path=None, extra=None):
     payload = {
         'traceEvents': chrome_events(),
         'displayTimeUnit': 'ms',
+        'ptRank': os.environ.get('PADDLE_TRAINER_ID', '0'),
+        'ptClock': {'unix_us': time.time() * 1e6,
+                    'export_us': now_us()},
         'ptSteps': [{'step': r['step'], 't0': r['t0'], 't1': r['t1'],
                      'tid': r.get('tid'), 'tags': r.get('tags'),
                      'spans': [[s[0], s[1], s[2], s[3], s[4],
@@ -620,6 +712,24 @@ def dump(path=None, extra=None):
     }
     if extra:
         payload['ptIncident'] = extra
+    return payload
+
+
+def dump(path=None, extra=None):
+    """Write the flight recorder (last N steps) as chrome-trace JSON;
+    the same file carries the raw step records under 'ptSteps' so
+    stat_summary.py --steps can rebuild the report offline.  The step
+    IN FLIGHT (spans recorded since the last step sealed — exactly the
+    step that failed, in the on-error path) is included as a partial
+    record.  `extra` (a JSON-able dict — e.g. the executor's NaN
+    provenance report) is embedded under 'ptIncident' so the dump that
+    captures an incident also carries its diagnosis."""
+    import json
+    if path is None:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            'pt_trace_%d.json' % os.getpid())
+    payload = dump_payload(extra=extra)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -699,6 +809,237 @@ def write_host_trace(path, capture):
                    'ptSync': capture['sync_us'],
                    'ptCaptureT0': capture['t0_us']}, f)
     return path
+
+
+# --------------------------------------------------- job-wide collection
+def _parse_worker_spec(spec):
+    """'0=host:port,1=host:port' -> [(rank, endpoint), ...] (the
+    PADDLE_TPU_STATUS_WORKERS wire format distributed/launch.py
+    emits)."""
+    out = []
+    for part in (spec or '').split(','):
+        part = part.strip()
+        if not part:
+            continue
+        if '=' in part:
+            rank, ep = part.split('=', 1)
+        else:
+            rank, ep = str(len(out)), part
+        out.append((rank.strip(), ep.strip()))
+    return out
+
+
+def _http_fetch_dump(timeout):
+    def fetch(endpoint):
+        import urllib.request
+        with urllib.request.urlopen(
+                'http://%s/trace/dump' % endpoint,
+                timeout=timeout) as resp:
+            return resp.read()
+    return fetch
+
+
+def collect_job(workers=None, fetch=None, timeout=10.0, local=None,
+                out_path=None):
+    """Pull every worker's ``/trace/dump`` and merge the job into ONE
+    Perfetto timeline with per-rank process tracks.
+
+    - `workers`: [(rank, endpoint)] pairs, a
+      'rank=host:port,...' spec string, or None to read
+      PADDLE_TPU_STATUS_WORKERS (the launcher's wire format).
+    - `fetch`: injectable ``fetch(endpoint) -> bytes`` (tests, file
+      merges via tools/timeline.py --job); default is HTTP GET
+      ``/trace/dump``.
+    - `local`: optional rank label for THIS process — its own
+      flight recorder is folded in without an HTTP round trip (the
+      rank-0 aggregator passes its own rank).
+
+    Clock re-homing: every dump carries 'ptClock' (unix wall clock +
+    export clock read at the same instant), so each rank's events
+    shift by (unix_us - export_us) onto the NTP-synced wall clock —
+    two workers' dumps merge without guessing.  A dump missing the
+    anchor (older build) falls back to capture-start alignment against
+    the earliest anchored rank and is counted in
+    trace/collect_unanchored.  A worker returning an empty, truncated
+    or unparsable dump is SKIPPED and counted in trace/collect_skipped
+    — a sick worker must never kill the aggregator's collection.
+
+    Returns the merged job document ({'traceEvents', 'ptSteps' (each
+    record tagged with its 'rank'), 'ptJob': {workers, skipped,
+    skew}}); `out_path` additionally writes it as Perfetto-loadable
+    JSON."""
+    import json
+    if workers is None:
+        workers = os.environ.get('PADDLE_TPU_STATUS_WORKERS', '')
+    if isinstance(workers, str):
+        workers = _parse_worker_spec(workers)
+    if fetch is None:
+        fetch = _http_fetch_dump(timeout)
+    docs = []       # (rank, doc, source)
+    skipped = {}
+    local_rank = str(local) if local is not None else None
+    if local_rank is not None:
+        docs.append((local_rank, dump_payload(), 'local'))
+    remote = [(str(rank), ep) for rank, ep in workers
+              if str(rank) != local_rank]
+
+    def _fetch_one(rank, ep, out):
+        try:
+            raw = fetch(ep)
+            if isinstance(raw, bytes):
+                raw = raw.decode('utf-8')
+            doc = json.loads(raw)
+            if not isinstance(doc, dict) or \
+                    not isinstance(doc.get('traceEvents'), list):
+                raise ValueError('dump has no traceEvents list')
+            out[rank] = (doc, None)
+        except Exception as e:
+            out[rank] = (None, '%s: %s' % (ep, e))
+
+    # concurrent pulls, same rationale as the health aggregator's
+    # probe fan-out: a partitioned host costs ONE timeout, not
+    # worker-count x timeout — /trace/collect stays responsive at
+    # any job size
+    results = {}
+    fetchers = [threading.Thread(target=_fetch_one,
+                                 args=(rank, ep, results), daemon=True)
+                for rank, ep in remote]
+    for t in fetchers:
+        t.start()
+    for t in fetchers:
+        t.join(timeout + 5.0)
+    used_ranks = {r for r, _d, _s in docs}
+    for rank, ep in remote:
+        doc, err = results.get(rank) or \
+            (None, '%s: fetch timed out' % ep)
+        if doc is not None:
+            # the dump's own ptRank is authoritative (file merges may
+            # pass dumps in any order); the caller's label is the
+            # fallback — and breaks ties when un-launched processes
+            # all claim the default rank 0
+            own = doc.get('ptRank')
+            own = str(own) if own is not None else None
+            label = own if own and own not in used_ranks else rank
+            used_ranks.add(label)
+            docs.append((label, doc, ep))
+        else:
+            monitor.add('trace/collect_skipped')
+            skipped[rank] = err
+    monitor.add('trace/collect_calls')
+
+    # clock shifts: anchored dumps are exact; unanchored ones align
+    # their earliest event to the earliest anchored rank's start
+    def _anchor_shift(doc):
+        clock = doc.get('ptClock')
+        if isinstance(clock, dict) and \
+                isinstance(clock.get('unix_us'), (int, float)) and \
+                isinstance(clock.get('export_us'), (int, float)):
+            return float(clock['unix_us']) - float(clock['export_us'])
+        return None
+
+    def _min_ts(doc):
+        ts = [e.get('ts') for e in doc['traceEvents']
+              if isinstance(e, dict) and
+              isinstance(e.get('ts'), (int, float))]
+        return min(ts) if ts else None
+
+    anchored_starts = []
+    shifts = {}
+    for rank, doc, _src in docs:
+        shift = _anchor_shift(doc)
+        shifts[rank] = shift
+        if shift is not None:
+            t = _min_ts(doc)
+            if t is not None:
+                anchored_starts.append(t + shift)
+    fallback_start = min(anchored_starts) if anchored_starts else None
+    merged = []
+    all_steps = []
+    workers_meta = {}
+    for idx, (rank, doc, src) in enumerate(docs):
+        shift = shifts[rank]
+        clock_mode = 'anchored'
+        if shift is None:
+            monitor.add('trace/collect_unanchored')
+            clock_mode = 'aligned'
+            t = _min_ts(doc)
+            ref = fallback_start if fallback_start is not None else \
+                (_min_ts(docs[0][1]) or 0.0)
+            shift = (ref - t) if t is not None else 0.0
+        # per-rank process tracks: remap every pid into a rank-owned
+        # band and title the band, so Perfetto shows 'rank N ...'
+        # processes side by side on the shared clock
+        base = idx * 100
+        pid_map = {}
+        n_events = 0
+        for e in doc['traceEvents']:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            pid = e.get('pid')
+            pid = pid if isinstance(pid, int) else 0
+            if pid not in pid_map:
+                pid_map[pid] = base + len(pid_map)
+            e['pid'] = pid_map[pid]
+            if isinstance(e.get('ts'), (int, float)):
+                e['ts'] = e['ts'] + shift
+            if e.get('ph') == 'M' and e.get('name') == 'process_name':
+                args = dict(e.get('args') or {})
+                args['name'] = 'rank %s %s' % (
+                    rank, args.get('name') or 'process')
+                e['args'] = args
+            merged.append(e)
+            n_events += 1
+        for pid in sorted(pid_map.values()):
+            merged.append({'ph': 'M', 'pid': pid, 'tid': 0,
+                           'cat': 'pt_job', 'name': 'process_sort_index',
+                           'args': {'sort_index': pid}})
+        recs = doc.get('ptSteps')
+        recs = recs if isinstance(recs, list) else []
+        for rec in recs:
+            if isinstance(rec, dict):
+                rec = dict(rec)
+                rec['rank'] = rank
+                all_steps.append(rec)
+        workers_meta[rank] = {'source': src, 'events': n_events,
+                              'steps': len(recs), 'clock': clock_mode}
+    per_rank = {}
+    for rec in all_steps:
+        per_rank.setdefault(rec['rank'], []).append(rec)
+    rollups = {}
+    for rank, recs in per_rank.items():
+        try:
+            rollups[rank] = step_rollup_from(recs)
+        except Exception:
+            pass
+    out = {
+        'traceEvents': merged,
+        'displayTimeUnit': 'ms',
+        'ptSteps': all_steps,
+        'ptJob': {
+            'workers': workers_meta,
+            'skipped': skipped,
+            'skew': job_skew_report(rollups),
+        },
+    }
+    if out_path is not None:
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, 'w') as f:
+            json.dump(out, f)
+    return out
+
+
+def step_rollup_from(records):
+    """step_rollup() over explicit records (a collected rank's
+    'ptSteps' list instead of the live flight recorder)."""
+    roll = report_from_records(records)['rollup']
+    return {'count': roll['count'],
+            'wall_p50_ms': roll['wall_p50_ms'],
+            'wall_p99_ms': roll['wall_p99_ms'],
+            'wall_max_ms': roll['wall_max_ms'],
+            'phases_ms': dict(roll['phases_ms'])}
 
 
 # FLAGS_trace=1 in the environment turns the flight recorder on at

@@ -37,19 +37,36 @@ def _in_shard_map():
         return False
 
 
-def _stat_collective(kind, x):
+def _stat_collective(kind, x, axis=None):
     """Trace-time collective accounting: each registered lowering runs
     ONCE per compile (the traced collective then runs every step), so
     these are bytes-moved-per-step estimates keyed at trace time —
     recording inside the traced graph would put a host call on the hot
     path.  Lazy import: ops must not pull the fluid package at import
-    time (fluid.executor imports ops.registry)."""
-    from ..fluid import monitor
+    time (fluid.executor imports ops.registry).
+
+    Besides the legacy collective/traced_* counters, each call files a
+    full comms record (payload bytes, dtype, mesh axis, participant
+    count, ring-algorithm bytes-on-wire) into the runner's ambient
+    fluid.comms.collecting() context, so the compiled segment owns its
+    collective profile and every dispatch can account real traffic."""
+    from ..fluid import comms, monitor
     size = int(getattr(x, 'size', 0) or 0)
     itemsize = getattr(getattr(x, 'dtype', None), 'itemsize', 4)
     monitor.add('collective/traced_calls')
     monitor.add('collective/traced_%s_calls' % kind)
     monitor.add('collective/traced_bytes', float(size * itemsize))
+    if axis is not None:
+        try:
+            # psum of a python int folds to the STATIC axis size at
+            # trace time — works inside shard_map, where the trace
+            # mesh is deliberately not published
+            participants = int(jax.lax.psum(1, axis))
+        except Exception:
+            participants = 1
+        comms.record_trace(kind, float(size * itemsize),
+                           dtype=getattr(x, 'dtype', None), axis=axis,
+                           participants=participants)
 
 
 def _maybe(axis_fn, x, axis, kind='allreduce'):
@@ -59,7 +76,7 @@ def _maybe(axis_fn, x, axis, kind='allreduce'):
         out = axis_fn(x, axis)
     except NameError:
         return x
-    _stat_collective(kind, x)
+    _stat_collective(kind, x, axis)
     return out
 
 
@@ -90,7 +107,7 @@ def c_allreduce_prod(ctx, ins, attrs):
         out = jnp.exp(jax.lax.psum(jnp.log(x), axis))
     except NameError:
         return {'Out': [x]}
-    _stat_collective('allreduce', x)
+    _stat_collective('allreduce', x, axis)
     return {'Out': [out]}
 
 
@@ -102,7 +119,7 @@ def c_allgather(ctx, ins, attrs):
         g = jax.lax.all_gather(x, axis)  # [nranks, ...]
     except NameError:
         return {'Out': [x]}
-    _stat_collective('allgather', x)
+    _stat_collective('allgather', x, axis)
     return {'Out': [g.reshape((-1,) + x.shape[1:])]}
 
 
@@ -115,7 +132,7 @@ def c_reducescatter(ctx, ins, attrs):
                                    tiled=True)
     except NameError:
         return {'Out': [x]}
-    _stat_collective('reducescatter', x)
+    _stat_collective('reducescatter', x, axis)
     return {'Out': [out]}
 
 
@@ -130,7 +147,7 @@ def c_broadcast(ctx, ins, attrs):
         out = jax.lax.psum(masked, axis)
     except NameError:
         return {'Out': [x]}
-    _stat_collective('broadcast', x)
+    _stat_collective('broadcast', x, axis)
     return {'Out': [out]}
 
 

@@ -34,6 +34,13 @@ from ..compat import shard_map as _shard_map
 from .registry import register
 
 
+def _nbytes(shape, itemsize=4):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return float(n * itemsize)
+
+
 def _token_axes(mesh, dims, prefer):
     """Build a PartitionSpec for an activation of shape `dims`:
     dim 0 (batch) over 'dp', dim 1 (time/tokens) over `prefer` axes —
@@ -102,6 +109,14 @@ def ring_attention_op(ctx, ins, attrs):
                 q.shape[0] % mesh.shape['dp'] == 0:
             spec[0] = 'dp'
         spec = P(*spec)
+        # comms telemetry (trace time): each ring step forwards this
+        # shard's K and V blocks to the neighbor, sp-1 rotations total
+        from ..fluid import comms
+        kv_itemsize = getattr(k.dtype, 'itemsize', 4)
+        hop = (_nbytes(k.shape, kv_itemsize) +
+               _nbytes(v.shape, kv_itemsize)) / sp
+        comms.record_trace('ppermute', hop, dtype=k.dtype, axis=axis,
+                           participants=sp, wire=(sp - 1) * hop)
         inner = ring_flash_attention_inner if use_flash \
             else ring_attention_inner
         if rate:
@@ -179,6 +194,17 @@ def moe_ffn_op(ctx, ins, attrs):
         for ax in token_axes:
             if ax != 'dp':
                 t_loc //= mesh.shape[ax]
+
+        # comms telemetry (trace time): dispatch + combine are two
+        # all_to_alls of the [E, C, D] expert buffer (einsum promotes
+        # tokens to f32), C = per-shard capacity
+        from ..fluid import comms
+        n_experts = int(w1.shape[0])
+        capacity = max(1, int(top_k * cf * (b_loc * t_loc) / n_experts))
+        a2a = _nbytes((n_experts, capacity, d), 4)
+        for _ in range(2):
+            comms.record_trace('all_to_all', a2a, dtype='float32',
+                               axis=axis, participants=ep)
 
         def inner(xl, wg_, w1_, w2_):
             out, aux = moe_ffn_inner(

@@ -1,0 +1,268 @@
+"""Job-wide observability gate: the cross-worker trace collection,
+collective telemetry and comms cost-model calibrator must work against
+REAL processes (the fluid.comms analog of check_health.py's live-
+endpoint checks).
+
+Four postures:
+
+  1. a real two-process collective job (tests/comms_worker.py x2, each
+     a GradAllReduce program on its own 8-device CPU mesh, rank 0
+     aggregating): trace.collect_job() must yield ONE schema-valid
+     merged Perfetto timeline with both ranks' spans on per-rank
+     process tracks and a shared clock, tolerating nothing worse than
+     per-event noise; the aggregator's /statusz must carry the per-
+     rank job view with a skew report; /trace/collect must serve the
+     merged doc over HTTP;
+  2. collective telemetry: both workers' /metrics.json must show
+     nonzero comms/bytes_on_wire and populated per-(collective,
+     size-bucket) bandwidth histograms, and the merged /metrics blob
+     must stay fluid.health lint-clean;
+  3. calibrator: tools/comms_calibrate.py --quick must emit a
+     well-formed comms_model.json whose predicted times stay within
+     2x of measured for every swept size;
+  4. disabled-path cost: with the tracer off, the steady-state
+     hot-path budgets of tools/check_hot_path.py must still hold.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu; the tool forces the
+8-device host platform itself).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_RATIO = float(os.environ.get('PADDLE_TPU_COMMS_MAX_RATIO', 2.0))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _wait_ready(proc, url, deadline):
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode('utf-8', 'replace') \
+                if proc.stdout else ''
+            raise RuntimeError('worker died rc=%d: %s'
+                               % (proc.returncode, out[-800:]))
+        try:
+            code, _ = _get(url + '/healthz/local', timeout=2)
+            if code == 200:
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise RuntimeError('worker at %s never became ready' % url)
+
+
+def check_merged_timeline(doc, failures):
+    events = doc.get('traceEvents')
+    if not isinstance(events, list) or not events:
+        failures.append('merged job timeline has no traceEvents')
+        return
+    rank_pids = {}
+    for e in events:
+        if not isinstance(e, dict):
+            failures.append('non-dict trace event in merged timeline')
+            return
+        if e.get('ph') == 'X':
+            for k in ('ts', 'dur', 'pid', 'name'):
+                if k not in e:
+                    failures.append('X event missing %r' % k)
+                    return
+            rank_pids.setdefault(e['pid'] // 100, set()).add(e['pid'])
+    if len(rank_pids) < 2:
+        failures.append('merged timeline has spans from %d rank '
+                        'bands, wanted 2' % len(rank_pids))
+    # shared clock: both ranks' span windows must overlap (the workers
+    # step concurrently; a broken re-home puts them eras apart)
+    spans_by_band = {}
+    for e in events:
+        if isinstance(e, dict) and e.get('ph') == 'X':
+            spans_by_band.setdefault(e['pid'] // 100, []).append(
+                (e['ts'], e['ts'] + e.get('dur', 0)))
+    bands = sorted(spans_by_band)
+    if len(bands) >= 2:
+        a = spans_by_band[bands[0]]
+        b = spans_by_band[bands[1]]
+        a0, a1 = min(t for t, _ in a), max(t for _, t in a)
+        b0, b1 = min(t for t, _ in b), max(t for _, t in b)
+        if a1 < b0 or b1 < a0:
+            failures.append(
+                'rank clocks do not overlap after re-home '
+                '(rank0 [%0.f, %.0f] vs rank1 [%.0f, %.0f] us)'
+                % (a0, a1, b0, b1))
+    ranks = {str(r.get('rank')) for r in doc.get('ptSteps', [])}
+    if len(ranks) < 2:
+        failures.append('merged ptSteps cover ranks %s, wanted 2'
+                        % sorted(ranks))
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    flags = os.environ.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    sys.path.insert(0, ROOT)
+    failures = []
+
+    # ---- 1+2: real two-process collective job --------------------------
+    worker = os.path.join(ROOT, 'tests', 'comms_worker.py')
+    p0, p1 = _free_port(), _free_port()
+    spec = '0=127.0.0.1:%d,1=127.0.0.1:%d' % (p0, p1)
+    base_env = dict(os.environ)
+    base_env.update({'PADDLE_TPU_STATUS_WORKERS': spec,
+                     'FLAGS_health_heartbeat_seconds': '0.5',
+                     'FLAGS_trace': '1'})
+    env0 = dict(base_env, PADDLE_TRAINER_ID='0',
+                PADDLE_TPU_STATUS_AGGREGATE='1')
+    env1 = dict(base_env, PADDLE_TRAINER_ID='1',
+                PADDLE_TPU_STATUS_AGGREGATE='0')
+    procs = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(p1), '120'], env=env1,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(p0), '120'], env=env0,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        deadline = time.time() + 240
+        agg = 'http://127.0.0.1:%d' % p0
+        wrk = 'http://127.0.0.1:%d' % p1
+        _wait_ready(procs[0], wrk, deadline)
+        _wait_ready(procs[1], agg, deadline)
+        time.sleep(2.0)     # a few steps + one heartbeat of scrapes
+
+        from paddle_tpu.fluid import trace as pt_trace
+        from paddle_tpu.fluid import health as pt_health
+        doc = pt_trace.collect_job(workers=spec)
+        if doc['ptJob']['skipped']:
+            failures.append('collect_job skipped healthy workers: %r'
+                            % doc['ptJob']['skipped'])
+        check_merged_timeline(doc, failures)
+
+        # collect over HTTP too: the aggregator's /trace/collect must
+        # serve the same merged document shape
+        code, body = _get(agg + '/trace/collect', timeout=30)
+        if code != 200:
+            failures.append('/trace/collect returned %d' % code)
+        else:
+            hdoc = json.loads(body)
+            if len(hdoc.get('ptJob', {}).get('workers', {})) < 2:
+                failures.append('/trace/collect merged %d workers, '
+                                'wanted 2' % len(
+                                    hdoc.get('ptJob', {})
+                                    .get('workers', {})))
+
+        # per-worker comms telemetry: nonzero bytes, bw histograms
+        for name, url in (('rank0', agg), ('rank1', wrk)):
+            code, body = _get(url + '/metrics.json')
+            state = json.loads(body)['state']
+            counters = state['counters']
+            if counters.get('comms/bytes_on_wire', 0.0) <= 0:
+                failures.append('%s comms/bytes_on_wire is zero'
+                                % name)
+            hists = [h for h in state['hists']
+                     if h.startswith('comms/bw_gbps/')]
+            if not any(state['hists'][h]['count'] > 0 for h in hists):
+                failures.append('%s has no populated comms/bw_gbps/* '
+                                'histogram' % name)
+
+        # merged /metrics stays lint-clean with the comms/* families
+        code, body = _get(agg + '/metrics')
+        problems = pt_health.prom_lint(body.decode('utf-8'))
+        if problems:
+            failures.append('merged /metrics lint: %s' % problems[:5])
+        if 'paddle_tpu_comms_bytes_on_wire' not in body.decode('utf-8'):
+            failures.append('merged /metrics missing comms series')
+
+        # aggregator /statusz: per-rank liveness + skew report
+        code, body = _get(agg + '/statusz')
+        job = json.loads(body).get('job')
+        if not job or len(job.get('workers', {})) < 2:
+            failures.append('/statusz job section missing or short: %r'
+                            % (job and sorted(job.get('workers', {}))))
+        else:
+            skew = job.get('skew')
+            if not skew or skew['wall']['skew_ratio'] < 1.0:
+                failures.append('/statusz job skew missing/invalid: %r'
+                                % (skew,))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+    # ---- 3: calibrator --------------------------------------------------
+    model_path = os.path.join(tempfile.mkdtemp(prefix='pt_comms_'),
+                              'comms_model.json')
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools',
+                                      'comms_calibrate.py'),
+         '--quick', '--out', model_path],
+        env=dict(os.environ), capture_output=True, text=True,
+        timeout=900)
+    if r.returncode != 0:
+        failures.append('comms_calibrate.py failed: %s'
+                        % r.stderr[-500:])
+    else:
+        try:
+            model = json.load(open(model_path))
+            colls = model['collectives']
+            assert model['devices'] >= 2 and colls
+            for kind, entry in colls.items():
+                assert entry['inv_bw_s_per_byte'] > 0
+                assert entry['latency_s'] >= 0
+                assert entry['points']
+                if entry['max_ratio'] > MAX_RATIO:
+                    failures.append(
+                        'calibrator %s predicted/measured ratio '
+                        '%.2fx exceeds %.1fx'
+                        % (kind, entry['max_ratio'], MAX_RATIO))
+        except Exception as e:
+            failures.append('comms_model.json malformed: %s' % e)
+
+    # ---- 4: disabled-path hot-loop budgets ------------------------------
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools',
+                                      'check_hot_path.py')],
+        env=dict(os.environ), capture_output=True, text=True,
+        timeout=600)
+    if r.returncode != 0:
+        failures.append('check_hot_path budgets broke with comms '
+                        'telemetry in the tree:\n%s'
+                        % (r.stdout + r.stderr)[-800:])
+
+    if failures:
+        print('check_comms: FAIL')
+        for f in failures:
+            print('  - %s' % f)
+        return 1
+    print('check_comms: merged 2-rank timeline OK, comms telemetry '
+          'nonzero + lint-clean, calibrator within %.1fx, hot-path '
+          'budgets hold' % MAX_RATIO)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

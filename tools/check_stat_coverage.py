@@ -136,6 +136,37 @@ REQUIRED = [
     ('paddle_tpu/fluid/serving.py', "_trace.step_tags"),
     ('paddle_tpu/fluid/trace.py', 'step_tags'),
     ('bench.py', 'serving_requests_per_sec'),
+    # job-wide observability (fluid/comms.py + trace.collect_job +
+    # the aggregator's skew detector): collective telemetry with
+    # bytes-on-wire and per-(collective, size-bucket) bandwidth,
+    # cross-worker trace collection tolerance counters, per-segment
+    # XLA memory gauges, and the straggler detector —
+    # tools/check_comms.py exercises the whole plane against a real
+    # two-process job
+    ('paddle_tpu/fluid/comms.py', 'comms/bytes_on_wire'),
+    ('paddle_tpu/fluid/comms.py', 'comms/payload_bytes'),
+    ('paddle_tpu/fluid/comms.py', 'comms/collective_calls'),
+    ('paddle_tpu/fluid/comms.py', 'comms/bw_gbps'),
+    ('paddle_tpu/fluid/comms.py', 'executor/segment_peak_bytes'),
+    ('paddle_tpu/fluid/comms.py', 'executor/segment_temp_bytes'),
+    ('paddle_tpu/ops/collective_ops.py', 'comms.record_trace'),
+    ('paddle_tpu/ops/parallel_ops.py', 'comms.record_trace'),
+    ('paddle_tpu/fluid/parallel_executor.py',
+     'comms.account_dispatch'),
+    ('paddle_tpu/fluid/parallel_executor.py', 'comms.collecting'),
+    ('paddle_tpu/fluid/executor.py', '_comms.record_memory'),
+    # a restarted (disk-hit) process must keep memory accounting
+    ('paddle_tpu/fluid/compile_cache.py', 'comms.record_memory'),
+    ('paddle_tpu/fluid/trace.py', 'trace/collect_skipped'),
+    ('paddle_tpu/fluid/trace.py', 'trace/collect_unanchored'),
+    ('paddle_tpu/fluid/trace.py', 'ptClock'),
+    ('paddle_tpu/fluid/health.py', 'comms/skew_ratio'),
+    ('paddle_tpu/fluid/health.py', 'comms/straggler_trips'),
+    ('paddle_tpu/fluid/health.py', 'step_rollup'),
+    ('paddle_tpu/distributed/launch.py', 'PADDLE_TPU_STATUS_WORKERS'),
+    ('tools/comms_calibrate.py', 'inv_bw_s_per_byte'),
+    ('tools/timeline.py', 'collect_job'),
+    ('bench.py', 'bytes_on_wire'),
 ]
 
 

@@ -1,0 +1,203 @@
+"""Collective cost-model calibrator: sweep collective payload sizes
+through the REAL collective runner and fit the measured times into
+``comms_model.json`` — per-collective, per-axis latency (alpha) +
+inverse bandwidth (beta), the T(b) = alpha + beta*b model the
+topology-aware collective synthesis (ROADMAP item 3, arXiv:2110.10548)
+and the EQuARX quantized-allreduce gate (arXiv:2506.17615) consume.
+
+Each swept point builds a one-collective program (a fed buffer and a
+single c_allreduce_sum / c_allgather / c_reducescatter / c_broadcast
+op), runs it through Executor.run's collective path (shard_map over
+the 'dp' mesh axis, exactly the path a GradAllReduce-transpiled
+trainer takes), and records the median steady-step wall time — so the
+model prices what training steps actually pay, host overheads
+included (alpha absorbs them).  The fluid.comms telemetry that
+accumulates during the sweep (comms/bytes_on_wire, achieved-bandwidth
+histograms) is written into the artifact alongside the fit, and every
+point carries its predicted/measured ratio: tools/check_comms.py
+gates max_ratio <= 2.0.
+
+Usage (8-device CPU mesh, the CI posture):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/comms_calibrate.py --out comms_model.json
+  --sizes_kib 16,64,256,1024   per-participant payloads to sweep
+  --steps 8                    timed steps per point (median)
+  --collectives allreduce,allgather,reducescatter,broadcast
+  --quick                      small sweep for CI gates
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _percentile(vals, q):
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def build_program(fluid, layers, kind, elems, ndev):
+    """One-collective program: feed 'x', run the collective, fetch the
+    result.  Shapes are chosen so each mesh shard holds `elems`
+    float32s (reducescatter needs its scatter dim divisible by the
+    axis size)."""
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main_p, startup):
+        if kind == 'reducescatter':
+            row = max(1, elems // ndev)
+            x = layers.data('x', shape=[row], dtype='float32')
+        else:
+            x = layers.data('x', shape=[elems], dtype='float32')
+    block = main_p.global_block()
+    op_type = {'allreduce': 'c_allreduce_sum',
+               'allgather': 'c_allgather',
+               'reducescatter': 'c_reducescatter',
+               'broadcast': 'c_broadcast'}[kind]
+    if kind in ('allreduce', 'broadcast'):
+        fetch = 'x'
+        block.append_op(op_type, inputs={'X': 'x'},
+                        outputs={'Out': 'x'},
+                        attrs={'ring_id': 0}, infer_shape=False)
+    else:
+        block.create_var(name='y', shape=x.shape, dtype='float32')
+        fetch = 'y'
+        block.append_op(op_type, inputs={'X': 'x'},
+                        outputs={'Out': 'y'},
+                        attrs={'ring_id': 0}, infer_shape=False)
+    main_p._collective_dp = True
+    if kind == 'reducescatter':
+        # per-shard [ndev, elems//ndev]: the scatter dim stays
+        # divisible by the axis size
+        feed_shape = (ndev * ndev, max(1, elems // ndev))
+    else:
+        feed_shape = (ndev, elems)
+    return main_p, fetch, feed_shape
+
+
+def sweep(kinds, sizes_kib, steps, warmup):
+    import numpy as np
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import comms, layers, monitor
+
+    ndev = len(jax.devices())
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    results = {}
+    for kind in kinds:
+        points = []
+        for kib in sizes_kib:
+            elems = max(ndev, (kib * 1024) // 4)
+            main_p, fetch, feed_shape = build_program(
+                fluid, layers, kind, elems, ndev)
+            rng = np.random.RandomState(1)
+            feed = {'x': rng.rand(*feed_shape).astype('float32')}
+            with fluid.scope_guard(fluid.Scope()):
+                for _ in range(max(1, warmup)):
+                    exe.run(main_p, feed=feed, fetch_list=[fetch])
+                walls = []
+                for _ in range(steps):
+                    t0 = time.perf_counter()
+                    exe.run(main_p, feed=feed, fetch_list=[fetch])
+                    walls.append(time.perf_counter() - t0)
+            payload = float(elems * 4)
+            wire = comms.wire_bytes(kind, payload, ndev)
+            # fit target is the MIN wall: the uncontended cost of the
+            # collective, the estimate a planner should price with —
+            # OS jitter only ever inflates a sample (p50/p90 ride
+            # along for the contended view)
+            best = min(walls)
+            points.append({
+                'payload_bytes': payload,
+                'wire_bytes': wire,
+                'measured_s': best,
+                'measured_p50_s': _percentile(walls, 0.5),
+                'measured_p90_s': _percentile(walls, 0.9),
+                'achieved_gbps': (wire / best / 1e9) if best > 0
+                                 else 0.0,
+            })
+            print('  %-13s %8d KiB/shard  min %8.3f ms  p50 %8.3f ms'
+                  '  %7.4f GB/s'
+                  % (kind, payload // 1024, best * 1e3,
+                     points[-1]['measured_p50_s'] * 1e3,
+                     points[-1]['achieved_gbps']), flush=True)
+        alpha, beta = comms.fit_linear(
+            [(p['wire_bytes'], p['measured_s']) for p in points])
+        for p in points:
+            pred = alpha + beta * p['wire_bytes']
+            p['predicted_s'] = pred
+            m = p['measured_s']
+            p['ratio'] = max(pred / m, m / pred) if m > 0 and pred > 0 \
+                else float('inf')
+        results[kind] = {
+            'axis': 'dp',
+            'participants': ndev,
+            'latency_s': alpha,
+            'inv_bw_s_per_byte': beta,
+            'bw_gbps': (1.0 / beta / 1e9) if beta > 0 else 0.0,
+            'max_ratio': max(p['ratio'] for p in points),
+            'points': points,
+        }
+    counters = {k: v for k, v in monitor.flat().items()
+                if k.startswith('comms/')}
+    return ndev, results, counters
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--out', default='comms_model.json')
+    ap.add_argument('--sizes_kib', default=None,
+                    help='comma list of per-participant payload KiB')
+    ap.add_argument('--steps', type=int, default=8)
+    ap.add_argument('--warmup', type=int, default=2)
+    ap.add_argument('--collectives',
+                    default='allreduce,allgather,reducescatter,'
+                            'broadcast')
+    ap.add_argument('--quick', action='store_true',
+                    help='small sweep (CI gate posture)')
+    args = ap.parse_args(argv)
+    sys.path.insert(0, ROOT)
+
+    if args.sizes_kib:
+        sizes = [int(s) for s in args.sizes_kib.split(',') if s]
+    elif args.quick:
+        sizes = [16, 256, 1024]
+    else:
+        sizes = [16, 64, 256, 1024, 4096]
+    kinds = [k.strip() for k in args.collectives.split(',') if k.strip()]
+    steps = max(3, args.steps // 2) if args.quick else args.steps
+
+    import jax
+    ndev, results, counters = sweep(kinds, sizes, steps, args.warmup)
+    model = {
+        'version': 1,
+        'backend': jax.default_backend(),
+        'devices': ndev,
+        'mesh_axes': {'dp': ndev},
+        'collectives': results,
+        'comms_counters': counters,
+        'meta': {
+            'created_unix': time.time(),
+            'steps_per_point': steps,
+            'sizes_kib': sizes,
+            'jax': jax.__version__,
+            'cmd': 'python tools/comms_calibrate.py',
+        },
+    }
+    d = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(d, exist_ok=True)
+    with open(args.out, 'w') as f:
+        json.dump(model, f, indent=1, sort_keys=True)
+    worst = max(r['max_ratio'] for r in results.values())
+    print('comms model written to %s (%d collectives x %d sizes, '
+          'worst predicted/measured ratio %.2fx)'
+          % (args.out, len(results), len(sizes), worst))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -9,6 +9,10 @@ Usage:
   python tools/stat_summary.py --steps dump.json    # per-step phase
                                                     # report from a
                                                     # trace.dump() file
+  python tools/stat_summary.py --steps job.json --rank 1
+                                  # one rank's steps out of a merged
+                                  # job dump (trace.collect_job /
+                                  # tools/timeline.py --job output)
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
@@ -81,8 +85,10 @@ def diff(before, after, out=None):
                      _fmt(ga.get(n, 0.0))))
 
 
-def steps_report(path, out=None):
-    """Per-step phase table from a fluid.trace.dump() file."""
+def steps_report(path, out=None, rank=None):
+    """Per-step phase table from a fluid.trace.dump() file; `rank`
+    filters a merged job dump (trace.collect_job tags each record with
+    its worker rank) down to one worker's steps."""
     # resolve stdout at CALL time: the module may be imported while a
     # test harness has stdout captured, and a def-time default would
     # pin that (soon-closed) stream
@@ -92,6 +98,16 @@ def steps_report(path, out=None):
     from paddle_tpu.fluid import trace as pt_trace
     with open(path) as f:
         recs = json.load(f).get('ptSteps', [])
+    if rank is not None:
+        ranks = sorted({str(r.get('rank')) for r in recs
+                        if r.get('rank') is not None})
+        recs = [r for r in recs if str(r.get('rank')) == str(rank)]
+        if not recs:
+            out.write('no step records for rank %s in %s (ranks '
+                      'present: %s)\n'
+                      % (rank, path, ', '.join(ranks) or 'none'))
+            return 1
+        out.write('rank %s:\n' % rank)
     if not recs:
         out.write('no step records in %s (was the tracer enabled?)\n'
                   % path)
@@ -104,10 +120,18 @@ def steps_report(path, out=None):
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == '--steps':
+        rank = None
+        if '--rank' in argv:
+            i = argv.index('--rank')
+            if i + 1 >= len(argv):
+                sys.stderr.write(__doc__)
+                return 2
+            rank = argv[i + 1]
+            del argv[i:i + 2]
         if len(argv) != 2:
             sys.stderr.write(__doc__)
             return 2
-        return steps_report(argv[1])
+        return steps_report(argv[1], rank=rank)
     if argv == ['--live']:
         sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))

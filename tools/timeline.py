@@ -15,6 +15,19 @@ aligned on the pt_clock_sync annotation the capture emitted.
 
 Usage: python tools/timeline.py --profile_path /tmp/profile \
            --timeline_path /tmp/timeline.json [--host_trace host.json]
+
+Job mode (`--job`) merges a whole MULTI-WORKER job instead: it pulls
+every worker's /trace/dump over HTTP (--workers 'rank=host:port,...',
+default $PADDLE_TPU_STATUS_WORKERS — the launcher's wire format) or
+reads already-saved dump files (--dumps a.json b.json ...), re-homes
+each rank's clock onto the shared unix-epoch anchor its dump carries,
+and writes ONE Perfetto timeline with per-rank process tracks plus the
+cross-rank skew report (fluid.trace.collect_job).
+
+Usage: python tools/timeline.py --job --workers 0=h:9184,1=h:9185 \
+           --timeline_path /tmp/job_timeline.json
+       python tools/timeline.py --job --dumps w0.json w1.json \
+           --timeline_path /tmp/job_timeline.json
 """
 
 import argparse
@@ -69,6 +82,50 @@ def merge(src, host_path, out_path):
     return n_host
 
 
+def collect_job_cli(args):
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from paddle_tpu.fluid import trace as pt_trace
+    if args.dumps:
+        workers = [(str(i), p) for i, p in enumerate(args.dumps)]
+
+        def fetch(path):
+            with open(path) as f:
+                return f.read()
+    else:
+        spec = args.workers or os.environ.get(
+            'PADDLE_TPU_STATUS_WORKERS', '')
+        if not spec:
+            raise SystemExit(
+                '--job needs --workers rank=host:port,... (or '
+                'PADDLE_TPU_STATUS_WORKERS) or --dumps file.json ...')
+        workers = spec
+        fetch = None
+    doc = pt_trace.collect_job(workers=workers, fetch=fetch,
+                               out_path=args.timeline_path)
+    job = doc.get('ptJob', {})
+    n = sum(1 for e in doc['traceEvents'] if e.get('ph') == 'X')
+    print('merged job timeline written to %s (%d ranks, %d span '
+          'events; open in https://ui.perfetto.dev)'
+          % (args.timeline_path, len(job.get('workers', {})), n))
+    for rank, err in sorted(job.get('skipped', {}).items()):
+        print('  SKIPPED rank %s: %s' % (rank, err))
+    skew = job.get('skew')
+    if skew:
+        wall = skew['wall']
+        print('  skew: slowest rank %s at p50 %.3f ms, %.2fx the '
+              'cross-rank median (%.3f ms)'
+              % (wall['slowest_rank'], wall['max_p50_ms'],
+                 wall['skew_ratio'], wall['median_p50_ms']))
+        worst = sorted(skew['phases'].items(),
+                       key=lambda kv: -kv[1]['ratio'])[:3]
+        for name, ph in worst:
+            print('    phase %-14s rank %s %.3f ms/step '
+                  '(%.2fx median)' % (name, ph['slowest_rank'],
+                                      ph['max_ms'], ph['ratio']))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--profile_path', default='/tmp/profile')
@@ -77,7 +134,20 @@ def main():
                     help='host_trace.json written by fluid.profiler.'
                          'stop_trace (default: auto-discover under '
                          'profile_path)')
+    ap.add_argument('--job', action='store_true',
+                    help='merge a multi-worker job from /trace/dump '
+                         'scrapes (--workers) or saved dump files '
+                         '(--dumps) into one per-rank timeline')
+    ap.add_argument('--workers', default=None,
+                    help="job worker spec 'rank=host:port,...' "
+                         '(default: $PADDLE_TPU_STATUS_WORKERS)')
+    ap.add_argument('--dumps', nargs='*', default=None,
+                    help='merge saved /trace/dump files instead of '
+                         'scraping (each dump\'s own ptRank labels '
+                         'it; argument order is the fallback)')
     args = ap.parse_args()
+    if args.job:
+        return collect_job_cli(args)
     src = find_trace(args.profile_path)
     host_path = args.host_trace or find_host_trace(args.profile_path)
     if host_path:
