@@ -1061,9 +1061,120 @@ def bench_parallel(batch=256, width=256, steps=30, warmup=5,
             monitor.counter_value('comms/payload_bytes'),
         'bandwidth': bw,
     }
+    rec['plan_ab'] = _plan_ab_fields(batch=batch, width=width)
     rec.update(_skew_job_fields(skew_seconds))
     rec.update(_monitor_fields())
     return rec
+
+
+def _plan_ab_fields(batch=256, width=256, rounds=6, per_round=4,
+                    warmup=3):
+    """Per-arm collective-planner A/B (interleaved): the same
+    GradAllReduce MLP transpiled three ways — v1.6 dense flat
+    (planner off), planned fused dense, planned quantized — each with
+    its own program + scope + executable (the planner digest keys the
+    fingerprints apart), timed in interleaved bursts so OS noise hits
+    every arm equally.  Reports steps/sec, bytes-on-wire per step and
+    the quantized arm's wire reduction vs dense, plus final losses so
+    the parity claim rides in the artifact."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, monitor
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+    # every arm pins the model path to a guaranteed-empty file so an
+    # ambient ./comms_model.json (README's calibrate-then-bench order)
+    # cannot flip the dense arms onto rs_ag and mislabel the A/B
+    no_model = {'FLAGS_comms_model_path': os.devnull}
+    arms = (
+        ('dense_flat', dict(no_model, **{'FLAGS_comms_plan': False,
+                                         'FLAGS_comms_quantize':
+                                             False})),
+        ('fused_dense', dict(no_model, **{'FLAGS_comms_plan': True,
+                                          'FLAGS_comms_quantize':
+                                              False})),
+        ('quant', dict(no_model, **{'FLAGS_comms_plan': True,
+                                    'FLAGS_comms_quantize': True,
+                                    'FLAGS_comms_quantize_min_bytes':
+                                        4096})),
+    )
+    keys = sorted({k for _, fl in arms for k in fl} |
+                  {'FLAGS_comms_quantize_min_bytes'})
+    prev = fluid.get_flags(keys)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.rand(batch, width).astype('float32'),
+            'y': rng.rand(batch, 1).astype('float32')}
+    setups = {}
+    out = {}
+    try:
+        for name, fl in arms:
+            fluid.set_flags(fl)
+            main_p, startup = fluid.Program(), fluid.Program()
+            main_p.random_seed = startup.random_seed = 7
+            with fluid.program_guard(main_p, startup):
+                x = layers.data('x', shape=[width], dtype='float32')
+                y = layers.data('y', shape=[1], dtype='float32')
+                h = layers.fc(x, width, act='relu')
+                h = layers.fc(h, width, act='relu')
+                # bounded regression objective: losses stay finite so
+                # the per-arm parity rides in the artifact
+                loss = layers.reduce_mean(layers.square_error_cost(
+                    layers.fc(h, 1), y))
+                fluid.optimizer.SGD(0.01).minimize(loss)
+            GradAllReduce().transpile(startup, main_p, 0,
+                                      ['127.0.0.1:0'], '127.0.0.1:0')
+            scope = fluid.Scope()
+            # one Executor PER ARM: parameter init folds the
+            # executor's step counter into its RNG, so a shared
+            # executor would hand each arm a different init and break
+            # the cross-arm loss comparison
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(warmup):
+                    exe.run(main_p, feed=feed, fetch_list=[loss])
+            setups[name] = {'flags': fl, 'program': main_p,
+                            'loss': loss, 'scope': scope, 'exe': exe,
+                            'walls': [], 'wire': 0.0, 'steps': 0,
+                            'final_loss': None}
+        for _ in range(rounds):
+            for name, _fl in arms:
+                s = setups[name]
+                fluid.set_flags(s['flags'])
+                with fluid.scope_guard(s['scope']):
+                    w0 = monitor.counter_value('comms/bytes_on_wire')
+                    t0 = time.perf_counter()
+                    for _ in range(per_round):
+                        lv, = s['exe'].run(s['program'], feed=feed,
+                                           fetch_list=[s['loss']])
+                    s['walls'].append(time.perf_counter() - t0)
+                    s['wire'] += monitor.counter_value(
+                        'comms/bytes_on_wire') - w0
+                    s['steps'] += per_round
+                    s['final_loss'] = float(np.asarray(lv))
+        for name, s in setups.items():
+            best = min(s['walls']) / per_round
+            out[name] = {
+                'steps_per_sec': round(per_round / min(s['walls']), 2),
+                'best_step_ms': round(best * 1e3, 3),
+                'bytes_on_wire_per_step':
+                    round(s['wire'] / max(1, s['steps']), 1),
+                'final_loss': s['final_loss'],
+            }
+        dense = out.get('fused_dense', {})
+        quant = out.get('quant', {})
+        flat = out.get('dense_flat', {})
+        if dense.get('bytes_on_wire_per_step') and \
+                quant.get('bytes_on_wire_per_step'):
+            out['quant_wire_reduction_x'] = round(
+                dense['bytes_on_wire_per_step'] /
+                quant['bytes_on_wire_per_step'], 2)
+        if flat.get('best_step_ms') and dense.get('best_step_ms'):
+            out['fused_vs_flat_step_delta_pct'] = round(
+                100.0 * (dense['best_step_ms'] - flat['best_step_ms'])
+                / flat['best_step_ms'], 1)
+    finally:
+        fluid.set_flags(prev)
+    return out
 
 
 def _skew_job_fields(run_for):

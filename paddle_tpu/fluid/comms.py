@@ -57,7 +57,7 @@ __all__ = [
     'collecting', 'record_trace', 'records_for', 'wire_bytes',
     'size_bucket', 'account_dispatch', 'bw_samples', 'record_memory',
     'memory_report', 'fit_linear', 'model_predict', 'reset',
-    'BW_BUCKETS', 'MEM_BUCKETS',
+    'BW_BUCKETS', 'MEM_BUCKETS', 'RATIO_BUCKETS',
 ]
 
 # achieved algorithmic bandwidth, GB/s: CPU-mesh psums sit well under
@@ -66,6 +66,10 @@ BW_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0,
               25.0, 50.0, 100.0, 200.0, 500.0)
 # per-segment memory footprints, bytes (KB..tens of GB of HBM)
 MEM_BUCKETS = (1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 4e9, 16e9, 64e9)
+# predicted/measured wall ratio for the planner's honesty histogram:
+# 1.0 = the cost model nailed it; < 1 when compute shares the wall
+RATIO_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0,
+                 4.0, 10.0)
 
 # size-bucket edges for the per-(collective, size) bandwidth
 # histograms: powers of 16 from 4KiB keep the label set small while
@@ -172,12 +176,18 @@ def collecting(key):
 
 
 def record_trace(kind, payload_bytes, dtype=None, axis=None,
-                 participants=1, wire=None):
+                 participants=1, wire=None, arm=None, predicted_s=None,
+                 dense_wire=None, fused=0):
     """Called from a collective lowering AT TRACE TIME: append one
     record to the ambient collecting() context (no-op without one —
     e.g. eager/test execution outside the runners).  `wire` overrides
     the ring-formula estimate for lowerings that know their exact
-    traffic (ppermute rotations)."""
+    traffic (ppermute rotations, the quantized arm's int8+scales).
+    Planner-chosen collectives (fluid.comms_plan) additionally carry
+    their `arm` ('dense'|'rs_ag'|'quant'), the planner's
+    `predicted_s`, the `dense_wire` bytes a flat dense allreduce would
+    have moved (so the saving is a counter, not a claim), and `fused`
+    = how many grads the record's bucket coalesced."""
     sink = getattr(_tls, 'sink', None)
     if sink is None:
         return None
@@ -192,6 +202,14 @@ def record_trace(kind, payload_bytes, dtype=None, axis=None,
         'participants': int(participants),
         'bucket': size_bucket(float(payload_bytes)),
     }
+    if arm is not None:
+        rec['arm'] = str(arm)
+        rec['dense_wire_bytes'] = float(
+            dense_wire if dense_wire is not None else rec['wire_bytes'])
+        if predicted_s is not None:
+            rec['predicted_s'] = float(predicted_s)
+        if fused:
+            rec['fused'] = int(fused)
     sink.append(rec)
     return rec
 
@@ -258,17 +276,55 @@ def account_dispatch(records, wall_s, compile_run=False):
     total_wire = payload = 0.0
     kinds = {}
     series_wire = {}
+    plan_arms = {}
+    plan_wire = plan_dense = plan_pred = 0.0
+    plan_fused = plan_unpriced = 0
     for r in records:
         total_wire += r['wire_bytes']
         payload += r['payload_bytes']
         kinds[r['kind']] = kinds.get(r['kind'], 0) + 1
         key = (r['kind'], r['bucket'])
         series_wire[key] = series_wire.get(key, 0.0) + r['wire_bytes']
+        arm = r.get('arm')
+        if arm is not None:
+            plan_arms[arm] = plan_arms.get(arm, 0) + 1
+            plan_wire += r['wire_bytes']
+            plan_dense += r.get('dense_wire_bytes', r['wire_bytes'])
+            pred = r.get('predicted_s')
+            if pred is None:
+                plan_unpriced += 1
+            else:
+                plan_pred += pred
+            plan_fused += r.get('fused', 0)
     monitor.add('comms/payload_bytes', payload)
     monitor.add('comms/collective_calls', float(len(records)))
     for kind, n in kinds.items():
         monitor.add('comms/%s_calls' % kind, float(n))
     monitor.add('comms/bytes_on_wire', total_wire)
+    if plan_arms:
+        # planner observability: which arm ran, the wire bytes it moved
+        # vs what flat dense would have moved, and predicted-vs-measured
+        # wall so the cost model's honesty is a scrape away.  Measured
+        # is the SEGMENT wall — exact for the calibrator's one-
+        # collective programs, an upper bound when compute shares the
+        # segment (the ratio then under-reports the model, never
+        # over-reports it).
+        for arm, n in plan_arms.items():
+            monitor.add('comms/plan_arm/%s' % arm, float(n))
+        monitor.add('comms/plan_wire_bytes', plan_wire)
+        monitor.add('comms/plan_dense_equiv_bytes', plan_dense)
+        if plan_fused:
+            monitor.add('comms/plan_fused_grads', float(plan_fused))
+        if plan_unpriced:
+            # partial model: some arms in this segment had no entry —
+            # comparing a partial prediction against the FULL wall
+            # would bias the honesty ratio low, so count instead
+            monitor.add('comms/plan_unpriced', float(plan_unpriced))
+        elif plan_pred > 0 and not compile_run and wall_s > 0:
+            monitor.add('comms/plan_predicted_seconds', plan_pred)
+            monitor.add('comms/plan_measured_seconds', wall_s)
+            monitor.observe('comms/plan_pred_over_measured',
+                            plan_pred / wall_s, RATIO_BUCKETS)
     if compile_run or wall_s <= 0 or total_wire <= 0:
         return
     for (kind, bucket), wire in series_wire.items():

@@ -113,6 +113,54 @@ _DEFAULTS = {
     # default (it only costs while nan-checking, itself a debug mode);
     # turn off to nan-check huge models without the state copies.
     'FLAGS_nan_replay': True,
+    # collective planner (fluid/comms_plan.py): with the flag on, the
+    # GradAllReduce transpiler consults the planner per gradient —
+    # same-dtype small grads coalesce into fused buckets
+    # (c_allreduce_fused), each bucket's reduction arm (dense flat vs
+    # reduce-scatter+allgather vs block-scaled int8 quantized) is
+    # chosen from the calibrated comms cost model (comms_model.json,
+    # falling back to a built-in heuristic), and every dispatch
+    # reports its arm + predicted-vs-measured wall through fluid.comms
+    # (comms/plan_arm/*).  Off restores the v1.6 one-flat-allreduce-
+    # per-grad rewrite bit for bit.
+    'FLAGS_comms_plan': True,
+    # quantized-allreduce arm (EQuARX-style, arXiv:2506.17615):
+    # quantize -> int8 reduce-scatter with per-block fp32 scales ->
+    # dequantize/reduce -> requantize -> int8 allgather.  OFF by
+    # default (it changes numerics ~1e-2 relative on the reduced
+    # grads); per-tensor gated by FLAGS_comms_quantize_min_bytes so
+    # latency-bound small tensors keep the dense path even when on.
+    'FLAGS_comms_quantize': False,
+    # per-tensor (or per fused bucket) payload floor for the quantized
+    # arm: below this the dense path runs — bit-exact fallback
+    'FLAGS_comms_quantize_min_bytes': 65536,
+    # block length for the per-block fp32 scales of the quantized arm
+    # (scale overhead = 4/block/itemsize of the payload)
+    'FLAGS_comms_quant_block': 256,
+    # grad-bucket fusion byte target: consecutive same-dtype grads
+    # coalesce into fused buckets up to this many bytes so the
+    # per-collective latency term is paid once per bucket; 0 disables
+    # fusion (every grad reduces alone)
+    'FLAGS_comms_bucket_bytes': 4 << 20,
+    # per-grad fusion eligibility floor when NO cost model is loaded:
+    # grads at/above this many bytes are bandwidth-bound and reduce
+    # alone (fusing them buys no latency but pays concat/split
+    # copies).  With comms_model.json loaded the cutoff is the
+    # model's own latency/bandwidth crossover alpha/beta instead.
+    'FLAGS_comms_fuse_grad_max_bytes': 64 << 10,
+    # calibrated cost model path (tools/comms_calibrate.py artifact);
+    # empty = ./comms_model.json when present, else the built-in
+    # heuristic (flat below FLAGS_comms_rs_ag_min_bytes, rs+ag above)
+    'FLAGS_comms_model_path': '',
+    # heuristic dense-strategy cut when no cost model is loaded:
+    # payloads at/above this use reduce-scatter+allgather
+    'FLAGS_comms_rs_ag_min_bytes': 8 << 20,
+    # per-segment HBM budget the planner must respect (bytes; 0 = no
+    # budget): bucket fusion caps its fused-buffer size to the
+    # headroom left over executor/segment_peak_bytes, and the
+    # quantized arm (which needs ~2.25x the payload in temporaries)
+    # falls back dense when the headroom is tighter than that
+    'FLAGS_comms_hbm_budget_bytes': 0,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

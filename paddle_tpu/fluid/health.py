@@ -197,6 +197,19 @@ def statusz():
             }
     except Exception:
         pass
+    # collective planner (fluid.comms_plan): the active plan per
+    # transpiled program — buckets, chosen arms, dense-equivalent vs
+    # actual wire bytes, predicted-vs-measured wall — so 'which
+    # reduction ran and was the model honest' is one scrape
+    comms_plan_section = None
+    try:
+        from . import comms_plan
+        rep = comms_plan.program_plans()
+        if rep.get('programs') or any(
+                v for v in rep.get('arm_counters', {}).values()):
+            comms_plan_section = rep
+    except Exception:
+        pass
     # aggregator rank: per-rank liveness + last-heartbeat skew, so one
     # /statusz answers 'is the job healthy and who is the straggler'
     job_section = None
@@ -212,6 +225,7 @@ def statusz():
         'caches': caches,
         'serving': serving_section,
         'memory': memory_section,
+        'comms_plan': comms_plan_section,
         'job': job_section,
         'flags': _all_flags(),
         'versions': versions,

@@ -375,9 +375,14 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         # reuses the existing traced jit instead of re-tracing, and
         # with FLAGS_compile_cache_dir the underlying XLA compile
         # dedupes across processes via jax's persistent cache
+        # the planner digest makes collective-planning decisions part
+        # of the segment fingerprint: a flag/model change retraces
+        # exactly once, an unchanged plan never retraces
+        from . import comms_plan
         fp = compile_cache.fingerprint(
             seg.ops,
-            (_mesh_fingerprint_key(mesh), repr(in_shardings)),
+            (_mesh_fingerprint_key(mesh), repr(in_shardings),
+             comms_plan.digest()),
             _lowering_flag_items(False, False),
             donate=True, purpose='parallel')
         compiled = compile_cache.plane().shared_jit(
@@ -521,10 +526,14 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
             out_specs = {n: P() for n in seg.output_names}
             # shared through the compile plane, same contract as the
             # data-parallel runner above
+            # planner decisions resolve at trace time against this
+            # mesh; folding the digest in keys the executable (and its
+            # comms records) by the plan that produced it
+            from . import comms_plan
             fp = compile_cache.fingerprint(
                 seg.ops,
                 (_mesh_fingerprint_key(mesh), repr(in_specs),
-                 repr(out_specs)),
+                 repr(out_specs), comms_plan.digest()),
                 _lowering_flag_items(False, False),
                 donate=True, purpose='collective')
 

@@ -10,28 +10,35 @@ import numpy as np
 from .. import monitor
 
 
-def _count_inserted_collectives(block, names, kind):
-    """Monitor accounting for a collective rewrite: ops inserted and
-    the per-step payload they move (static estimate from the declared
-    var shapes; -1 dims count as 1, so it is a lower bound for batch-
-    shaped vars — param/grad syncs, the common case, are exact)."""
-    monitor.add('collective/%s_ops_inserted' % kind, float(len(names)))
-    total = 0.0
-    for n in names:
-        v = block._find_var_recursive(n)
-        shape = tuple(getattr(v, 'shape', ()) or ()) if v is not None \
-            else ()
-        if not shape:
-            continue
-        elems = 1
-        for d in shape:
-            elems *= max(int(d), 1)
-        try:
-            itemsize = np.dtype(v.dtype).itemsize
-        except Exception:
-            itemsize = 4
-        total += float(elems * itemsize)
-    monitor.add('collective/%s_bytes_per_step' % kind, total)
+def _var_nbytes(block, name):
+    """Static (nbytes, dtype_name) estimate for a block var from its
+    declared shape; -1 dims count as 1, so it is a lower bound for
+    batch-shaped vars — param/grad syncs, the common case, are exact.
+    Unknown shapes report 0 bytes."""
+    v = block._find_var_recursive(name)
+    shape = tuple(getattr(v, 'shape', ()) or ()) if v is not None \
+        else ()
+    try:
+        dt = np.dtype(v.dtype)
+    except Exception:
+        dt = np.dtype('float32')
+    if not shape:
+        return 0, dt.name
+    elems = 1
+    for d in shape:
+        elems *= max(int(d), 1)
+    return elems * dt.itemsize, dt.name
+
+
+def _count_inserted_collectives(block, names, kind, n_ops=None):
+    """Monitor accounting for a collective rewrite: collective ops
+    actually inserted (bucket fusion makes this fewer than the synced
+    vars) and the per-step payload those vars move (static
+    _var_nbytes estimate)."""
+    monitor.add('collective/%s_ops_inserted' % kind,
+                float(len(names) if n_ops is None else n_ops))
+    monitor.add('collective/%s_bytes_per_step' % kind,
+                float(sum(_var_nbytes(block, n)[0] for n in names)))
 
 
 class Collective(object):
@@ -61,9 +68,22 @@ class Collective(object):
 
 class GradAllReduce(Collective):
     """Reference collective.py:178: insert c_allreduce_sum + scale after
-    backward on every param gradient."""
+    backward on every param gradient.
+
+    With FLAGS_comms_plan (the default) the rewrite consults the
+    collective planner (fluid.comms_plan) instead of emitting the v1.6
+    one-flat-allreduce-per-grad shape: consecutive same-dtype grads
+    coalesce into fused buckets (c_allreduce_fused — the latency term
+    is paid once per bucket), and each bucket's reduction arm (dense
+    flat vs reduce-scatter+allgather vs block-scaled int8 quantized)
+    is chosen per mesh at trace time from the calibrated cost model.
+    The planned rewrite computes the SAME elementwise sum; only the
+    quantized arm (off by default) changes numerics.  FLAGS_comms_plan
+    off restores the reference rewrite bit for bit."""
 
     def _transpile_main_program(self):
+        from .. import comms_plan
+        from ..flags import get_flag
         block = self.main_program.global_block()
         grad_names = []
         for op in block.ops:
@@ -77,15 +97,67 @@ class GradAllReduce(Collective):
         if insert_at is None:
             insert_at = len(block.ops)
         uniq = list(dict.fromkeys(grad_names))
-        for g in uniq:
-            block._insert_op(insert_at, 'c_allreduce_sum',
-                             inputs={'X': g}, outputs={'Out': g},
-                             attrs={'ring_id': 0})
-            block._insert_op(insert_at + 1, 'scale',
-                             inputs={'X': g}, outputs={'Out': g},
-                             attrs={'scale': 1.0 / self.nranks})
-            insert_at += 2
-        _count_inserted_collectives(block, uniq, 'allreduce')
+        if not get_flag('FLAGS_comms_plan', True):
+            for g in uniq:
+                block._insert_op(insert_at, 'c_allreduce_sum',
+                                 inputs={'X': g}, outputs={'Out': g},
+                                 attrs={'ring_id': 0})
+                block._insert_op(insert_at + 1, 'scale',
+                                 inputs={'X': g}, outputs={'Out': g},
+                                 attrs={'scale': 1.0 / self.nranks})
+                insert_at += 2
+            _count_inserted_collectives(block, uniq, 'allreduce')
+            return
+
+        # planner path: bucket the grads, insert one planned collective
+        # per bucket (the arm itself resolves at trace time, when the
+        # actual mesh axis size is known), then the reference's
+        # 1/nranks scale per grad
+        grads = [(g,) + _var_nbytes(block, g) for g in uniq]
+        buckets = comms_plan.bucket_grads(grads)
+        summary = {'nranks': self.nranks, 'grads': len(uniq),
+                   'buckets': []}
+        for b in buckets:
+            names = b['names']
+            if len(names) == 1:
+                block._insert_op(insert_at, 'c_allreduce_sum',
+                                 inputs={'X': names[0]},
+                                 outputs={'Out': names[0]},
+                                 attrs={'ring_id': 0, 'plan': True})
+            else:
+                block._insert_op(insert_at, 'c_allreduce_fused',
+                                 inputs={'X': list(names)},
+                                 outputs={'Out': list(names)},
+                                 attrs={'ring_id': 0, 'plan': True})
+            insert_at += 1
+            for g in names:
+                block._insert_op(insert_at, 'scale',
+                                 inputs={'X': g}, outputs={'Out': g},
+                                 attrs={'scale': 1.0 / self.nranks})
+                insert_at += 1
+            # transpile-time PREVIEW for /statusz — named arm_preview
+            # because the binding decision re-runs at trace time
+            # against the actual mesh axis size (self.nranks is the
+            # endpoint/device estimate); the comms/plan_arm/* counters
+            # report what actually ran
+            try:
+                itemsize = np.dtype(b['dtype']).itemsize
+            except Exception:
+                itemsize = 4
+            decision = comms_plan.decide(b['bytes'], itemsize,
+                                         self.nranks)
+            summary['buckets'].append({
+                'grads': len(names), 'bytes': b['bytes'],
+                'dtype': b['dtype'], 'arm_preview': decision['arm'],
+                'strategy_preview': decision['strategy'],
+                'names': names[:8]})
+            monitor.add('collective/plan_buckets')
+            if len(names) > 1:
+                monitor.add('collective/plan_fused_grads',
+                            float(len(names)))
+        comms_plan.record_program_plan(summary)
+        _count_inserted_collectives(block, uniq, 'allreduce',
+                                    n_ops=len(buckets))
 
 
 class LocalSGD(Collective):

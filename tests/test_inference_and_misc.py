@@ -165,9 +165,17 @@ def test_grad_allreduce_transpiler_rewrite():
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
         fluid.optimizer.SGD(0.1).minimize(loss)
     n_before = len(main.global_block().ops)
-    GradAllReduce().transpile(startup, main, rank=0,
-                              endpoints=['a', 'b'],
-                              current_endpoint='a')
+    # reference (v1.6) rewrite shape with the collective planner off:
+    # one flat c_allreduce_sum + scale per grad (the planned default
+    # coalesces grads into fused buckets — tests/test_comms_plan.py)
+    prev = fluid.get_flags(['FLAGS_comms_plan'])
+    fluid.set_flags({'FLAGS_comms_plan': False})
+    try:
+        GradAllReduce().transpile(startup, main, rank=0,
+                                  endpoints=['a', 'b'],
+                                  current_endpoint='a')
+    finally:
+        fluid.set_flags(prev)
     ops = [op.type for op in main.global_block().ops]
     assert ops.count('c_allreduce_sum') == 2  # w and b grads
     assert len(ops) == n_before + 4

@@ -270,8 +270,16 @@ def test_collective_transpile_counters():
         loss = layers.reduce_mean(y)
         fluid.optimizer.SGD(0.1).minimize(loss)
     monitor.reset()
-    GradAllReduce().transpile(startup, main, 0, ['127.0.0.1:6170'],
-                              '127.0.0.1:6170')
+    # reference (v1.6) rewrite counters: one c_allreduce_sum per grad
+    # (the planned default fuses the two small grads into ONE bucket
+    # op and reports ops_inserted accordingly — test_comms_plan.py)
+    prev = fluid.get_flags(['FLAGS_comms_plan'])
+    fluid.set_flags({'FLAGS_comms_plan': False})
+    try:
+        GradAllReduce().transpile(startup, main, 0, ['127.0.0.1:6170'],
+                                  '127.0.0.1:6170')
+    finally:
+        fluid.set_flags(prev)
     snap = monitor.snapshot()['collective']
     assert snap['transpile_calls'] == 1.0
     # fc weight + bias gradients each get one inserted c_allreduce_sum

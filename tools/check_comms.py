@@ -16,10 +16,17 @@ Four postures:
   2. collective telemetry: both workers' /metrics.json must show
      nonzero comms/bytes_on_wire and populated per-(collective,
      size-bucket) bandwidth histograms, and the merged /metrics blob
-     must stay fluid.health lint-clean;
+     must stay fluid.health lint-clean; the workers run with the
+     collective planner's quantized arm enabled (FLAGS_comms_quantize
+     + a low floor), so each rank must ALSO show nonzero
+     comms/plan_arm/* counters (the planner ran), plan wire bytes
+     strictly below the dense-equivalent bytes (the quantized arm
+     moved less than flat dense would have), and a populated
+     comms_plan section in /statusz (the active plan per program);
   3. calibrator: tools/comms_calibrate.py --quick must emit a
      well-formed comms_model.json whose predicted times stay within
-     2x of measured for every swept size;
+     2x of measured for every swept size — including the
+     allreduce_quant entry that prices the quantized arm;
   4. disabled-path cost: with the tracer off, the steady-state
      hot-path budgets of tools/check_hot_path.py must still hold.
 
@@ -129,7 +136,13 @@ def main():
     base_env = dict(os.environ)
     base_env.update({'PADDLE_TPU_STATUS_WORKERS': spec,
                      'FLAGS_health_heartbeat_seconds': '0.5',
-                     'FLAGS_trace': '1'})
+                     'FLAGS_trace': '1',
+                     # collective-planner posture: quantized arm on
+                     # with a floor below the worker's grad-bucket
+                     # size, so the planner must fire and the wire
+                     # bytes must drop vs dense
+                     'FLAGS_comms_quantize': '1',
+                     'FLAGS_comms_quantize_min_bytes': '1024'})
     env0 = dict(base_env, PADDLE_TRAINER_ID='0',
                 PADDLE_TPU_STATUS_AGGREGATE='1')
     env1 = dict(base_env, PADDLE_TRAINER_ID='1',
@@ -183,6 +196,45 @@ def main():
             if not any(state['hists'][h]['count'] > 0 for h in hists):
                 failures.append('%s has no populated comms/bw_gbps/* '
                                 'histogram' % name)
+            # collective planner: the quantized arm must have run, and
+            # its wire bytes must be strictly below what flat dense
+            # would have moved (the named saving, not a claim)
+            arm_hits = sum(v for k, v in counters.items()
+                           if k.startswith('comms/plan_arm/'))
+            if arm_hits <= 0:
+                failures.append('%s comms/plan_arm/* counters are '
+                                'zero: planner never ran' % name)
+            if counters.get('comms/plan_arm/quant', 0.0) <= 0:
+                failures.append('%s quantized arm never fired despite '
+                                'FLAGS_comms_quantize' % name)
+            plan_wire = counters.get('comms/plan_wire_bytes', 0.0)
+            dense_equiv = counters.get('comms/plan_dense_equiv_bytes',
+                                       0.0)
+            if not (0 < plan_wire < 0.5 * dense_equiv):
+                failures.append(
+                    '%s planned wire bytes did not drop vs dense '
+                    '(%.0f vs dense-equiv %.0f)'
+                    % (name, plan_wire, dense_equiv))
+            # /statusz must carry the active plan per program
+            code, body = _get(url + '/statusz')
+            plan_sec = json.loads(body).get('comms_plan')
+            if not plan_sec or not plan_sec.get('programs'):
+                failures.append('%s /statusz comms_plan section '
+                                'missing or empty' % name)
+            else:
+                buckets = [b for p in plan_sec['programs'].values()
+                           for b in p.get('buckets', [])]
+                if not any(b.get('grads', 0) > 1 for b in buckets):
+                    failures.append('%s /statusz comms_plan shows no '
+                                    'fused bucket' % name)
+                # the transpile-time preview must agree with the
+                # posture: quantize is on with a floor below the
+                # bucket size, so the preview names the quant arm
+                if not any(b.get('arm_preview') == 'quant'
+                           for b in buckets):
+                    failures.append('%s /statusz comms_plan preview '
+                                    'never shows the quant arm'
+                                    % name)
 
         # merged /metrics stays lint-clean with the comms/* families
         code, body = _get(agg + '/metrics')
@@ -230,6 +282,10 @@ def main():
             model = json.load(open(model_path))
             colls = model['collectives']
             assert model['devices'] >= 2 and colls
+            if 'allreduce_quant' not in colls:
+                failures.append('comms_model.json has no '
+                                'allreduce_quant entry: the quantized '
+                                'arm was not calibrated')
             for kind, entry in colls.items():
                 assert entry['inv_bw_s_per_byte'] > 0
                 assert entry['latency_s'] >= 0
@@ -259,8 +315,9 @@ def main():
             print('  - %s' % f)
         return 1
     print('check_comms: merged 2-rank timeline OK, comms telemetry '
-          'nonzero + lint-clean, calibrator within %.1fx, hot-path '
-          'budgets hold' % MAX_RATIO)
+          'nonzero + lint-clean, planner ran (quant arm, wire < '
+          'dense-equiv, /statusz plan), calibrator (incl. quant arm) '
+          'within %.1fx, hot-path budgets hold' % MAX_RATIO)
     return 0
 
 

@@ -154,6 +154,28 @@ REQUIRED = [
     ('paddle_tpu/fluid/parallel_executor.py',
      'comms.account_dispatch'),
     ('paddle_tpu/fluid/parallel_executor.py', 'comms.collecting'),
+    # collective planner (fluid/comms_plan.py + the planned lowerings
+    # in ops/collective_ops.py + the GradAllReduce bucket rewrite):
+    # which arm ran, actual vs dense-equivalent wire bytes, the cost
+    # model's predicted-vs-measured honesty, and the planner digest
+    # folded into both runner fingerprints — tools/check_comms.py
+    # asserts the counters move on a real quantized two-process job
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_arm/'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_wire_bytes'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_dense_equiv_bytes'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_predicted_seconds'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_measured_seconds'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_pred_over_measured'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_unpriced'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_fused_grads'),
+    ('paddle_tpu/fluid/transpiler/collective.py',
+     'collective/plan_buckets'),
+    ('paddle_tpu/fluid/transpiler/collective.py',
+     'collective/plan_fused_grads'),
+    ('paddle_tpu/ops/collective_ops.py', '_planned_allreduce'),
+    ('paddle_tpu/fluid/parallel_executor.py', 'comms_plan.digest'),
+    ('paddle_tpu/fluid/health.py', 'comms_plan.program_plans'),
+    ('bench.py', '_plan_ab_fields'),
     ('paddle_tpu/fluid/executor.py', '_comms.record_memory'),
     # a restarted (disk-hit) process must keep memory accounting
     ('paddle_tpu/fluid/compile_cache.py', 'comms.record_memory'),

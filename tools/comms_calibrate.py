@@ -22,8 +22,15 @@ Usage (8-device CPU mesh, the CI posture):
     python tools/comms_calibrate.py --out comms_model.json
   --sizes_kib 16,64,256,1024   per-participant payloads to sweep
   --steps 8                    timed steps per point (median)
-  --collectives allreduce,allgather,reducescatter,broadcast
+  --collectives allreduce,allgather,reducescatter,broadcast,allreduce_quant
   --quick                      small sweep for CI gates
+
+The ``allreduce_quant`` kind sweeps the planner's quantized arm (the
+c_allreduce_sum lowering with plan_arm='quant': int8 reduce-scatter +
+per-block fp32 scales + int8 allgather), priced at its actual
+quantized wire bytes (comms_plan.quant_wire_bytes) — so
+comms_model.json carries a real measured entry for the quant-vs-dense
+decision, not a scaled guess.
 """
 
 import argparse
@@ -55,14 +62,21 @@ def build_program(fluid, layers, kind, elems, ndev):
             x = layers.data('x', shape=[elems], dtype='float32')
     block = main_p.global_block()
     op_type = {'allreduce': 'c_allreduce_sum',
+               'allreduce_quant': 'c_allreduce_sum',
                'allgather': 'c_allgather',
                'reducescatter': 'c_reducescatter',
                'broadcast': 'c_broadcast'}[kind]
-    if kind in ('allreduce', 'broadcast'):
+    if kind in ('allreduce', 'allreduce_quant', 'broadcast'):
         fetch = 'x'
+        attrs = {'ring_id': 0}
+        if kind == 'allreduce_quant':
+            # force the quantized arm (int8 reduce-scatter + scales)
+            # regardless of the FLAGS_comms_quantize gate, so the model
+            # can price it against dense
+            attrs['plan_arm'] = 'quant'
         block.append_op(op_type, inputs={'X': 'x'},
                         outputs={'Out': 'x'},
-                        attrs={'ring_id': 0}, infer_shape=False)
+                        attrs=attrs, infer_shape=False)
     else:
         block.create_var(name='y', shape=x.shape, dtype='float32')
         fetch = 'y'
@@ -83,7 +97,7 @@ def sweep(kinds, sizes_kib, steps, warmup):
     import numpy as np
     import jax
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.fluid import comms, layers, monitor
+    from paddle_tpu.fluid import comms, comms_plan, layers, monitor
 
     ndev = len(jax.devices())
     exe = fluid.Executor(fluid.XLAPlace(0))
@@ -105,7 +119,10 @@ def sweep(kinds, sizes_kib, steps, warmup):
                     exe.run(main_p, feed=feed, fetch_list=[fetch])
                     walls.append(time.perf_counter() - t0)
             payload = float(elems * 4)
-            wire = comms.wire_bytes(kind, payload, ndev)
+            if kind == 'allreduce_quant':
+                wire = comms_plan.quant_wire_bytes(payload, 4, ndev)
+            else:
+                wire = comms.wire_bytes(kind, payload, ndev)
             # fit target is the MIN wall: the uncontended cost of the
             # collective, the estimate a planner should price with —
             # OS jitter only ever inflates a sample (p50/p90 ride
@@ -156,7 +173,7 @@ def main(argv=None):
     ap.add_argument('--warmup', type=int, default=2)
     ap.add_argument('--collectives',
                     default='allreduce,allgather,reducescatter,'
-                            'broadcast')
+                            'broadcast,allreduce_quant')
     ap.add_argument('--quick', action='store_true',
                     help='small sweep (CI gate posture)')
     args = ap.parse_args(argv)

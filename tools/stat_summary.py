@@ -13,6 +13,10 @@ Usage:
                                   # one rank's steps out of a merged
                                   # job dump (trace.collect_job /
                                   # tools/timeline.py --job output)
+  python tools/stat_summary.py --plan run.jsonl     # collective-
+                                  # planner rollup: arm mix, wire vs
+                                  # dense-equivalent bytes, cost-model
+                                  # predicted vs measured
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
@@ -117,8 +121,50 @@ def steps_report(path, out=None, rank=None):
     return 0
 
 
+def plan_report(rec, out=None):
+    """Collective-planner rollup from one monitor record: which arms
+    ran (comms/plan_arm/*), the wire bytes the plan moved vs what flat
+    dense would have (the measured saving), and the cost model's
+    predicted-vs-measured seconds.  The same numbers /statusz's
+    comms_plan section serves live."""
+    out = out if out is not None else sys.stdout
+    c = rec.get('counters', {})
+    arms = {n.rsplit('/', 1)[1]: v for n, v in c.items()
+            if n.startswith('comms/plan_arm/')}
+    if not arms:
+        out.write('no comms/plan_arm/* counters: the collective '
+                  'planner never ran in this record\n')
+        return 1
+    total = sum(arms.values())
+    out.write('collective planner rollup\n')
+    for arm in sorted(arms):
+        out.write('  arm %-8s %10d dispatches (%.0f%%)\n'
+                  % (arm, arms[arm], 100.0 * arms[arm] / total))
+    wire = c.get('comms/plan_wire_bytes', 0.0)
+    dense = c.get('comms/plan_dense_equiv_bytes', 0.0)
+    if dense > 0:
+        out.write('  wire bytes      %14s vs dense-equiv %s '
+                  '(%.2fx reduction)\n'
+                  % (_fmt(wire), _fmt(dense),
+                     dense / wire if wire > 0 else float('inf')))
+    fused = c.get('comms/plan_fused_grads', 0.0)
+    if fused:
+        out.write('  fused grads     %14s\n' % _fmt(fused))
+    pred = c.get('comms/plan_predicted_seconds', 0.0)
+    meas = c.get('comms/plan_measured_seconds', 0.0)
+    if meas > 0:
+        out.write('  cost model      predicted %.6gs vs measured '
+                  '%.6gs (ratio %.2f)\n' % (pred, meas, pred / meas))
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == '--plan':
+        if len(argv) != 2:
+            sys.stderr.write(__doc__)
+            return 2
+        return plan_report(load_last(argv[1]))
     if argv and argv[0] == '--steps':
         rank = None
         if '--rank' in argv:
