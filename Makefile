@@ -58,7 +58,10 @@ bench:
 # retraces and lint-clean serving metrics, and the job-wide
 # observability plane must merge a real two-process job into one
 # schema-valid per-rank timeline with nonzero collective telemetry
-# and a calibrated comms cost model within 2x of measured
+# and a calibrated comms cost model within 2x of measured, and the
+# device-memory plane must attribute per-(program, segment) peaks,
+# sample the live-HBM census into gauges + a Perfetto counter track,
+# and cost nothing when off
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
@@ -67,6 +70,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_health.py
 	JAX_PLATFORMS=cpu python tools/check_serving.py
 	JAX_PLATFORMS=cpu python tools/check_comms.py
+	JAX_PLATFORMS=cpu python tools/check_memviz.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

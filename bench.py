@@ -1004,6 +1004,72 @@ def bench_health_overhead(depth=4, width=64, batch=32, steps=60,
                 **_monitor_fields())
 
 
+def bench_memviz_overhead(depth=4, width=64, batch=32, steps=60,
+                          warmup=8):
+    """FLAGS_memviz on/off A/B on one small MLP: the BENCH JSON
+    records the per-step cost of the live-HBM sampler (census over
+    jax.live_arrays() + gauges + counter track) AND enforces the
+    'costs one flag read when off' claim — the off posture must record
+    zero census samples (tools/check_memviz.py gates the counter
+    budgets; this publishes the wall-clock trajectory so a sampler
+    that starts blocking per step is visible in the numbers)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import memviz, monitor
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[width], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = fluid.layers.fc(h, size=width, act='relu')
+        loss = fluid.layers.reduce_mean(fluid.layers.square(h))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    feed = {'x': jax.device_put(np.ones((batch, width), 'float32'))}
+
+    def timed(flag_on):
+        # the flag gates only the post-step sampler (never the plan or
+        # the lowering), so both postures share one program + executor
+        fluid.flags.set_flags({'FLAGS_memviz': flag_on})
+        try:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.XLAPlace(0))
+                exe.run(startup)
+                for _ in range(warmup):
+                    exe.run(main, feed=feed, fetch_list=[])
+                pname = main.all_parameters()[0].name
+                jax.block_until_ready(scope.find_var(pname))
+                t0 = time.time()
+                for _ in range(steps):
+                    exe.run(main, feed=feed, fetch_list=[])
+                    jax.block_until_ready(scope.find_var(pname))
+                return (time.time() - t0) / steps
+        finally:
+            fluid.flags.set_flags({'FLAGS_memviz': False})
+
+    memviz.reset()
+    off_s = timed(False)
+    samples_off = monitor.counter_value('memviz/samples')
+    on_s = timed(True)
+    samples_on = monitor.counter_value('memviz/samples') - samples_off
+    return dict({'metric': 'memviz_overhead_us_per_step_d%d' % depth,
+                 'value': round((on_s - off_s) * 1e6, 1),
+                 'unit': 'us/step',
+                 'memviz_overhead': {
+                     'off_us_per_step': round(off_s * 1e6, 1),
+                     'on_us_per_step': round(on_s * 1e6, 1),
+                     'overhead_pct': round(
+                         100.0 * (on_s - off_s) / max(off_s, 1e-12),
+                         1),
+                     'samples_recorded_off': samples_off,
+                     'samples_recorded_on': samples_on,
+                     'live_bytes_total': monitor.gauge_value(
+                         'memviz/live_bytes_total')}},
+                **_monitor_fields())
+
+
 def bench_parallel(batch=256, width=256, steps=30, warmup=5,
                    skew_seconds=20.0):
     """Collective-job bench (BENCH_comms.json): a GradAllReduce MLP
@@ -1277,6 +1343,7 @@ def _skew_job_fields(run_for):
 
 SMOKE_BENCHES = (('dispatch', {}),
                  ('health_overhead', {}),
+                 ('memviz_overhead', {}),
                  ('lenet', {'batch': 64, 'steps': 30}))
 
 

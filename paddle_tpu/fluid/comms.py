@@ -350,33 +350,22 @@ def bw_samples():
 # ------------------------------------------------------ memory accounting
 def record_memory(label, compiled):
     """Read an XLA executable's memory_analysis() into the per-segment
-    registry + executor/segment_*_bytes gauges.  Never raises (some
-    backends return None / partial stats); returns the row or None."""
-    try:
-        ma = compiled.memory_analysis()
-    except Exception:
+    registry + executor/segment_*_bytes gauges.  Never raises:
+    backends where the analysis raises, returns None or reports only
+    partial fields are tolerated and counted
+    (``memviz/analysis_unavailable``, via fluid.memviz — the shared
+    extraction) instead of silently skipped; returns the row or
+    None."""
+    from . import memviz
+    fields = memviz.analysis_fields(compiled)
+    if fields is None:
         return None
-    if ma is None:
-        return None
-
-    def _field(name):
-        try:
-            v = getattr(ma, name, None)
-            return float(v) if v is not None else None
-        except Exception:
-            return None
-
-    arg = _field('argument_size_in_bytes')
-    out = _field('output_size_in_bytes')
-    temp = _field('temp_size_in_bytes')
-    peak = _field('peak_memory_in_bytes')
-    if peak is None:
-        # CPU XLA reports no peak; arg+out+temp is the live-set bound
-        peak = (arg or 0.0) + (out or 0.0) + (temp or 0.0)
-    row = {'argument_bytes': arg or 0.0, 'output_bytes': out or 0.0,
-           'temp_bytes': temp or 0.0, 'peak_bytes': peak,
-           'generated_code_bytes': _field(
-               'generated_code_size_in_bytes') or 0.0}
+    row = {'argument_bytes': fields['argument_bytes'],
+           'output_bytes': fields['output_bytes'],
+           'temp_bytes': fields['temp_bytes'],
+           'peak_bytes': fields['peak_bytes'],
+           'generated_code_bytes': fields['generated_code_bytes']}
+    peak = row['peak_bytes']
     with _lock:
         if label not in _MEMORY and len(_MEMORY) >= _MEMORY_CAP:
             _MEMORY.pop(next(iter(_MEMORY)))

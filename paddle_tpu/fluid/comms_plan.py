@@ -80,9 +80,11 @@ __all__ = [
 ]
 
 _lock = threading.Lock()
-# (path, mtime, size) -> parsed model; one entry (models are small and
-# a process consults one file)
+# (path, mtime, size) -> parsed model (or None for an unusable file —
+# negatives cache too); one entry (models are small and a process
+# consults one file)
 _model_cache = {}
+_MODEL_MISS = object()
 # label -> plan summary, insertion-ordered and bounded (/statusz view)
 _PLANS = {}
 _PLANS_CAP = 64
@@ -127,16 +129,19 @@ def load_model(path=None):
         return None
     key = (os.path.abspath(p), st.st_mtime_ns, st.st_size)
     with _lock:
-        cached = _model_cache.get(key)
-    if cached is not None:
+        cached = _model_cache.get(key, _MODEL_MISS)
+    if cached is not _MODEL_MISS:
         return cached
     try:
         with open(p) as f:
             model = json.load(f)
         if not isinstance(model.get('collectives'), dict):
-            return None
+            model = None
     except Exception:
-        return None
+        model = None
+    # cache negatives too (same (path, mtime, size) key): an
+    # unparsable/schema-less file would otherwise be re-read and
+    # re-parsed on EVERY predict_seconds call
     with _lock:
         _model_cache.clear()
         _model_cache[key] = model
@@ -228,13 +233,30 @@ def quant_block():
 
 # ------------------------------------------------------------- HBM budget
 def hbm_headroom_bytes():
-    """Remaining per-segment HBM under FLAGS_comms_hbm_budget_bytes,
-    measured against the executor/segment_peak_bytes gauge (fluid.comms
-    record_memory); None when no budget is configured."""
+    """Remaining per-segment HBM under FLAGS_comms_hbm_budget_bytes;
+    None when no budget is configured.
+
+    The footprint is PER PROGRAM where the memory plane can attribute
+    it: inside an executor/runner/transpiler ``memviz.program_scope``
+    the ambient program's own peak (fluid.memviz ``record_segment``)
+    is the reference — one big resident program no longer suppresses
+    quantization/fusion for every other program.  Outside a program
+    scope, or before any attribution row lands for the program, the
+    job-wide ``executor/segment_peak_bytes`` gauge keeps the old
+    conservative behavior."""
     budget = float(get_flag('FLAGS_comms_hbm_budget_bytes', 0) or 0)
     if budget <= 0:
         return None
-    used = monitor.gauge_value('executor/segment_peak_bytes') or 0.0
+    used = None
+    try:
+        from . import memviz
+        label = memviz.current_program()
+        if label is not None:
+            used = memviz.peak_bytes(label)
+    except Exception:
+        used = None
+    if used is None:
+        used = monitor.gauge_value('executor/segment_peak_bytes') or 0.0
     return max(0.0, budget - used)
 
 

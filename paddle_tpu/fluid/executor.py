@@ -26,6 +26,7 @@ import jax
 from . import compile_cache
 from . import core
 from . import framework
+from . import memviz as _memviz
 from . import monitor
 from . import trace as _trace
 from ..ops import registry
@@ -1803,6 +1804,12 @@ class Executor(object):
             item.input_names = inputs
             item.state_names = state
             item.output_names = sorted(outputs)
+        # census param-vs-state classification: the parameters of
+        # every planned program are registered once, at plan-build time
+        try:
+            _memviz.note_params(p.name for p in program.all_parameters())
+        except Exception:
+            pass
         plan = _Plan(items)
         dev_names = set()
         consume_count = {}
@@ -1931,6 +1938,21 @@ class Executor(object):
 
     def _run_plan(self, program, plan, feed, fetch_names, scope,
                   return_numpy):
+        """Program-scoped wrapper over the plan interpreter: the
+        ambient memviz program label (per-(program, segment) HBM
+        attribution + the collective planner's per-program headroom
+        gate) and the flag-gated live-memory sampler ride here, so
+        BOTH per-step entry points (Executor.run, CompiledPipeline)
+        are covered.  Disabled memviz cost: one flag read per step."""
+        with _memviz.program_scope(_memviz.program_label(program)):
+            out = self._run_plan_inner(program, plan, feed,
+                                       fetch_names, scope,
+                                       return_numpy)
+        _memviz.maybe_sample(self._step, scope)
+        return out
+
+    def _run_plan_inner(self, program, plan, feed, fetch_names, scope,
+                        return_numpy):
         device = self.place.jax_device()
         feed = self._stage_feeds(program, plan, feed, device)
         fetched = {}
@@ -2152,6 +2174,17 @@ class Executor(object):
                     fp, lambda: _aot_build(seg, wpg, state_specs,
                                            data_specs, device))
                 seg.compiled[skey] = compiled
+                # memory-plane attribution: once per NEW executable
+                # entry — compile, memory hit or disk hit all land
+                # here, so a zero-retrace restarted process keeps its
+                # per-(program, segment) peak decomposition
+                _memviz.record_segment(
+                    None,
+                    '%dops:%s@%s' % (
+                        len(seg.ops),
+                        ','.join(sorted(seg.output_names)[:3]),
+                        fp[:8]),
+                    compiled, state_specs, data_specs, seg=seg)
             else:
                 monitor.add('executor/segment_cache_hit')
         else:
@@ -2216,10 +2249,26 @@ class Executor(object):
             note = _feed_mismatch_note(seg.ops[0].block.program, feed)
             if note:
                 _add_note(e, note)
-            dump = _trace.dump_on_error('segfail_step%d' % self._step)
-            if dump:
-                _add_note(e, 'trace flight recorder (last %d steps) '
-                          'dumped to %s' % (len(_trace.steps()), dump))
+            oom_note = None
+            if _memviz.is_oom_error(e):
+                # OOM forensics (the memory analog of the NaN
+                # provenance path): embed the live census + per-segment
+                # peaks + largest buffers in the flight dump and name
+                # the top contributors in the error itself
+                oom_note = _memviz.oom_incident(e, step=self._step,
+                                                scope=scope)
+                if oom_note:
+                    _add_note(e, oom_note)
+            # one dump per incident: the OOM dump already embeds the
+            # full flight recorder + snapshot, so the generic segfail
+            # dump runs only when the OOM path didn't write one
+            if not (oom_note and 'flight dump' in oom_note):
+                dump = _trace.dump_on_error(
+                    'segfail_step%d' % self._step)
+                if dump:
+                    _add_note(e, 'trace flight recorder (last %d '
+                              'steps) dumped to %s'
+                              % (len(_trace.steps()), dump))
             raise
         if check_nan:
             self._check_nan_inf(out, seg=seg, replay=replay)

@@ -161,6 +161,32 @@ _DEFAULTS = {
     # quantized arm (which needs ~2.25x the payload in temporaries)
     # falls back dense when the headroom is tighter than that
     'FLAGS_comms_hbm_budget_bytes': 0,
+    # device-memory observability plane (fluid/memviz.py): FLAGS_memviz
+    # turns on the per-step live-HBM sampler — a census over
+    # jax.live_arrays() classified param/state/feed/exec/other into
+    # memviz/live_bytes/* gauges and a Perfetto counter track merged
+    # into the step timeline.  Off (the default) the executor pays one
+    # flag read per step (bench.py --smoke memviz_overhead proves it);
+    # peak ATTRIBUTION (per-(program, segment) decomposition of each
+    # AOT executable's memory_analysis()) and OOM forensics are always
+    # on — they run at compile/incident time, never per step.
+    'FLAGS_memviz': False,
+    # census cadence: sample every N'th step (1 = every step; the
+    # census is O(live arrays), so big-residency jobs may thin it)
+    'FLAGS_memviz_sample_steps': 1,
+    # HBM budget for the watermark detector, bytes; 0 = auto-detect
+    # from device.memory_stats()['bytes_limit'] where the backend
+    # reports it (CPU reports nothing -> watermarks off)
+    'FLAGS_memviz_budget_bytes': 0,
+    # utilization fraction of the budget that trips the watermark
+    # detector (memviz/watermark_trips + rate-limited snapshot dump)
+    'FLAGS_memviz_watermark': 0.9,
+    # growth-spike detector: live bytes this many times over the
+    # running EMA auto-dump the snapshot BEFORE the OOM; 0 disables
+    'FLAGS_memviz_spike_factor': 2.0,
+    # rate limits for the detector and OOM-incident flight dumps
+    'FLAGS_memviz_dump_interval_s': 60.0,
+    'FLAGS_memviz_oom_interval_s': 30.0,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

@@ -109,6 +109,20 @@ def status():
         if serving_ready is False:
             ready = False
             reasons.extend(s_reasons)
+    # memory-pressure degradation (fluid.memviz budget watermarks):
+    # /healthz stays 200 — a pressured trainer is still live — but the
+    # body names the degradation so routers/operators can shed load
+    # before the allocator fails
+    memory = None
+    try:
+        from . import memviz
+        memory = memviz.memory_pressure()
+        if memory is not None and memory['degraded']:
+            reasons.append(
+                'device memory at %.0f%% of budget (watermark)'
+                % (100.0 * memory['utilization']))
+    except Exception:
+        pass
     return {
         'alive': True,
         'ready': ready,
@@ -119,6 +133,7 @@ def status():
         'steps': run_calls,
         'warmed': warmed,
         'serving_ready': serving_ready,
+        'memory': memory,
         'last_step_age_s': (round(age, 3) if age is not None else None),
     }
 
@@ -177,14 +192,25 @@ def statusz():
         versions['backend'] = jax.default_backend()
     except Exception:
         pass
-    # per-segment XLA memory accounting (fluid.comms.record_memory):
-    # the HBM-budget view the placement planner reads
+    # device-memory plane (fluid.memviz + fluid.comms.record_memory):
+    # per-(program, segment) peak ATTRIBUTION (named contributors, not
+    # four scalars), the latest live-HBM census by class, and the
+    # budget watermarks — the HBM view the placement planner, the
+    # collective planner's headroom gate, and an OOM post-mortem read
     memory_section = None
     try:
-        from . import comms
+        from . import comms, memviz
+        attribution = memviz.report(limit=16)
         rows = comms.memory_report()
-        if rows:
+        # the census alone is reason enough to render the section: on
+        # a backend with no memory_analysis() it is the only memory
+        # signal (attribution rows are then counted unavailable)
+        if rows or attribution or memviz.last_census() is not None:
             memory_section = {
+                'attribution': attribution,
+                'top_buffers': memviz.top_contributors(),
+                'live': memviz.last_census(),
+                'budget': memviz.memory_pressure(),
                 'segments': rows[:32],
                 'segment_argument_bytes': monitor.gauge_value(
                     'executor/segment_argument_bytes'),
@@ -522,19 +548,37 @@ class _Aggregator(object):
                     monitor.add('health/detector_dumps')
         return rep
 
+    @staticmethod
+    def _memory_view(gauges):
+        """Per-worker memory rollup from scraped memviz gauges (None
+        until that worker's sampler ran)."""
+        total = gauges.get('memviz/live_bytes_total')
+        if total is None:
+            return None
+        return {'live_bytes': total,
+                'live_bytes_hwm': gauges.get('memviz/live_bytes_hwm'),
+                'budget_utilization': gauges.get(
+                    'memviz/budget_utilization'),
+                'segment_peak_bytes': gauges.get(
+                    'executor/segment_peak_bytes')}
+
     def job_view(self):
-        """The /statusz 'job' section: per-rank liveness + the last
-        heartbeat's skew report."""
+        """The /statusz 'job' section: per-rank liveness, per-rank
+        memory (live HBM + budget utilization from the memviz
+        sampler), and the last heartbeat's skew report."""
         own = status()
         now = time.time()
         workers = {self.self_rank: {
             'up': True, 'ready': own['ready'], 'endpoint': 'local',
-            'steps': own['steps'], 'last_scrape_age_s': 0.0}}
+            'steps': own['steps'], 'last_scrape_age_s': 0.0,
+            'memory': self._memory_view(monitor.raw_state()['gauges'])}}
         for r, p in self.peers().items():
             workers[r] = {
                 'up': p['up'], 'ready': p['ready'],
                 'endpoint': p['endpoint'], 'error': p['error'],
                 'steps': (p.get('status') or {}).get('steps'),
+                'memory': self._memory_view(
+                    (p.get('state') or {}).get('gauges') or {}),
                 'last_scrape_age_s': (round(now - p['ts'], 3)
                                       if p['ts'] else None)}
         return {'workers': workers, 'skew': self._last_skew,

@@ -36,7 +36,8 @@ import re
 import time
 
 __all__ = [
-    'add', 'set_gauge', 'observe', 'counter_value', 'gauge_value',
+    'add', 'set_gauge', 'remove_gauge', 'observe', 'counter_value',
+    'gauge_value',
     'histogram_value', 'reset', 'set_enabled', 'snapshot', 'flat',
     'dump_jsonl', 'prometheus_text', 'raw_state', 'serve',
     'prom_escape_help', 'prom_escape_label', 'prom_sample',
@@ -82,6 +83,14 @@ def set_gauge(name, value):
     if not _enabled:
         return
     _gauges[name] = float(value)
+
+
+def remove_gauge(name):
+    """Drop gauge `name` from the registry — for per-entity gauge
+    series (per-program peaks, per-tenant depths) whose entity went
+    away: a frozen last value is misleading and the label set must
+    stay bounded in long-running services."""
+    _gauges.pop(name, None)
 
 
 def observe(name, value, buckets=TIME_BUCKETS):

@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import comms
 from . import compile_cache
 from . import core
+from . import memviz as _memviz
 from . import monitor
 from . import trace as _trace
 from .executor import (_Segment, _SegmentBinder, FetchHandle,
@@ -286,7 +287,11 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
                 return P(zero_axis)
             return None
     batch_feeds = _batch_feed_names(program, feed)
-    with _trace.step_span(executor._step):
+    # ambient program label: per-(program, segment) memory attribution
+    # and the collective planner's per-program HBM headroom resolve
+    # through it at trace time
+    with _memviz.program_scope(_memviz.program_label(program)), \
+            _trace.step_span(executor._step):
         for item in plan:
             if isinstance(item, _Segment):
                 _run_segment_parallel(executor, item, feed, scope, mesh,
@@ -303,6 +308,7 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
             if val is None:
                 val = core.as_array(scope.find_var(name))
             results.append(_resolve_fetch(val, return_numpy))
+    _memviz.maybe_sample(executor._step, scope)
     # dispatch-side wall time: this runner is an Executor.run entry
     # point too (CompiledProgram path), so it records the same counters
     monitor.add('executor/run_calls')
@@ -403,6 +409,13 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
             recs = comms.records_for(seg.comms_key)
             monitor.observe('parallel/segment_compile_seconds',
                             _time_mod.perf_counter() - t0)
+            # estimated attribution (args + outputs; shared jits
+            # expose no memory_analysis): keeps the per-program HBM
+            # headroom gate live for runner-compiled programs
+            _memviz.record_segment_estimate(
+                None, '%dops@%s' % (len(seg.ops),
+                                    str(seg.comms_key)[:8]),
+                state, data, outputs=out, seg=seg)
         else:
             with _dispatch_span('dispatch', seg.comms_key, recs):
                 out = compiled(executor._step, state, data)
@@ -419,10 +432,19 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
     except Exception as e:
         # same incident contract as the single-device executor: the
         # flight recorder holds the steps that led here — dump it
-        dump = _trace.dump_on_error('segfail_step%d' % executor._step)
-        if dump:
-            _add_note(e, 'trace flight recorder (last %d steps) '
-                      'dumped to %s' % (len(_trace.steps()), dump))
+        # (ONE dump: the OOM path's dump already embeds everything)
+        oom_note = None
+        if _memviz.is_oom_error(e):
+            oom_note = _memviz.oom_incident(e, step=executor._step,
+                                            scope=scope)
+            if oom_note:
+                _add_note(e, oom_note)
+        if not (oom_note and 'flight dump' in oom_note):
+            dump = _trace.dump_on_error(
+                'segfail_step%d' % executor._step)
+            if dump:
+                _add_note(e, 'trace flight recorder (last %d steps) '
+                          'dumped to %s' % (len(_trace.steps()), dump))
         raise
     for n, v in out.items():
         scope.set_var(n, v)
@@ -468,7 +490,8 @@ def run_collective(executor, program, feed, fetch_list, scope,
         for k, v in feed.items():
             scope.set_var(k, v.data if isinstance(v, _core.LoDTensor)
                           else v)
-    with _trace.step_span(executor._step):
+    with _memviz.program_scope(_memviz.program_label(program)), \
+            _trace.step_span(executor._step):
         _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                              batch_feeds, fetched)
         # fetch resolution inside the step span, same as run_parallel:
@@ -479,6 +502,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
             if val is None:
                 val = _core.as_array(scope.find_var(name))
             results.append(_resolve_fetch(val, return_numpy))
+    _memviz.maybe_sample(executor._step, scope)
     monitor.add('executor/run_calls')
     monitor.observe('executor/run_seconds',
                     _time_mod.perf_counter() - t_run0)
@@ -566,6 +590,12 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                 recs = comms.records_for(seg.comms_key)
                 monitor.observe('parallel/segment_compile_seconds',
                                 _time_mod.perf_counter() - t0)
+                # same estimated attribution as the data-parallel
+                # runner: per-program headroom needs a per-program row
+                _memviz.record_segment_estimate(
+                    None, '%dops@%s' % (len(seg.ops),
+                                        str(seg.comms_key)[:8]),
+                    state, data, outputs=out, seg=seg)
             else:
                 with _dispatch_span('dispatch', seg.comms_key, recs):
                     out = compiled(step, state, data)
@@ -587,11 +617,19 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                         getattr(v, 'dtype', '?'),
                         getattr(v, 'sharding', type(v).__name__)))
             _add_note(e, 'segment inputs:\n  ' + '\n  '.join(detail))
-            dump = _trace.dump_on_error(
-                'segfail_step%d' % executor._step)
-            if dump:
-                _add_note(e, 'trace flight recorder (last %d steps) '
-                          'dumped to %s' % (len(_trace.steps()), dump))
+            oom_note = None
+            if _memviz.is_oom_error(e):
+                oom_note = _memviz.oom_incident(
+                    e, step=executor._step, scope=scope)
+                if oom_note:
+                    _add_note(e, oom_note)
+            if not (oom_note and 'flight dump' in oom_note):
+                dump = _trace.dump_on_error(
+                    'segfail_step%d' % executor._step)
+                if dump:
+                    _add_note(e, 'trace flight recorder (last %d '
+                              'steps) dumped to %s'
+                              % (len(_trace.steps()), dump))
             raise
         for n, v in out.items():
             scope.set_var(n, v)

@@ -651,3 +651,24 @@ def resident_report():
     /statusz section body)."""
     return [s.resident_report() for s in list(_live)
             if not s._closed]
+
+
+def tenant_scopes():
+    """[(tenant label, scope)] over every live ServingExecutor — the
+    memviz census walks these so per-tenant device residency shows up
+    in the live-HBM classes and in OOM snapshots."""
+    out = []
+    for s in list(_live):
+        if s._closed:
+            continue
+        for t in s._tenant_list():
+            out.append((t.name, t.scope))
+    return out
+
+
+# census integration: registering the provider at import keeps plain
+# trainers unaware of the serving plane (memviz only walks it when this
+# module was imported, i.e. when a serving plane can exist)
+from . import memviz as _memviz  # noqa: E402
+
+_memviz.register_scope_provider(tenant_scopes)

@@ -58,9 +58,9 @@ __all__ = [
     'enable', 'disable', 'is_active', 'reset', 'span', 'record',
     'traced', 'step_span', 'step_tags', 'steps', 'step_report',
     'step_rollup', 'report_from_records', 'format_step_report',
-    'chrome_events', 'merge_device_trace', 'write_chrome', 'dump',
-    'dump_payload', 'dump_on_error', 'collect_job', 'job_skew_report',
-    'now_us',
+    'counter', 'counters', 'chrome_events', 'merge_device_trace',
+    'write_chrome', 'dump', 'dump_payload', 'dump_on_error',
+    'collect_job', 'job_skew_report', 'now_us',
 ]
 
 # monotonic->epoch anchor: every span stores perf_counter floats; the
@@ -71,6 +71,8 @@ _T0 = time.time()
 
 _active = False
 _events = []        # finished spans of the current step window
+_counters = []      # (name, t, {series: value}) counter samples —
+                    # the Perfetto counter tracks (memviz live-HBM)
 _steps = None       # deque of closed step records (the flight recorder)
 _capture = None     # device-capture session: {'t0_us', 'sync_us', 'events'}
 _tls = threading.local()
@@ -129,8 +131,10 @@ def reset():
     global _events, _steps
     with _lock:
         _events = []
+        del _counters[:]
         if _capture is not None:
             _capture['events'] = []
+            _capture['counters'] = []
         if _active:
             n = _steps.maxlen if _steps is not None else max(
                 1, int(get_flag('FLAGS_trace_buffer_steps', 16) or 16))
@@ -149,13 +153,17 @@ def _depth():
 # never stopped — would otherwise grow these lists for the life of the
 # process.  Overflow drops the oldest half and counts it.
 _WINDOW_CAP = 65536
+# counter-sample window (trace.counter): one sample per sampled step —
+# 4096 retains hours of 1/step sampling while keeping incident dumps
+# step-window-scaled, not run-length-scaled
+_COUNTER_CAP = 4096
 
 
-def _trim(ev):
+def _trim(ev, stat='trace/window_spans_dropped'):
     if len(ev) > _WINDOW_CAP:
         n = _WINDOW_CAP // 2
         del ev[:n]
-        monitor.add('trace/window_spans_dropped', float(n))
+        monitor.add(stat, float(n))
 
 
 def _emit(rec):
@@ -176,6 +184,40 @@ def record(name, t0, t1, args=None):
     if not _active:
         return
     _emit((name, t0, t1, threading.get_ident(), _depth(), args))
+
+
+def counter(name, values, t=None):
+    """Record one COUNTER TRACK sample — a named set of series values
+    at one instant (the memviz live-HBM sampler's per-class bytes).
+    The exporter renders these as Perfetto 'C' events, so counters and
+    spans read on one time axis.  Off: a no-op; counters ride their
+    own bounded window (spans' phase decomposition never sees them)."""
+    if not _active:
+        return
+    if t is None:
+        t = time.perf_counter()
+    rec = (str(name), float(t),
+           {str(k): float(v) for k, v in values.items()})
+    _counters.append(rec)
+    # counters keep a much smaller window than open spans: they are a
+    # per-step time series, and a dump should stay bounded near the
+    # flight recorder's step window, not carry the whole run's history.
+    # Their evictions get their own drop signal (an operator debugging
+    # span loss must not see counter evictions inflate span counters).
+    if len(_counters) > _COUNTER_CAP:
+        n = _COUNTER_CAP // 2
+        del _counters[:n]
+        monitor.add('trace/counter_samples_dropped', float(n))
+    cap = _capture
+    if cap is not None:
+        cap.setdefault('counters', []).append(rec)
+        _trim(cap['counters'], 'trace/counter_samples_dropped')
+    monitor.add('trace/counter_samples')
+
+
+def counters():
+    """The retained counter samples, oldest first."""
+    return list(_counters)
 
 
 class _NullSpan(object):
@@ -584,10 +626,11 @@ def _json_safe(v):
     return str(v)
 
 
-def chrome_events(span_tuples=None, pid=0):
+def chrome_events(span_tuples=None, pid=0, counter_samples=None):
     """Host spans -> chrome-trace 'X' events (epoch microseconds) plus
-    process/thread metadata.  Default source: every span retained by
-    the flight recorder + the current window."""
+    process/thread metadata and counter-track 'C' events.  Default
+    source: every span retained by the flight recorder + the current
+    window, and the retained counter samples."""
     if span_tuples is None:
         span_tuples = []
         for rec in steps():
@@ -597,6 +640,8 @@ def chrome_events(span_tuples=None, pid=0):
             span_tuples.append(('step', rec['t0'], rec['t1'],
                                 rec.get('tid'), 0, step_args))
         span_tuples.extend(list(_events))
+        if counter_samples is None:
+            counter_samples = counters()
     out = [{'ph': 'M', 'pid': pid, 'tid': 0, 'cat': 'pt_host',
             'name': 'process_name',
             'args': {'name': 'paddle_tpu host'}}]
@@ -615,6 +660,12 @@ def chrome_events(span_tuples=None, pid=0):
         if args:
             ev['args'] = {str(k): _json_safe(v) for k, v in args.items()}
         out.append(ev)
+    # counter tracks (memviz live-HBM classes): Perfetto renders each
+    # sample's args as stacked series under one named track, on the
+    # same clock as the spans
+    for name, t, values in (counter_samples or ()):
+        out.append({'ph': 'C', 'pid': pid, 'tid': 0, 'cat': 'pt_counter',
+                    'ts': now_us(t), 'name': name, 'args': values})
     return out
 
 
@@ -709,6 +760,7 @@ def dump_payload(extra=None):
                                 safe_args(s[5])]
                                for s in r['spans']]}
                     for r in recs],
+        'ptCounters': [[n, t, dict(v)] for n, t, v in counters()],
     }
     if extra:
         payload['ptIncident'] = extra
@@ -772,7 +824,7 @@ def attach_capture():
         if _capture is not None:
             return _capture
         _capture = {'t0_us': now_us(), 'sync_us': None, 'events': [],
-                    'was_active': _active}
+                    'counters': [], 'was_active': _active}
         if not _active:
             enable()
         return _capture
@@ -805,7 +857,9 @@ def write_host_trace(path, capture):
     does this) so tools/timeline.py can merge them offline."""
     import json
     with open(path, 'w') as f:
-        json.dump({'ptHostEvents': chrome_events(capture['events']),
+        json.dump({'ptHostEvents': chrome_events(
+                       capture['events'],
+                       counter_samples=capture.get('counters')),
                    'ptSync': capture['sync_us'],
                    'ptCaptureT0': capture['t0_us']}, f)
     return path

@@ -112,52 +112,61 @@ class GradAllReduce(Collective):
         # planner path: bucket the grads, insert one planned collective
         # per bucket (the arm itself resolves at trace time, when the
         # actual mesh axis size is known), then the reference's
-        # 1/nranks scale per grad
-        grads = [(g,) + _var_nbytes(block, g) for g in uniq]
-        buckets = comms_plan.bucket_grads(grads)
-        summary = {'nranks': self.nranks, 'grads': len(uniq),
-                   'buckets': []}
-        for b in buckets:
-            names = b['names']
-            if len(names) == 1:
-                block._insert_op(insert_at, 'c_allreduce_sum',
-                                 inputs={'X': names[0]},
-                                 outputs={'Out': names[0]},
-                                 attrs={'ring_id': 0, 'plan': True})
-            else:
-                block._insert_op(insert_at, 'c_allreduce_fused',
-                                 inputs={'X': list(names)},
-                                 outputs={'Out': list(names)},
-                                 attrs={'ring_id': 0, 'plan': True})
-            insert_at += 1
-            for g in names:
-                block._insert_op(insert_at, 'scale',
-                                 inputs={'X': g}, outputs={'Out': g},
-                                 attrs={'scale': 1.0 / self.nranks})
+        # 1/nranks scale per grad.  One ambient memviz program label
+        # over the whole rewrite makes the HBM-headroom gate (bucket
+        # caps + arm previews) read THIS program's recorded peak, not
+        # the job-wide max
+        from .. import memviz
+        with memviz.program_scope(memviz.program_label(
+                self.main_program)):
+            grads = [(g,) + _var_nbytes(block, g) for g in uniq]
+            buckets = comms_plan.bucket_grads(grads)
+            summary = {'nranks': self.nranks, 'grads': len(uniq),
+                       'buckets': []}
+            for b in buckets:
+                names = b['names']
+                if len(names) == 1:
+                    block._insert_op(insert_at, 'c_allreduce_sum',
+                                     inputs={'X': names[0]},
+                                     outputs={'Out': names[0]},
+                                     attrs={'ring_id': 0, 'plan': True})
+                else:
+                    block._insert_op(insert_at, 'c_allreduce_fused',
+                                     inputs={'X': list(names)},
+                                     outputs={'Out': list(names)},
+                                     attrs={'ring_id': 0, 'plan': True})
                 insert_at += 1
-            # transpile-time PREVIEW for /statusz — named arm_preview
-            # because the binding decision re-runs at trace time
-            # against the actual mesh axis size (self.nranks is the
-            # endpoint/device estimate); the comms/plan_arm/* counters
-            # report what actually ran
-            try:
-                itemsize = np.dtype(b['dtype']).itemsize
-            except Exception:
-                itemsize = 4
-            decision = comms_plan.decide(b['bytes'], itemsize,
-                                         self.nranks)
-            summary['buckets'].append({
-                'grads': len(names), 'bytes': b['bytes'],
-                'dtype': b['dtype'], 'arm_preview': decision['arm'],
-                'strategy_preview': decision['strategy'],
-                'names': names[:8]})
-            monitor.add('collective/plan_buckets')
-            if len(names) > 1:
-                monitor.add('collective/plan_fused_grads',
-                            float(len(names)))
+                for g in names:
+                    block._insert_op(insert_at, 'scale',
+                                     inputs={'X': g},
+                                     outputs={'Out': g},
+                                     attrs={'scale': 1.0 / self.nranks})
+                    insert_at += 1
+                # transpile-time PREVIEW for /statusz — named
+                # arm_preview because the binding decision re-runs at
+                # trace time against the actual mesh axis size
+                # (self.nranks is the endpoint/device estimate); the
+                # comms/plan_arm/* counters report what actually ran
+                try:
+                    itemsize = np.dtype(b['dtype']).itemsize
+                except Exception:
+                    itemsize = 4
+                decision = comms_plan.decide(b['bytes'], itemsize,
+                                             self.nranks)
+                summary['buckets'].append({
+                    'grads': len(names), 'bytes': b['bytes'],
+                    'dtype': b['dtype'],
+                    'arm_preview': decision['arm'],
+                    'strategy_preview': decision['strategy'],
+                    'names': names[:8]})
+                monitor.add('collective/plan_buckets')
+                if len(names) > 1:
+                    monitor.add('collective/plan_fused_grads',
+                                float(len(names)))
         comms_plan.record_program_plan(summary)
         _count_inserted_collectives(block, uniq, 'allreduce',
                                     n_ops=len(buckets))
+
 
 
 class LocalSGD(Collective):

@@ -1,0 +1,160 @@
+"""Memory-plane gate: the device-memory observability plane must
+attribute, sample and export on a REAL run, and cost nothing when off
+(the fluid.memviz analog of check_trace.py's contract).
+
+Runs a real LeNet training job (through Executor.warmup so the AOT
+plane — where attribution rides — is engaged) with FLAGS_memviz on and
+the tracer live, then checks:
+
+  1. attribution: per-(program, segment) rows with named top buffers
+     land in memviz.report(), classes + overhead sum back to the
+     executable's memory_analysis() argument arena;
+  2. sampler: every memviz/live_bytes/<class> gauge is populated and
+     param bytes are nonzero (LeNet's conv/fc weights are resident);
+  3. /statusz: the memory section carries the top-K attribution table
+     (not just the four scalars) off a live status server;
+  4. counter track: the flight-recorder dump holds schema-valid
+     Perfetto 'C' events for memviz/live_bytes on the same clock as
+     the step spans (the tools/timeline.py merge input);
+  5. disabled: with FLAGS_memviz off (the default), the steady-state
+     hot-path budgets of tools/check_hot_path.py must still hold.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import tempfile
+    import urllib.request
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import health, memviz, monitor, trace
+    from paddle_tpu import models
+
+    failures = []
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_p, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(64, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (64, 1)).astype('int64')}
+
+    fluid.set_flags({'FLAGS_memviz': True})
+    trace.enable()
+    srv = health.serve(port=0)
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            exe.warmup(main_p,
+                       feed_shapes={'img': ((64, 1, 28, 28), 'float32'),
+                                    'label': ((64, 1), 'int64')},
+                       fetch_list=[loss], wait=True)
+            for _ in range(4):
+                exe.run(main_p, feed=feed, fetch_list=[loss])
+
+        # 1. attribution rows with named contributors, summing honest
+        rows = memviz.report()
+        if not rows:
+            failures.append('no attribution rows after a warmed run')
+        for r in rows:
+            named = sum(r['classes'].values())
+            if abs(named + r['arg_overhead_bytes'] -
+                   r['argument_bytes']) > 1.0:
+                failures.append(
+                    'segment %s/%s classes %r + overhead %g != '
+                    'argument arena %g'
+                    % (r['program'], r['segment'], r['classes'],
+                       r['arg_overhead_bytes'], r['argument_bytes']))
+        if rows and not any(r['top_buffers'] for r in rows):
+            failures.append('attribution rows name no buffers')
+
+        # 2. per-class live gauges
+        for cls in ('param', 'state', 'feed', 'exec', 'other'):
+            if monitor.gauge_value('memviz/live_bytes/%s' % cls,
+                                   None) is None:
+                failures.append('gauge memviz/live_bytes/%s never '
+                                'published' % cls)
+        if not monitor.gauge_value('memviz/live_bytes/param'):
+            failures.append('LeNet params not attributed in the '
+                            'live census')
+        if not monitor.counter_value('memviz/samples'):
+            failures.append('sampler never ran with FLAGS_memviz on')
+
+        # 3. /statusz memory table off the live server
+        with urllib.request.urlopen('%s/statusz' % srv.url,
+                                    timeout=10) as resp:
+            sz = json.loads(resp.read().decode('utf-8'))
+        mem = sz.get('memory') or {}
+        if not mem.get('attribution'):
+            failures.append('/statusz memory section has no '
+                            'attribution table')
+        elif not mem['attribution'][0].get('top_buffers'):
+            failures.append('/statusz attribution rows have no named '
+                            'top buffers')
+
+        # 4. counter track in the dump (the timeline-merge input)
+        dump_path = os.path.join(tempfile.mkdtemp(prefix='pt_memviz_'),
+                                 'dump.json')
+        trace.dump(dump_path)
+        with open(dump_path) as f:
+            doc = json.load(f)
+        cs = [e for e in doc['traceEvents'] if e.get('ph') == 'C']
+        xs = [e for e in doc['traceEvents'] if e.get('ph') == 'X']
+        if not cs:
+            failures.append('no counter-track events in the dump')
+        for e in cs:
+            if e.get('name') != 'memviz/live_bytes' or \
+                    not isinstance(e.get('ts'), (int, float)) or \
+                    not isinstance(e.get('args'), dict):
+                failures.append('malformed counter event %r' % (e,))
+                break
+        if cs and xs:
+            ts = [e['ts'] for e in xs
+                  if isinstance(e.get('ts'), (int, float))]
+            lo, hi = min(ts), max(ts) + 1e6
+            if not all(lo <= e['ts'] <= hi for e in cs):
+                failures.append('counter samples not on the span '
+                                'clock')
+        print('memviz: %d attribution rows, %d counter samples, live '
+              'param bytes %s, statusz table rows %d'
+              % (len(rows), len(cs),
+                 int(monitor.gauge_value('memviz/live_bytes/param')),
+                 len(mem.get('attribution') or [])))
+    finally:
+        health.stop()
+        trace.disable()
+        trace.reset()
+        fluid.set_flags({'FLAGS_memviz': False})
+        memviz.reset()
+        monitor.reset()
+
+    # 5. disabled-path budgets: FLAGS_memviz off must keep the PR-2
+    # hot path byte-identical (one flag read per step)
+    import check_hot_path
+    rc = check_hot_path.main()
+    if rc != 0:
+        failures.append('check_hot_path budgets violated with memviz '
+                        'disabled (rc=%d)' % rc)
+
+    if failures:
+        for f in failures:
+            print('MEMVIZ GATE  ' + f)
+        return 1
+    print('memviz: attribution + sampler + statusz + counter track + '
+          'disabled budgets all hold')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -189,6 +189,32 @@ REQUIRED = [
     ('tools/comms_calibrate.py', 'inv_bw_s_per_byte'),
     ('tools/timeline.py', 'collect_job'),
     ('bench.py', 'bytes_on_wire'),
+    # device-memory observability plane (fluid/memviz.py): per-
+    # (program, segment) peak attribution, the live-HBM census sampler
+    # + Perfetto counter track, OOM forensics and budget watermarks —
+    # tools/check_memviz.py exercises the plane against a warmed LeNet
+    ('paddle_tpu/fluid/memviz.py', 'memviz/segments_attributed'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/program_peak_bytes'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/live_bytes/'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/live_bytes_total'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/live_bytes_hwm'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/budget_utilization'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/watermark_trips'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/spike_trips'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/oom_incidents'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/oom_dumps'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/analysis_unavailable'),
+    ('paddle_tpu/fluid/memviz.py', 'memviz/samples'),
+    ('paddle_tpu/fluid/executor.py', '_memviz.record_segment'),
+    ('paddle_tpu/fluid/executor.py', '_memviz.maybe_sample'),
+    ('paddle_tpu/fluid/executor.py', '_memviz.oom_incident'),
+    ('paddle_tpu/fluid/parallel_executor.py', '_memviz.oom_incident'),
+    ('paddle_tpu/fluid/trace.py', 'trace/counter_samples'),
+    ('paddle_tpu/fluid/comms_plan.py', 'memviz.peak_bytes'),
+    ('paddle_tpu/fluid/health.py', 'memviz.memory_pressure'),
+    ('paddle_tpu/fluid/serving.py', 'register_scope_provider'),
+    ('tools/stat_summary.py', 'memviz/live_bytes_total'),
+    ('bench.py', 'memviz_overhead'),
 ]
 
 

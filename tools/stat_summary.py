@@ -17,6 +17,11 @@ Usage:
                                   # planner rollup: arm mix, wire vs
                                   # dense-equivalent bytes, cost-model
                                   # predicted vs measured
+  python tools/stat_summary.py --memory run.jsonl   # device-memory
+                                  # rollup: live HBM by class, high
+                                  # watermark, budget utilization,
+                                  # per-program peaks, OOM/watermark
+                                  # incident counts (fluid.memviz)
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
@@ -158,8 +163,75 @@ def plan_report(rec, out=None):
     return 0
 
 
+def _fmt_bytes(b):
+    b = float(b)
+    if b >= 1 << 30:
+        return '%.2fGiB' % (b / (1 << 30))
+    if b >= 1 << 20:
+        return '%.1fMiB' % (b / (1 << 20))
+    if b >= 1024:
+        return '%.1fKiB' % (b / 1024.0)
+    return '%dB' % int(b)
+
+
+def memory_report(rec, out=None):
+    """Device-memory rollup from one monitor record: the memviz
+    live-HBM classes, high watermark, budget utilization, per-program
+    attributed peaks and incident counters — the offline form of the
+    /statusz memory section."""
+    out = out if out is not None else sys.stdout
+    g = rec.get('gauges', {})
+    c = rec.get('counters', {})
+    total = g.get('memviz/live_bytes_total')
+    if total is None and not any(n.startswith('memviz/')
+                                 for n in list(g) + list(c)):
+        out.write('no memviz/* stats in this record: enable '
+                  'FLAGS_memviz for the live-HBM sampler\n')
+        return 1
+    out.write('device-memory rollup (fluid.memviz)\n')
+    if total is not None:
+        classes = {n.rsplit('/', 1)[1]: v for n, v in g.items()
+                   if n.startswith('memviz/live_bytes/')}
+        out.write('  live HBM        %12s across %d arrays (%s)\n'
+                  % (_fmt_bytes(total),
+                     int(g.get('memviz/live_arrays', 0)),
+                     ', '.join('%s=%s' % (k, _fmt_bytes(classes[k]))
+                               for k in sorted(classes))))
+        hwm = g.get('memviz/live_bytes_hwm')
+        if hwm is not None:
+            out.write('  high watermark  %12s\n' % _fmt_bytes(hwm))
+        util = g.get('memviz/budget_utilization')
+        if util is not None:
+            out.write('  budget          %11.1f%% utilized\n'
+                      % (100.0 * util))
+    peaks = sorted(((n.rsplit('/', 1)[1], v) for n, v in g.items()
+                    if n.startswith('memviz/program_peak_bytes/')),
+                   key=lambda kv: -kv[1])
+    for prog, peak in peaks[:8]:
+        out.write('  program %-12s peak %12s\n'
+                  % (prog, _fmt_bytes(peak)))
+    for name, label in (('memviz/samples', 'census samples'),
+                        ('memviz/segments_attributed',
+                         'segments attributed'),
+                        ('memviz/watermark_trips', 'watermark trips'),
+                        ('memviz/spike_trips', 'spike trips'),
+                        ('memviz/oom_incidents', 'OOM incidents'),
+                        ('memviz/oom_dumps', 'OOM dumps'),
+                        ('memviz/analysis_unavailable',
+                         'analysis unavailable')):
+        v = c.get(name)
+        if v:
+            out.write('  %-22s %10d\n' % (label, v))
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == '--memory':
+        if len(argv) != 2:
+            sys.stderr.write(__doc__)
+            return 2
+        return memory_report(load_last(argv[1]))
     if argv and argv[0] == '--plan':
         if len(argv) != 2:
             sys.stderr.write(__doc__)

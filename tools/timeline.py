@@ -79,7 +79,12 @@ def merge(src, host_path, out_path):
     pt_trace.write_chrome(out_path, merged)
     n_host = sum(1 for e in host.get('ptHostEvents', [])
                  if e.get('ph') == 'X')
-    return n_host
+    # counter tracks (memviz live-HBM classes) ride the host events
+    # as 'C' samples; surface their presence so a silently-dark
+    # memory axis is visible at merge time
+    n_counters = sum(1 for e in host.get('ptHostEvents', [])
+                     if e.get('ph') == 'C')
+    return n_host, n_counters
 
 
 def collect_job_cli(args):
@@ -151,11 +156,11 @@ def main():
     src = find_trace(args.profile_path)
     host_path = args.host_trace or find_host_trace(args.profile_path)
     if host_path:
-        n_host = merge(src, host_path, args.timeline_path)
+        n_host, n_counters = merge(src, host_path, args.timeline_path)
         print('merged chrome trace written to %s (%d host spans + '
-              'device events; open in chrome://tracing or '
-              'https://ui.perfetto.dev)'
-              % (args.timeline_path, n_host))
+              '%d counter samples + device events; open in '
+              'chrome://tracing or https://ui.perfetto.dev)'
+              % (args.timeline_path, n_host, n_counters))
         return 0
     # device-only capture: passthrough, byte-identical to the source
     if src.endswith('.gz'):
