@@ -61,7 +61,10 @@ bench:
 # and a calibrated comms cost model within 2x of measured, and the
 # device-memory plane must attribute per-(program, segment) peaks,
 # sample the live-HBM census into gauges + a Perfetto counter track,
-# and cost nothing when off
+# and cost nothing when off, and the auto-sharding planner must plan
+# a real two-process job on every rank (parallel/plan_* counters +
+# /statusz auto_shard) while FLAGS_auto_shard=0 stays bit-for-bit
+# the hand-placed behavior
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
@@ -71,6 +74,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_serving.py
 	JAX_PLATFORMS=cpu python tools/check_comms.py
 	JAX_PLATFORMS=cpu python tools/check_memviz.py
+	JAX_PLATFORMS=cpu python tools/check_autoshard.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -1243,6 +1243,173 @@ def _plan_ab_fields(batch=256, width=256, rounds=6, per_round=4,
     return out
 
 
+def bench_autoshard(batch=8, rounds=5, per_round=4, warmup=3):
+    """Auto-sharding A/B (BENCH_autoshard.json): the SAME transformer
+    block (qkv fc -> context-parallel attention -> proj -> MoE FFN,
+    the test_sp_ep_fluid shape) trained three ways, interleaved so OS
+    noise hits every arm equally —
+
+      hand_spep:      the hand-placed dp2 x sp2 x ep2 mesh config
+                      (FLAGS_auto_shard=0, the pre-planner posture),
+      auto:           FLAGS_auto_shard=1 on the UNANNOTATED program
+                      (no mesh, no rules, no axis names),
+      auto_hbm_tight: same, under an injected HBM budget below the
+                      fully-replicated residency, so the memviz gate
+                      must REJECT at least one candidate layout before
+                      anything compiles and the planner lands on a
+                      scattered one.
+
+    Per arm: best step wall, bytes-on-wire per step, attributed peak
+    HBM, final loss (the parity claim rides in the artifact); the auto
+    arms also embed their plan summary (chosen layout, candidate
+    count, HBM rejections)."""
+    return {'metric': 'autoshard_ab', 'unit': 'ms/step',
+            'autoshard_ab': _autoshard_fields(batch, rounds,
+                                              per_round, warmup)}
+
+
+def _autoshard_fields(batch=8, rounds=5, per_round=4, warmup=3):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, memviz, monitor
+    from paddle_tpu.parallel import mesh as pmesh
+    from paddle_tpu.parallel import plan as auto_plan
+
+    T, H, D, E, FF = 16, 4, 8, 4, 32
+    DIM = H * D
+
+    def build(seed=5):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[T, DIM], dtype='float32')
+            y = layers.data('y', shape=[T, DIM], dtype='float32')
+            qkv = layers.fc(x, size=3 * DIM, num_flatten_dims=2,
+                            bias_attr=False)
+            q, k, v = layers.split(qkv, 3, dim=-1)
+            q = layers.reshape(q, [-1, T, H, D])
+            k = layers.reshape(k, [-1, T, H, D])
+            v = layers.reshape(v, [-1, T, H, D])
+            att = layers.context_parallel_attention(q, k, v,
+                                                    causal=True)
+            att = layers.reshape(att, [-1, T, DIM])
+            proj = layers.fc(att, size=DIM, num_flatten_dims=2,
+                             bias_attr=False)
+            h1 = layers.elementwise_add(x, proj)
+            mo, aux = layers.moe(h1, num_experts=E, hidden_size=FF,
+                                 aux_weight=0.01)
+            out_v = layers.elementwise_add(h1, mo)
+            mse = layers.reduce_mean(
+                layers.square(layers.elementwise_sub(out_v, y)))
+            loss = layers.elementwise_add(mse, aux)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(batch, T, DIM).astype('float32'),
+            'y': rng.randn(batch, T, DIM).astype('float32')}
+    # the injected budget for the tight arm: below the fully-
+    # replicated (dp-only) per-device residency, above the best
+    # scattered candidate — the memviz gate must fire
+    probe_main, _ps, _pl = build()
+    free = auto_plan.build_plan(
+        probe_main, ndev=8,
+        feed_shapes={k: v.shape for k, v in feed.items()})
+    repl_hbm = next(c['hbm_bytes'] for c in free.candidates
+                    if tuple(c['layout']) == (8, 1, 1))
+    auto_plan.reset()
+
+    arms = (
+        ('hand_spep', {'FLAGS_auto_shard': False,
+                       'FLAGS_memviz_budget_bytes': 0}, True),
+        ('auto', {'FLAGS_auto_shard': True,
+                  'FLAGS_memviz_budget_bytes': 0}, False),
+        ('auto_hbm_tight', {'FLAGS_auto_shard': True,
+                            'FLAGS_memviz_budget_bytes':
+                                repl_hbm * 0.8}, False),
+    )
+    prev = fluid.get_flags(['FLAGS_auto_shard',
+                            'FLAGS_memviz_budget_bytes'])
+    setups = {}
+    out = {}
+    try:
+        for name, fl, hand_mesh in arms:
+            fluid.set_flags(fl)
+            main_p, startup, loss = build()
+            comp = fluid.CompiledProgram(main_p).with_data_parallel(
+                loss_name=loss.name)
+            if hand_mesh:
+                comp = comp.with_mesh(pmesh.create_mesh(dp=2, sp=2,
+                                                        ep=2))
+            scope = fluid.Scope()
+            # one Executor per arm: parameter init folds the executor
+            # step counter into its RNG (same rationale as
+            # _plan_ab_fields)
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(warmup):
+                    exe.run(comp, feed=feed, fetch_list=[loss])
+            setups[name] = {'flags': fl, 'comp': comp, 'loss': loss,
+                            'scope': scope, 'exe': exe,
+                            'program': main_p, 'walls': [],
+                            'wire': 0.0, 'steps': 0,
+                            'final_loss': None}
+        for _ in range(rounds):
+            for name, _fl, _hm in arms:
+                s = setups[name]
+                fluid.set_flags(s['flags'])
+                with fluid.scope_guard(s['scope']):
+                    w0 = monitor.counter_value('comms/bytes_on_wire')
+                    t0 = time.perf_counter()
+                    for _ in range(per_round):
+                        lv, = s['exe'].run(s['comp'], feed=feed,
+                                           fetch_list=[s['loss']])
+                    s['walls'].append(time.perf_counter() - t0)
+                    s['wire'] += monitor.counter_value(
+                        'comms/bytes_on_wire') - w0
+                    s['steps'] += per_round
+                    s['final_loss'] = float(np.asarray(lv).ravel()[0])
+        for name, s in setups.items():
+            peak = memviz.peak_bytes(memviz.program_label(
+                s['program']))
+            row = {
+                'best_step_ms': round(
+                    min(s['walls']) / per_round * 1e3, 3),
+                'steps_per_sec': round(per_round / min(s['walls']), 2),
+                'bytes_on_wire_per_step':
+                    round(s['wire'] / max(1, s['steps']), 1),
+                'peak_hbm_bytes': peak,
+                'final_loss': s['final_loss'],
+            }
+            ap = getattr(s['comp'], '_auto_plan', None)
+            if ap is not None:
+                row['plan'] = {
+                    'layout': {'dp': ap.layout[0],
+                               'fsdp': ap.layout[1],
+                               'tp': ap.layout[2]},
+                    'update_axis': ap.update_axis,
+                    'candidates': len(ap.candidates),
+                    'hbm_rejected': ap.rejected,
+                    # the planner's own per-device residency estimate
+                    # for the chosen layout (the quantity the memviz
+                    # gate compared against the budget)
+                    'est_hbm_bytes': round(ap.chosen['hbm_bytes'], 1),
+                    'digest': ap.digest(),
+                }
+            out[name] = row
+        tight = out.get('auto_hbm_tight', {}).get('plan', {})
+        out['hbm_gate_fired'] = bool(tight.get('hbm_rejected'))
+        hand = out.get('hand_spep', {})
+        auto = out.get('auto', {})
+        if hand.get('best_step_ms') and auto.get('best_step_ms'):
+            out['auto_vs_hand_step_delta_pct'] = round(
+                100.0 * (auto['best_step_ms'] - hand['best_step_ms'])
+                / hand['best_step_ms'], 1)
+    finally:
+        fluid.set_flags(prev)
+    return out
+
+
 def _skew_job_fields(run_for):
     """The cross-rank half of bench_parallel: a real two-subprocess
     job (tests/comms_worker.py, rank 1 with a 4x batch), scraped for
@@ -1399,10 +1566,12 @@ def _run_entry(name, kwargs, timeout=900):
 
 
 def main():
-    if len(sys.argv) > 1 and sys.argv[1] == '--parallel':
+    if len(sys.argv) > 1 and sys.argv[1] in ('--parallel',
+                                             '--auto-shard'):
         # multi-device posture BEFORE the first jax import: the comms
-        # numbers need a real mesh (8 virtual CPU devices when the
-        # host has no accelerator platform configured)
+        # and placement numbers need a real mesh (8 virtual CPU
+        # devices when the host has no accelerator platform
+        # configured)
         flags = os.environ.get('XLA_FLAGS', '')
         if 'xla_force_host_platform_device_count' not in flags:
             os.environ['XLA_FLAGS'] = (
@@ -1446,6 +1615,21 @@ def main():
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--serving',
+                       'entries': [rec]}, f, indent=1, sort_keys=True)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--auto-shard':
+        # auto-sharding planner A/B: FLAGS_auto_shard=1 on an
+        # unannotated program vs the hand-placed sp/ep mesh config,
+        # interleaved, with an HBM-gate rejection arm.  Baseline
+        # recorded in BENCH_autoshard.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'BENCH_autoshard.json')
+        rec = bench_autoshard()
+        print(json.dumps(rec))
+        with open(out, 'w') as f:
+            json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
+                              '--auto-shard',
                        'entries': [rec]}, f, indent=1, sort_keys=True)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--parallel':

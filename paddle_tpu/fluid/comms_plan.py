@@ -157,13 +157,19 @@ def model_entry(kind, model=None):
 
 def predict_seconds(kind, wire_bytes, model=None):
     """Model-predicted seconds for `wire_bytes` over collective `kind`,
-    or None when the model has no entry (the caller falls back to the
-    heuristic)."""
+    or None when the model has no entry — or a PARTIAL/malformed one
+    (a hand-edited or truncated comms_model.json must degrade every
+    consumer to its heuristic, never crash the planner)."""
     entry = model_entry(kind, model)
     if not entry:
         return None
     from . import comms
-    return comms.model_predict(entry, wire_bytes)
+    try:
+        return comms.model_predict(entry, wire_bytes)
+    except (KeyError, TypeError, ValueError):
+        # entry exists but lacks latency_s/inv_bw_s_per_byte (or they
+        # are non-numeric): same contract as a missing entry
+        return None
 
 
 def digest():

@@ -187,6 +187,18 @@ _DEFAULTS = {
     # rate limits for the detector and OOM-incident flight dumps
     'FLAGS_memviz_dump_interval_s': 60.0,
     'FLAGS_memviz_oom_interval_s': 30.0,
+    # auto-sharding planner (parallel/plan.py): with the flag on, an
+    # UNANNOTATED CompiledProgram (no with_mesh / with_param_shardings)
+    # is planned automatically — regex rule -> PartitionSpec matching
+    # over its parameters emits a dp x fsdp x tp layout, candidate
+    # layouts are priced with the comms cost model and HBM-gated by
+    # the memviz budget BEFORE compiling, and the weight-update /
+    # optimizer phase shards through the existing ZeRO path
+    # (with_sharded_optimizer_states).  The plan digest folds into
+    # segment fingerprints, so plans never go stale against cached
+    # executables and unchanged plans never retrace.  Off (the
+    # default) is bit-for-bit the hand-placed behavior.
+    'FLAGS_auto_shard': False,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

@@ -236,6 +236,19 @@ def statusz():
             comms_plan_section = rep
     except Exception:
         pass
+    # auto-sharding planner (parallel/plan.py): the chosen layout per
+    # program, the priced candidate table (including HBM-gate
+    # rejections) and the plan counters — 'who placed my axes and why'
+    # in one scrape; rendered whenever the planner is on or has run
+    auto_shard_section = None
+    try:
+        from ..parallel import plan as auto_shard_plan
+        rep = auto_shard_plan.report()
+        if rep.get('enabled') or rep.get('programs') or \
+                rep['counters'].get('plan_builds'):
+            auto_shard_section = rep
+    except Exception:
+        pass
     # aggregator rank: per-rank liveness + last-heartbeat skew, so one
     # /statusz answers 'is the job healthy and who is the straggler'
     job_section = None
@@ -252,6 +265,7 @@ def statusz():
         'serving': serving_section,
         'memory': memory_section,
         'comms_plan': comms_plan_section,
+        'auto_shard': auto_shard_section,
         'job': job_section,
         'flags': _all_flags(),
         'versions': versions,

@@ -202,30 +202,40 @@ def _hint_to_spec(hint, mesh, shape):
     """Layer-stamped sharding hint (tuple over dims; each entry None, an
     axis name, or a tuple of axis names) -> PartitionSpec valid on
     `mesh`: axes absent from the mesh (or with indivisible dims) degrade
-    to replication, so one program runs on any mesh."""
+    to replication, so one program runs on any mesh.  The degrade
+    itself is the auto-sharding planner's validate_spec (one
+    implementation of the contract); a hint that degrades to full
+    replication still returns an explicit replicated spec — a stamped
+    hint is FINAL, it never falls through to a user/planner rule."""
     if len(hint) != len(shape):
         return None
-    spec = []
-    for dim, entry in zip(shape, hint):
-        if entry is None:
-            spec.append(None)
-            continue
-        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
-        keep = [a for a in axes
-                if a in mesh.axis_names and mesh.shape[a] > 1]
-        prod = 1
-        for a in keep:
-            prod *= mesh.shape[a]
-        if keep and dim % prod == 0:
-            spec.append(tuple(keep) if len(keep) > 1 else keep[0])
-        else:
-            spec.append(None)
-    return P(*spec)
+    from ..parallel.plan import validate_spec
+    spec = validate_spec(P(*hint), shape,
+                         {a: int(mesh.shape[a])
+                          for a in mesh.axis_names})
+    return spec if spec is not None else P(*([None] * len(shape)))
 
 
-def get_mesh(compiled):
+def get_mesh(compiled, program=None, feed=None):
     if getattr(compiled, '_mesh', None) is None:
-        compiled._mesh = _default_mesh(compiled._places)
+        mesh = None
+        if program is not None and \
+                getattr(compiled, '_param_sharding_rule', None) is None:
+            # auto-sharding planner (FLAGS_auto_shard): an unannotated
+            # program gets its dp x fsdp x tp mesh synthesized from
+            # the chosen layout (over the user's places when given);
+            # choose_mesh returns None when the planner is off and the
+            # default 1-axis dp mesh stands.  Mesh and plan share the
+            # CompiledProgram's lifetime: a budget/model/flag change
+            # applies to programs built after it (the lowering-flag
+            # convention), never to a live one mid-run.
+            from ..parallel import plan as _ashard
+            devices = [p.jax_device() for p in compiled._places] \
+                if compiled._places else None
+            mesh = _ashard.choose_mesh(compiled, program, feed,
+                                       devices=devices)
+        compiled._mesh = mesh if mesh is not None \
+            else _default_mesh(compiled._places)
     return _check_mesh_spans_processes(compiled._mesh)
 
 
@@ -239,7 +249,7 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     from . import framework
     fetch_names = [v.name if isinstance(v, framework.Variable) else v
                    for v in fetch_list]
-    mesh = get_mesh(compiled)
+    mesh = get_mesh(compiled, program, feed)
     ndev = mesh.devices.size
     monitor.set_gauge('parallel/device_count', ndev)
     monitor.set_gauge('parallel/process_count', jax.process_count())
@@ -257,20 +267,86 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     executor._step += 1
     fetched = {}
     param_rule = getattr(compiled, '_param_sharding_rule', None)
+    batch_axes = (mesh.axis_names[0],)
+    auto_plan = None
+    if param_rule is None:
+        from ..parallel import plan as _ashard
+        if _ashard.enabled():
+            # auto-sharding planner: rule-matched PartitionSpecs for
+            # the sharded params (None for replicated ones, so the
+            # ZeRO accumulator wrapper below still fires), the batch
+            # sharded over every data axis of the chosen layout, and
+            # the weight-update phase sharded through the EXISTING
+            # with_sharded_optimizer_states path (arXiv:2004.13336
+            # unified with ReduceStrategy.Reduce, not a parallel
+            # implementation)
+            auto_plan = _ashard.plan_for(compiled, program,
+                                         ndev=ndev, feed=feed)
+            # the execution mesh may not be the plan's own (the user
+            # hand-placed a mesh via with_mesh): re-validate every
+            # spec against the ACTUAL mesh axes, like batch_axes and
+            # update_axis below — axes the mesh lacks degrade to
+            # replication instead of crashing NamedSharding
+            mesh_sizes = {a: int(mesh.shape[a])
+                          for a in mesh.axis_names}
+
+            def param_rule(name, shape, _p=auto_plan, _ms=mesh_sizes):
+                return _ashard.validate_spec(_p.param_rule(name, shape),
+                                             shape, _ms)
+            # honor the plan's batch axes EXACTLY — () means the plan
+            # priced (and the HBM gate admitted) a replicated batch
+            # (tp-only layouts), so falling back to the mesh's first
+            # axis would execute a placement the candidate table never
+            # described
+            batch_axes = tuple(a for a in auto_plan.batch_axes
+                               if a in mesh.axis_names)
+            # the planner only sets the update axis when the user
+            # hasn't: a USER-set axis is never overridden, and a
+            # planner-set one re-validates against the actual mesh
+            # (a hand-placed with_mesh may lack the plan's axis)
+            user_set = getattr(compiled, '_shard_opt_states_axis',
+                               None) is not None and \
+                not getattr(compiled, '_auto_opt_axis', False)
+            if not user_set:
+                if auto_plan.update_axis in mesh.axis_names:
+                    compiled._shard_opt_states_axis = \
+                        auto_plan.update_axis
+                    compiled._auto_opt_axis = True
+                elif getattr(compiled, '_auto_opt_axis', False):
+                    compiled._shard_opt_states_axis = None
+                    compiled._auto_opt_axis = False
     hints = getattr(program, '_sharding_hints', None)
     if hints:
         # layer-stamped hints (moe expert weights on 'ep', attention
         # activations on 'sp') take precedence; the user rule fills in
-        # the rest
+        # the rest.  Under the auto-planner a hint whose axes ALL
+        # degraded on this mesh (e.g. 'ep' on a planner-built
+        # dp x fsdp x mp layout) falls through to the plan's rule
+        # instead of pinning replication — the plan priced and
+        # HBM-gated that rule spec, so executing anything else would
+        # falsify the gate; a USER rule keeps the hint-is-final
+        # contract
         user_rule = param_rule
 
-        def param_rule(name, shape, _u=user_rule, _h=hints):
+        def param_rule(name, shape, _u=user_rule, _h=hints,
+                       _ap=auto_plan):
             if name in _h:
                 spec = _hint_to_spec(_h[name], mesh, shape)
-                if spec is not None:
+                if spec is not None and (
+                        _ap is None or
+                        any(e is not None for e in spec)):
                     return spec
             return _u(name, shape) if _u is not None else None
     zero_axis = getattr(compiled, '_shard_opt_states_axis', None)
+    if zero_axis is not None and zero_axis not in mesh.axis_names:
+        # a pre-set axis (ReduceStrategy.Reduce defaults to 'dp') the
+        # actual mesh lacks — e.g. a planner-built dp=1 layout drops
+        # the size-1 dp axis: re-home onto the plan's update axis when
+        # one exists, else skip the accumulator sharding rather than
+        # KeyError on mesh.shape
+        zero_axis = auto_plan.update_axis if (
+            auto_plan is not None and
+            auto_plan.update_axis in mesh.axis_names) else None
     if zero_axis is not None:
         param_names = set(p.name for p in program.all_parameters())
         base_rule = param_rule
@@ -296,7 +372,8 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
             if isinstance(item, _Segment):
                 _run_segment_parallel(executor, item, feed, scope, mesh,
                                       ndev, fetched, param_rule,
-                                      batch_feeds, hints)
+                                      batch_feeds, hints, batch_axes,
+                                      auto_plan)
             else:
                 from ..ops import registry
                 op = item[1]
@@ -319,21 +396,49 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
 
 
 def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
-                          param_rule=None, batch_feeds=None, hints=None):
+                          param_rule=None, batch_feeds=None, hints=None,
+                          batch_axes=None, auto_plan=None):
     repl = NamedSharding(mesh, P())
-    dp = mesh.axis_names[0]
-    dp_size = mesh.shape[dp]
+    if batch_axes is None:
+        batch_axes = (mesh.axis_names[0],)
+    dp_size = 1
+    for a in batch_axes:
+        dp_size *= mesh.shape[a]
+    batch_spec = P(batch_axes if len(batch_axes) > 1
+                   else batch_axes[0]) if batch_axes else P()
     batch_feeds = feed if batch_feeds is None else batch_feeds
 
     def data_shard(name, val):
         if hints and name in hints and jax.process_count() == 1:
             spec = _hint_to_spec(hints[name], mesh,
                                  getattr(val, 'shape', ()))
-            if spec is not None:
+            # under the auto-planner a fully-degraded hint falls
+            # through to the plan's batch sharding (which the plan
+            # priced); a hand-placed mesh keeps hint-is-final
+            if spec is not None and (
+                    auto_plan is None or
+                    any(e is not None for e in spec)):
                 return NamedSharding(mesh, spec)
-        if name in feed and name in batch_feeds and \
-                _guard_local_batch(name, val, mesh, dp_size):
-            return NamedSharding(mesh, P(dp))
+        if name in feed and name in batch_feeds:
+            # batch_axes == () (a tp-only auto plan): the batch stays
+            # replicated, exactly as the plan priced it — but on a
+            # multi-process run feeds are process-LOCAL, so claiming
+            # replication would silently train each trainer on its
+            # own data (the _guard_local_batch hazard): raise instead
+            if batch_axes and _guard_local_batch(name, val, mesh,
+                                                 dp_size):
+                return NamedSharding(mesh, batch_spec)
+            if not batch_axes and jax.process_count() > 1 and \
+                    getattr(val, 'ndim', 0) >= 1:
+                raise ValueError(
+                    'feed %r: the auto-shard plan replicates the '
+                    'batch (no data axis on mesh %r), but feeds are '
+                    'process-local on a %d-process run — a replicated '
+                    'claim would silently train each trainer on its '
+                    'own data; choose a layout with a data axis or '
+                    'feed identical global batches'
+                    % (name, tuple(mesh.axis_names),
+                       jax.process_count()))
         return repl
 
     def state_shard(name, val):
@@ -384,11 +489,18 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         # the planner digest makes collective-planning decisions part
         # of the segment fingerprint: a flag/model change retraces
         # exactly once, an unchanged plan never retraces
+        # the auto-shard digest keys the executable by the plan that
+        # produced it (plan specs already ride repr(in_shardings);
+        # the digest covers the flag/rules/model/budget inputs), so a
+        # plan change retraces exactly once and an unchanged plan
+        # never retraces
         from . import comms_plan
+        from ..parallel import plan as _ashard
         fp = compile_cache.fingerprint(
             seg.ops,
             (_mesh_fingerprint_key(mesh), repr(in_shardings),
-             comms_plan.digest()),
+             comms_plan.digest(), _ashard.digest(),
+             auto_plan.digest() if auto_plan is not None else None),
             _lowering_flag_items(False, False),
             donate=True, purpose='parallel')
         compiled = compile_cache.plane().shared_jit(
@@ -554,10 +666,12 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
             # mesh; folding the digest in keys the executable (and its
             # comms records) by the plan that produced it
             from . import comms_plan
+            from ..parallel import plan as _ashard
             fp = compile_cache.fingerprint(
                 seg.ops,
                 (_mesh_fingerprint_key(mesh), repr(in_specs),
-                 repr(out_specs), comms_plan.digest()),
+                 repr(out_specs), comms_plan.digest(),
+                 _ashard.digest()),
                 _lowering_flag_items(False, False),
                 donate=True, purpose='collective')
 

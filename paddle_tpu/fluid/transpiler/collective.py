@@ -84,6 +84,14 @@ class GradAllReduce(Collective):
     def _transpile_main_program(self):
         from .. import comms_plan
         from ..flags import get_flag
+        # auto-sharding planner (FLAGS_auto_shard): the collective
+        # rewrite is rank-per-process data parallelism, so the layout
+        # space collapses to (nranks, 1, 1) — still priced, HBM-gated
+        # and registered (parallel/plan_* counters + the /statusz
+        # auto_shard section on every rank); transpile_plan is a no-op
+        # with the flag off, keeping the v1.6 rewrite untouched
+        from ...parallel import plan as auto_shard_plan
+        auto_shard_plan.transpile_plan(self.main_program, self.nranks)
         block = self.main_program.global_block()
         grad_names = []
         for op in block.ops:

@@ -1,7 +1,12 @@
 """Parallelism beyond data parallel — NEW capability vs the reference
 (SURVEY.md §2.4 'NOT present': TP/SP/ring attention).
 
-- mesh.py:           mesh construction (dp/mp/pp/sp axes) + registry
+- mesh.py:           mesh construction (dp/fsdp/mp/pp/sp/ep axes) + registry
+- plan.py:           auto-sharding planner (rule -> PartitionSpec layouts,
+                     cost-model-priced candidates, memviz HBM gate,
+                     automatic weight-update sharding; FLAGS_auto_shard) —
+                     imported lazily (it needs fluid; this package loads
+                     before fluid does): `from paddle_tpu.parallel import plan`
 - ring_attention.py: context parallelism via ppermute ring
 - ulysses.py:        sequence parallelism via all_to_all head exchange
 - pipeline.py:       microbatch pipeline over a 'pp' axis

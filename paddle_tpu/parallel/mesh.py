@@ -13,22 +13,24 @@ from jax.sharding import Mesh
 
 _GLOBAL_MESH = None
 
-# canonical axis order: data, model(tensor), pipeline, sequence, expert
-AXES = ('dp', 'mp', 'pp', 'sp', 'ep')
+# canonical axis order: data, fully-sharded-data (parameter scatter —
+# the auto-sharding planner's ZeRO/weight-update axis), model(tensor),
+# pipeline, sequence, expert
+AXES = ('dp', 'fsdp', 'mp', 'pp', 'sp', 'ep')
 
 
-def create_mesh(dp=None, mp=1, pp=1, sp=1, ep=1, devices=None):
+def create_mesh(dp=None, mp=1, pp=1, sp=1, ep=1, fsdp=1, devices=None):
     """Build a mesh over the available devices.  dp defaults to
     'whatever remains'.  Axis sizes must multiply to the device count."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    rest = mp * pp * sp * ep
+    rest = mp * pp * sp * ep * fsdp
     if dp is None:
         if n % rest:
             raise ValueError('device count %d not divisible by %d'
                              % (n, rest))
         dp = n // rest
-    sizes = dict(dp=dp, mp=mp, pp=pp, sp=sp, ep=ep)
+    sizes = dict(dp=dp, fsdp=fsdp, mp=mp, pp=pp, sp=sp, ep=ep)
     total = int(np.prod(list(sizes.values())))
     if total != n:
         raise ValueError('mesh %s needs %d devices, have %d'

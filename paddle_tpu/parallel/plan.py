@@ -1,0 +1,810 @@
+"""Auto-sharding planner: rule-driven PartitionSpec layouts priced by
+the comms cost model and gated by the device-memory plane.
+
+ROADMAP item 1 ("close the loops and remove the hand-placement"): the
+parallel plane has dp/sp/ep meshes, ZeRO via ``ReduceStrategy.Reduce``,
+ring attention and a calibrated comms cost model — but the user still
+hand-places every axis.  This module takes an **unannotated** Program
+and emits a full dp x fsdp x tp sharding:
+
+1. **Rules.**  ``match_partition_rules(rules, params)`` matches each
+   parameter (name, shape) against an ordered ``[(regex, spec)]`` list
+   — the first hit wins; spec entries may be callables ``(name, shape)
+   -> PartitionSpec | None`` so one rule can split column-parallel
+   (out >= in) from row-parallel (out < in) fc weights by shape.  The
+   built-in ``default_rules()`` cover the transformer/BERT/GPT
+   parameter naming this repo's layers produce (``fc_N.w_K``,
+   ``embedding_N.w_K`` / ``gpt_wte``, ``moe_N.w_K``, ``layer_norm`` /
+   biases / conv kernels replicated).  Specs are validated against the
+   actual mesh: axes absent (or size 1) degrade to replication, as do
+   indivisible dims — one rule set runs on any mesh
+   (``parallel_executor._hint_to_spec`` semantics).
+
+2. **Priced candidates.**  ``build_plan`` enumerates every
+   (dp, fsdp, tp) factorization of the device count and prices each
+   candidate's per-step collective schedule with
+   ``comms.model_predict`` over the calibrated ``comms_model.json``
+   (arXiv:2110.10548's cost-model-driven synthesis): gradient
+   allreduce over the replicated extent, fsdp weight allgather +
+   gradient reduce-scatter, tp activation allreduce per row-parallel
+   weight, plus a compute proxy that rewards batch sharding.  A
+   missing/partial model NEVER fails the plan: the affected term
+   degrades to heuristic byte-count pricing and is counted
+   (``parallel/plan_unpriced`` — the PR-8 ``comms/plan_unpriced``
+   honesty convention).
+
+3. **HBM gate.**  Each candidate's per-device residency (params +
+   grads + optimizer moments under the candidate's sharding +
+   activation proxy) is checked against the memviz budget
+   (``memviz.budget_bytes()`` / ``FLAGS_memviz_budget_bytes``) BEFORE
+   anything compiles; when the program already has a per-program peak
+   attribution row (``memviz.peak_bytes``), the measured peak
+   calibrates the activation term.  Over-budget layouts are rejected
+   (``parallel/plan_hbm_rejected``) and never traced.
+
+4. **Weight-update sharding** (arXiv:2004.13336, "Automatic
+   Cross-Replica Sharding of Weight Update Computation"): the chosen
+   plan names an ``update_axis`` and the runner applies it through the
+   EXISTING ZeRO path (``CompiledProgram.with_sharded_optimizer_states``
+   / ``_shard_opt_states_axis`` — the ``ReduceStrategy.Reduce``
+   rendering), not a parallel implementation: optimizer accumulators
+   shard over the fsdp axis when one exists, else over dp.
+
+**Fingerprint honesty.**  ``digest()`` folds the flag, the rule-set
+identity, the comms-model identity and the power-of-two-bucketed HBM
+budget into a string both runners add to their segment fingerprints
+(the ``comms_plan.digest()`` pattern): a flag/model/budget change
+retraces exactly once, an unchanged plan never retraces — and the
+chosen specs themselves already key the executable via the runners'
+``repr(in_shardings)`` fingerprint component.
+
+Observability: ``parallel/plan_*`` counters, ``parallel/plan_layout_*``
+gauges, a bounded per-program plan registry ``report()`` renders as the
+``/statusz`` ``auto_shard`` section, and ``stat_summary.py
+--autoshard`` offline.
+
+No jax imports at module level (hot-path discipline, like comms_plan);
+planning runs once per (program, mesh), never per step.
+"""
+
+import hashlib
+import re
+import threading
+import time
+
+import numpy as np
+
+from ..fluid import monitor
+from ..fluid.flags import get_flag
+
+__all__ = [
+    'SpecLayout', 'default_rules', 'match_partition_rules',
+    'validate_spec', 'enumerate_layouts', 'build_plan', 'Plan',
+    'enabled', 'digest', 'plan_for', 'choose_mesh', 'report', 'reset',
+]
+
+_lock = threading.Lock()
+# program label -> plan summary (bounded, insertion-ordered): the
+# /statusz auto_shard section
+_PLANS = {}
+_PLANS_CAP = 64
+
+# params below this many bytes are never worth scattering (the
+# allgather latency dwarfs the residency win)
+MIN_SHARD_BYTES = 1024
+# compute proxy: seconds per (param element x token) of matmul work —
+# only the RANKING between candidates matters, not the absolute scale
+_FLOP_SECONDS = 1.0 / 1e12
+# heuristic byte pricing when the cost model has no entry for a
+# collective: a flat launch latency plus wire bytes at a nominal
+# fabric bandwidth (the "byte-count pricing" fallback)
+_HEUR_LATENCY_S = 20e-6
+_HEUR_BW_BYTES_PER_S = 10e9
+# grads are transient but alive alongside params at the update;
+# optimizer moments counted per _opt_state_multiplier
+_ACT_BYTES_PER_TOKEN_FACTOR = 2.0   # fwd + bwd activation residency
+
+
+def reset():
+    """Drop the plan registry (tests)."""
+    with _lock:
+        _PLANS.clear()
+
+
+def enabled():
+    return bool(get_flag('FLAGS_auto_shard', False))
+
+
+# ------------------------------------------------------------ rule layer
+class SpecLayout(object):
+    """Canonical PartitionSpecs aligned with this repo's mesh axes
+    (SNIPPETS.md [2]): data batch on 'dp', parameter scatter on
+    'fsdp', tensor parallelism on 'mp' (the repo's model axis)."""
+
+    __slots__ = ('data_axis', 'fsdp_axis', 'tp_axis')
+
+    def __init__(self, data_axis='dp', fsdp_axis='fsdp', tp_axis='mp'):
+        self.data_axis = data_axis
+        self.fsdp_axis = fsdp_axis
+        self.tp_axis = tp_axis
+
+    def _ps(self, *spec):
+        from jax.sharding import PartitionSpec as P
+        return P(*spec)
+
+    def embedding(self):
+        """Embedding tables: vocab rows scattered over fsdp x tp."""
+        return self._ps((self.fsdp_axis, self.tp_axis), None)
+
+    def col_weight(self):
+        """Column-parallel fc (qkv / ffn-up / lm head): rows on fsdp,
+        output columns on tp."""
+        return self._ps(self.fsdp_axis, self.tp_axis)
+
+    def row_weight(self):
+        """Row-parallel fc (attention out / ffn-down): input rows on
+        tp, columns on fsdp."""
+        return self._ps(self.tp_axis, self.fsdp_axis)
+
+    def expert_weight(self):
+        """3D expert stacks [E, ...]: experts scattered over fsdp (an
+        'ep' mesh hint, when present, takes precedence in the
+        runner)."""
+        return self._ps(self.fsdp_axis, None, None)
+
+    def replicated(self):
+        return None
+
+
+def default_rules(layout=None):
+    """The built-in rule set for this repo's layer naming (LayerHelper
+    generates ``<layer>_N.w_K``; gpt names its tied embedding
+    ``gpt_wte``).  Ordered; first match wins; a None result falls
+    through to the next rule."""
+    lay = layout or SpecLayout()
+
+    def fc_weight(name, shape):
+        if len(shape) != 2:
+            return None
+        rows, cols = int(shape[0]), int(shape[1])
+        if rows <= 1 or cols <= 1:
+            return None
+        # column-parallel when the layer widens (qkv 3h, ffn 4h,
+        # vocab head), row-parallel when it narrows back
+        return lay.col_weight() if cols >= rows else lay.row_weight()
+
+    def embed_weight(name, shape):
+        return lay.embedding() if len(shape) == 2 else None
+
+    def expert_weight(name, shape):
+        return lay.expert_weight() if len(shape) == 3 else None
+
+    return [
+        (r'gpt_wte|embedding_\d+\.w_\d+', embed_weight),
+        (r'moe[\w.]*\.w_\d+', expert_weight),
+        (r'(fc|mul)_\d+\.w_\d+', fc_weight),
+        # norms, biases, conv kernels, scalars: replicated
+        (r'.*', lambda name, shape: None),
+    ]
+
+
+def validate_spec(spec, shape, axis_sizes):
+    """Degrade a PartitionSpec to what `axis_sizes` ({axis: size}) and
+    `shape` admit: axes absent or size 1 drop, a dim whose kept-axes
+    product does not divide it replicates — the _hint_to_spec contract,
+    so one rule set runs on any mesh.  Returns a PartitionSpec or None
+    (fully replicated)."""
+    if spec is None:
+        return None
+    from jax.sharding import PartitionSpec as P
+    out = []
+    for dim, entry in zip(tuple(shape), tuple(spec)):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        keep = [a for a in axes if int(axis_sizes.get(a, 1)) > 1]
+        prod = 1
+        for a in keep:
+            prod *= int(axis_sizes[a])
+        if keep and int(dim) > 0 and int(dim) % prod == 0:
+            out.append(tuple(keep) if len(keep) > 1 else keep[0])
+        else:
+            out.append(None)
+    # pad unmentioned trailing dims as replicated
+    while len(out) < len(tuple(shape)):
+        out.append(None)
+    if all(e is None for e in out):
+        return None
+    return P(*out)
+
+
+def match_partition_rules(rules, params, axis_sizes=None):
+    """{name: PartitionSpec | None} for `params` ([(name, shape)] or
+    {name: shape}) under ordered `rules` ([(regex, PartitionSpec or
+    callable(name, shape))]).  Scalars and single-element params are
+    never partitioned (SNIPPETS.md [3]).  With `axis_sizes` the specs
+    are validated/degraded against that mesh."""
+    if isinstance(params, dict):
+        params = list(params.items())
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    out = {}
+    for name, shape in params:
+        shape = tuple(int(s) for s in (shape or ()))
+        spec = None
+        if shape and int(np.prod([max(s, 1) for s in shape])) > 1:
+            for pat, rule in compiled:
+                if pat.search(name) is None:
+                    continue
+                spec = rule(name, shape) if callable(rule) else rule
+                if spec is not None:
+                    break
+        if axis_sizes is not None:
+            spec = validate_spec(spec, shape, axis_sizes)
+        out[name] = spec
+    return out
+
+
+# ------------------------------------------------------- program inventory
+def _param_inventory(program):
+    """[(name, shape, nbytes, itemsize)] for the program's parameters
+    (static shapes; -1 dims never appear on params)."""
+    out = []
+    for p in program.all_parameters():
+        shape = tuple(int(s) for s in (getattr(p, 'shape', ()) or ()))
+        try:
+            dt = np.dtype(p.dtype)
+        except Exception:
+            dt = np.dtype('float32')
+        elems = int(np.prod([max(s, 1) for s in shape])) if shape else 1
+        out.append((p.name, shape, elems * dt.itemsize, dt.itemsize))
+    return out
+
+
+_OPT_STATES = {'sgd': 0, 'momentum': 1, 'lars_momentum': 1,
+               'adagrad': 1, 'rmsprop': 1, 'adam': 2, 'adamw': 2,
+               'lamb': 2}
+
+
+def _opt_state_multiplier(program):
+    """Optimizer moments per param byte, from the program's update
+    ops (adam keeps 2 fp32 moments, momentum 1, sgd none)."""
+    mult = 0
+    for op in program.global_block().ops:
+        if op.type in _OPT_STATES:
+            mult = max(mult, _OPT_STATES[op.type])
+    return mult
+
+
+def _batch_tokens(program, feed_shapes):
+    """(tokens, batch) of the largest batch feed: `tokens` is the
+    leading-dims product (the compute / activation scale), `batch` is
+    dim 0 — the ONLY dim the runner actually shards
+    (_guard_local_batch), so candidate shardability is judged on it,
+    not on the token product.  `feed_shapes` ({name: shape}) comes
+    from the actual feed when the runner plans at first step; falls
+    back to the program's declared feed shapes, where an unknown (-1)
+    batch dim reads as batch 0 = 'assume divisible' (the
+    transpile-time posture)."""
+    toks, batch = 1, 0
+    feed_shapes = feed_shapes or {}
+    blk = program.global_block()
+    names = set(feed_shapes)
+    try:
+        for op in blk.ops:
+            if op.type == 'feed':
+                names.update(op.output_arg_names)
+    except Exception:
+        pass
+    for n in names:
+        shape = feed_shapes.get(n)
+        if shape is None:
+            try:
+                shape = tuple(getattr(blk.var(n), 'shape', ()) or ())
+            except Exception:
+                shape = ()
+        if not shape:
+            continue
+        lead = [int(s) for s in shape[:-1]] or [int(shape[0])]
+        t = int(np.prod([max(s, 1) for s in lead]))
+        if t > toks:
+            toks = t
+            batch = max(0, int(shape[0]))
+    return toks, batch
+
+
+# ------------------------------------------------------ candidate layouts
+def enumerate_layouts(ndev):
+    """Every (dp, fsdp, tp) triple whose product is `ndev`,
+    deterministically ordered dp-heaviest first (the tie-break the
+    chooser inherits)."""
+    ndev = max(1, int(ndev))
+    out = []
+    for dp in range(ndev, 0, -1):
+        if ndev % dp:
+            continue
+        rest = ndev // dp
+        for fsdp in range(rest, 0, -1):
+            if rest % fsdp:
+                continue
+            out.append((dp, fsdp, rest // fsdp))
+    return out
+
+
+def _predict(kind, wire, model, unpriced):
+    """Model-predicted seconds for `wire` bytes over `kind`, degrading
+    to heuristic byte-count pricing (and counting the degradation)
+    when comms_model.json is absent or has no entry — the planner
+    never crashes on a missing model."""
+    if wire <= 0:
+        return 0.0
+    pred = None
+    try:
+        from ..fluid import comms_plan as _cp
+        pred = _cp.predict_seconds(kind, wire, model)
+    except Exception:
+        pred = None
+    if pred is None:
+        unpriced[0] += 1
+        return _HEUR_LATENCY_S + wire / _HEUR_BW_BYTES_PER_S
+    return float(pred)
+
+
+def _effective_spec(name, shape, specs_by_name, hints, axis_sizes):
+    """The spec a param will ACTUALLY execute under on a mesh with
+    `axis_sizes`: a layer-stamped hint (program._sharding_hints, e.g.
+    moe expert weights on 'ep') takes precedence when any of its axes
+    survive this mesh, else the rule-matched spec — mirroring the
+    runner's hint-first wrapper, so pricing and the HBM gate describe
+    the shardings that really run."""
+    h = hints.get(name) if hints else None
+    if h is not None and len(tuple(h)) == len(tuple(shape)):
+        sp = validate_spec(h, shape, axis_sizes)
+        if sp is not None:
+            return sp
+    return validate_spec(specs_by_name.get(name), shape, axis_sizes)
+
+
+def _shard_factor(spec, axis_sizes):
+    f = 1
+    if spec is None:
+        return 1
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, (tuple, list))
+                  else (entry,)):
+            f *= int(axis_sizes.get(a, 1))
+    return f
+
+
+def _price_layout(layout, inv, specs_by_name, tokens, batch, opt_mult,
+                  act_residual, model, lay, hints=None, nproc=1):
+    """One candidate's per-step cost estimate + per-device HBM
+    residency.  Returns {'cost_s', 'comm_s', 'compute_s',
+    'wire_bytes', 'hbm_bytes', 'unpriced'}."""
+    from ..fluid import comms
+    dp, fsdp, tp = layout
+    ndev = dp * fsdp * tp
+    axis_sizes = {lay.data_axis: dp, lay.fsdp_axis: fsdp,
+                  lay.tp_axis: tp}
+    batch_extent = max(1, dp * fsdp)
+    # the runner shards ONLY the batch dim (dim 0), one per-process
+    # slice of the data axes: judge shardability exactly as
+    # _guard_local_batch will, on the batch dim — NOT on the token
+    # product, which is divisible far more often and would price (and
+    # HBM-admit) splits the execution silently replicates.  batch 0 =
+    # unknown/dynamic (-1 declared dim): assume divisible.
+    if nproc > 1:
+        shardable = batch_extent % nproc == 0 and (
+            batch <= 0 or batch % (batch_extent // nproc) == 0)
+    else:
+        shardable = batch <= 0 or batch % batch_extent == 0
+    tok_dev = tokens / batch_extent if shardable else float(tokens)
+    unpriced = [0]
+    comm_s = wire_total = 0.0
+    hbm = 0.0
+    total_elems = 0
+    for name, shape, nbytes, itemsize in inv:
+        total_elems += nbytes // max(1, itemsize)
+        spec = _effective_spec(name, shape, specs_by_name, hints,
+                               axis_sizes)
+        f = _shard_factor(spec, axis_sizes)
+        shard_b = nbytes / f
+        # residency: param + grad shards, moments over the update axis
+        # (rule-sharded params carry their moments at the same factor;
+        # replicated params' moments ride the ZeRO update_axis shard
+        # when dim0 divides it — arXiv:2004.13336 through the
+        # with_sharded_optimizer_states path)
+        u = fsdp if fsdp > 1 else dp
+        opt_f = f if f > 1 else (
+            u if shape and shape[0] > 1 and shape[0] % u == 0 else 1)
+        hbm += shard_b * 2.0 + opt_mult * nbytes / opt_f
+        # gradient reduction over the replicated extent
+        r = max(1, ndev // f)
+        if r > 1:
+            w = comms.wire_bytes('allreduce', shard_b, r)
+            comm_s += _predict('allreduce', w, model, unpriced)
+            wire_total += w
+        if spec is not None:
+            dim_axes = []
+            axes_used = set()
+            for entry in tuple(spec):
+                axes = tuple((entry if isinstance(entry, (tuple, list))
+                              else (entry,)) if entry else ())
+                dim_axes.append(axes)
+                axes_used.update(axes)
+            if lay.fsdp_axis in axes_used and fsdp > 1:
+                # fsdp scatter: gather the weight fwd+bwd, scatter the
+                # grad back
+                # each fsdp group gathers/scatters only ITS slice of
+                # the other axes: the grad a tp-sharded weight
+                # reduce-scatters is nbytes/tp (= shard_b * fsdp), not
+                # the full tensor — pricing the full bytes would
+                # penalize combined fsdp x tp layouts by tp x
+                w_ag = comms.wire_bytes('allgather', shard_b, fsdp)
+                w_rs = comms.wire_bytes('reducescatter',
+                                        shard_b * fsdp, fsdp)
+                comm_s += 2.0 * _predict('allgather', w_ag, model,
+                                         unpriced)
+                comm_s += _predict('reducescatter', w_rs, model,
+                                   unpriced)
+                wire_total += 2.0 * w_ag + w_rs
+            if tp > 1 and len(shape) >= 2 and \
+                    lay.tp_axis in axes_used:
+                # tensor parallelism is never free on activations:
+                # an input-dim (row-parallel / embedding-row) shard
+                # allreduces the partial outputs, an output-dim
+                # (column-parallel) shard allgathers them downstream
+                # — tokens x out-columns bytes either way
+                act_b = tok_dev * max(1, shape[-1]) * itemsize
+                if lay.tp_axis in dim_axes[0]:
+                    w_act = comms.wire_bytes('allreduce', act_b, tp)
+                    comm_s += _predict('allreduce', w_act, model,
+                                       unpriced)
+                else:
+                    w_act = comms.wire_bytes('allgather',
+                                             act_b / tp, tp)
+                    comm_s += _predict('allgather', w_act, model,
+                                       unpriced)
+                wire_total += w_act
+    compute_s = 2.0 * total_elems * tok_dev * _FLOP_SECONDS
+    hbm += act_residual / (batch_extent if shardable else 1) \
+        + _ACT_BYTES_PER_TOKEN_FACTOR * tok_dev * 4.0
+    return {'cost_s': comm_s + compute_s, 'comm_s': comm_s,
+            'compute_s': compute_s, 'wire_bytes': wire_total,
+            'hbm_bytes': hbm, 'unpriced': unpriced[0],
+            'batch_shardable': shardable}
+
+
+# --------------------------------------------------------------- the plan
+class Plan(object):
+    """One program's chosen layout: the (dp, fsdp, tp) mesh, the
+    per-param PartitionSpecs, the batch axes, the weight-update
+    sharding axis, and the priced-candidate table that justified it."""
+
+    __slots__ = ('label', 'layout', 'specs', 'layout_axes',
+                 'update_axis', 'batch_axes', 'candidates', 'chosen',
+                 'rejected', '_digest')
+
+    def __init__(self, label, layout, specs, lay, candidates,
+                 chosen, rejected):
+        self.label = label
+        self.layout = layout            # (dp, fsdp, tp)
+        self.specs = specs              # {param: PartitionSpec|None}
+        self.layout_axes = lay
+        dp, fsdp, tp = layout
+        # execution-honest: when the batch dim cannot split over the
+        # chosen dp x fsdp extent the runner replicates it
+        # (_guard_local_batch), and the plan must say so — that is
+        # what this layout was priced at
+        self.batch_axes = tuple(
+            a for a, s in ((lay.data_axis, dp), (lay.fsdp_axis, fsdp))
+            if s > 1) if chosen.get('batch_shardable', True) else ()
+        self.update_axis = lay.fsdp_axis if fsdp > 1 else (
+            lay.data_axis if dp > 1 else None)
+        self.candidates = candidates
+        self.chosen = chosen
+        self.rejected = rejected
+        self._digest = None
+
+    def param_rule(self, name, shape):
+        """The runner's ``_param_sharding_rule`` form: the matched
+        spec for sharded params, None for replicated ones — None (not
+        P()) so the ZeRO accumulator wrapper still applies to
+        replicated-param moments."""
+        return self.specs.get(name)
+
+    def mesh_sizes(self):
+        dp, fsdp, tp = self.layout
+        return {self.layout_axes.data_axis: dp,
+                self.layout_axes.fsdp_axis: fsdp,
+                self.layout_axes.tp_axis: tp}
+
+    def build_mesh(self, devices=None):
+        """A jax Mesh realizing the layout (size-1 axes dropped, like
+        parallel.mesh.create_mesh; pure-replicated plans keep a
+        1-axis dp mesh)."""
+        import jax
+        from jax.sharding import Mesh
+        devices = devices if devices is not None else jax.devices()
+        axes = [(a, s) for a, s in
+                ((self.layout_axes.data_axis, self.layout[0]),
+                 (self.layout_axes.fsdp_axis, self.layout[1]),
+                 (self.layout_axes.tp_axis, self.layout[2]))
+                if s > 1] or [(self.layout_axes.data_axis, 1)]
+        shape = tuple(s for _, s in axes)
+        arr = np.array(devices[:int(np.prod(shape))]).reshape(shape)
+        return Mesh(arr, tuple(a for a, _ in axes))
+
+    def digest(self):
+        """Deterministic digest of everything the plan decided —
+        folded (with the global digest()) into segment fingerprints so
+        an executable can never be reused under a different plan."""
+        if self._digest is None:
+            spec_sig = ';'.join('%s=%s' % (n, self.specs[n])
+                                for n in sorted(self.specs))
+            raw = 'layout=%r,batch=%r,update=%r,%s' % (
+                self.layout, self.batch_axes, self.update_axis,
+                spec_sig)
+            self._digest = 'auto_plan(%s)' % hashlib.sha256(
+                raw.encode()).hexdigest()[:16]
+        return self._digest
+
+    def summary(self):
+        dp, fsdp, tp = self.layout
+        sharded = sorted(n for n, s in self.specs.items()
+                         if s is not None)
+        return {
+            'layout': {'dp': dp, 'fsdp': fsdp, 'tp': tp},
+            'batch_axes': list(self.batch_axes),
+            'update_axis': self.update_axis,
+            'digest': self.digest(),
+            'params_sharded': len(sharded),
+            'params_replicated': len(self.specs) - len(sharded),
+            'sharded': [{'name': n, 'spec': str(self.specs[n])}
+                        for n in sharded[:16]],
+            'chosen': self.chosen,
+            'candidates': self.candidates,
+            'hbm_rejected': self.rejected,
+        }
+
+
+def _rules_signature(rules):
+    sig = []
+    for pat, spec in rules:
+        tag = getattr(spec, '__name__', None) if callable(spec) \
+            else str(spec)
+        sig.append('%s->%s' % (pat, tag))
+    return hashlib.sha256('|'.join(sig).encode()).hexdigest()[:12]
+
+
+# digest() runs per step (plan_for's cache key): the default-rule
+# signature is constant per process, and the model-content hash is
+# keyed by the cached model OBJECT load_model returns (same object
+# until the file changes; holding the ref keeps id() unambiguous)
+_default_rules_sig = []
+_model_hash_memo = {'model': None, 'hash': 'none'}
+
+
+def _default_rules_signature():
+    if not _default_rules_sig:
+        _default_rules_sig.append(_rules_signature(default_rules()))
+    return _default_rules_sig[0]
+
+
+def _model_content_hash(model):
+    if model is None:
+        return 'none'
+    if model is _model_hash_memo['model']:
+        return _model_hash_memo['hash']
+    import json as _json
+    h = hashlib.sha256(_json.dumps(
+        model, sort_keys=True).encode()).hexdigest()[:8]
+    _model_hash_memo['model'] = model
+    _model_hash_memo['hash'] = h
+    return h
+
+
+def _budget_bytes(budget=None):
+    """The HBM gate's budget: explicit arg, else the memviz plane's
+    (FLAGS_memviz_budget_bytes or the device's reported limit); None
+    disables the gate (CPU reports nothing)."""
+    if budget is not None:
+        return float(budget) or None
+    try:
+        from ..fluid import memviz
+        return memviz.budget_bytes()
+    except Exception:
+        return None
+
+
+def digest():
+    """The GLOBAL auto-shard fingerprint component both runners fold
+    into segment fingerprints (comms_plan.digest() pattern): flag off
+    is a constant; on, it captures every plan input besides the
+    program itself — the rule-set identity, the comms-model identity,
+    and the power-of-two-bucketed HBM budget — so plans never go stale
+    against cached executables and unchanged plans never retrace."""
+    if not enabled():
+        return 'auto_shard(off)'
+    try:
+        from ..fluid import comms_plan as _cp
+        # hash the model CONTENTS (sort_keys makes it deterministic):
+        # a recalibration that keeps the same collective names but new
+        # alpha/beta values must change the fingerprint, or cached
+        # executables would keep running a stale plan
+        mid = _model_content_hash(_cp.load_model())
+    except Exception:
+        mid = 'none'
+    budget = _budget_bytes()
+    hb = 'off' if not budget else str(int(budget).bit_length())
+    return 'auto_shard(on,rules=%s,model=%s,budget=%s)' % (
+        _default_rules_signature(), mid, hb)
+
+
+def build_plan(program, ndev=None, feed_shapes=None, budget=None,
+               rules=None, layout=None, layouts=None, label=None):
+    """Plan one program: match rules, enumerate + price + HBM-gate the
+    candidate layouts, choose the cheapest admissible one.  Pure in
+    (program, ndev, feed_shapes, flags, model file, budget); never
+    raises on a missing/partial cost model (heuristic pricing,
+    counted) and never returns None — with every candidate over
+    budget the smallest-footprint one is kept (counted, reported), so
+    training proceeds and the operator sees the squeeze."""
+    t0 = time.perf_counter()
+    if ndev is None:
+        import jax
+        ndev = len(jax.devices())
+    lay = layout or SpecLayout()
+    rules = rules if rules is not None else default_rules(lay)
+    inv = _param_inventory(program)
+    raw_specs = match_partition_rules(
+        rules, [(n, s) for n, s, _b, _i in inv])
+    small = {n for n, _s, b, _i in inv if b < MIN_SHARD_BYTES}
+    raw_specs = {n: (None if n in small else sp)
+                 for n, sp in raw_specs.items()}
+    hints = getattr(program, '_sharding_hints', None) or {}
+    tokens, batch = _batch_tokens(program, feed_shapes)
+    opt_mult = _opt_state_multiplier(program)
+    try:
+        import jax
+        nproc = jax.process_count()
+    except Exception:
+        nproc = 1
+    try:
+        from ..fluid import comms_plan as _cp
+        model = _cp.load_model()
+    except Exception:
+        model = None
+    # measured-peak calibration: a prior run's attribution row (any
+    # layout) bounds the activation/temp residual the static param
+    # terms miss
+    act_residual = 0.0
+    try:
+        from ..fluid import memviz
+        lbl = label or memviz.program_label(program)
+        measured = memviz.peak_bytes(lbl)
+        if measured:
+            static_repl = sum(b * (2.0 + opt_mult)
+                              for _n, _s, b, _i in inv)
+            act_residual = max(0.0, float(measured) - static_repl)
+    except Exception:
+        lbl = label or 'program'
+    budget = _budget_bytes(budget)
+    cands = []
+    rejected = 0
+    unpriced_total = 0
+    for lo in (layouts if layouts is not None
+               else enumerate_layouts(ndev)):
+        priced = _price_layout(lo, inv, raw_specs, tokens, batch,
+                               opt_mult, act_residual, model, lay,
+                               hints, nproc)
+        unpriced_total += priced['unpriced']
+        admissible = budget is None or priced['hbm_bytes'] <= budget
+        if not admissible:
+            rejected += 1
+        cands.append(dict(priced, layout=list(lo),
+                          admissible=admissible))
+    pool = [c for c in cands if c['admissible']] or \
+        sorted(cands, key=lambda c: c['hbm_bytes'])[:1]
+    chosen = min(pool, key=lambda c: (c['cost_s'], -c['layout'][0]))
+    lo = tuple(chosen['layout'])
+    axis_sizes = {lay.data_axis: lo[0], lay.fsdp_axis: lo[1],
+                  lay.tp_axis: lo[2]}
+    specs = {n: _effective_spec(n, s, raw_specs, hints, axis_sizes)
+             for n, s, _b, _i in inv}
+    plan = Plan(lbl, lo, specs, lay, cands, chosen, rejected)
+    # observability: counters + gauges + the /statusz registry
+    monitor.add('parallel/plan_builds')
+    monitor.add('parallel/plan_candidates', float(len(cands)))
+    if rejected:
+        monitor.add('parallel/plan_hbm_rejected', float(rejected))
+    if unpriced_total:
+        # cost model absent/partial: the priced terms degraded to
+        # heuristic byte-count pricing (PR-8 honesty convention)
+        monitor.add('parallel/plan_unpriced', float(unpriced_total))
+    monitor.add('parallel/plan_params_sharded',
+                float(sum(1 for s in specs.values() if s is not None)))
+    monitor.add('parallel/plan_params_replicated',
+                float(sum(1 for s in specs.values() if s is None)))
+    monitor.set_gauge('parallel/plan_layout_dp', float(lo[0]))
+    monitor.set_gauge('parallel/plan_layout_fsdp', float(lo[1]))
+    monitor.set_gauge('parallel/plan_layout_tp', float(lo[2]))
+    monitor.observe('parallel/plan_seconds',
+                    time.perf_counter() - t0)
+    with _lock:
+        if lbl not in _PLANS and len(_PLANS) >= _PLANS_CAP:
+            _PLANS.pop(next(iter(_PLANS)))
+        _PLANS[lbl] = plan.summary()
+    return plan
+
+
+# ----------------------------------------------------- runner integration
+def plan_for(compiled, program, ndev=None, feed=None):
+    """The run_parallel entry: build (or reuse) the auto plan for a
+    CompiledProgram.  Cached on the compiled object for its LIFETIME —
+    the chosen specs must be byte-stable across steps so the segment
+    jit never retraces, and a live segment's executable memo keeps the
+    plan it was traced with (the same contract every lowering flag and
+    comms_plan follow): a budget/model/rules change applies to
+    CompiledPrograms built AFTER the change, where digest() in the
+    segment fingerprints guarantees the rebuilt program cannot reuse
+    an executable traced under the old plan."""
+    plan = getattr(compiled, '_auto_plan', None)
+    if plan is not None:
+        monitor.add('parallel/plan_reused')
+        return plan
+    feed_shapes = None
+    if feed:
+        feed_shapes = {n: tuple(np.shape(getattr(v, 'data', v)))
+                       for n, v in feed.items()}
+    plan = build_plan(program, ndev=ndev, feed_shapes=feed_shapes)
+    compiled._auto_plan = plan
+    return plan
+
+
+def choose_mesh(compiled, program, feed=None, devices=None):
+    """Mesh synthesis for an UNANNOTATED CompiledProgram (no
+    with_mesh): build the plan over every device and realize its
+    layout as the execution mesh.  Returns None when planning is
+    disabled (the caller keeps the default 1-axis dp mesh)."""
+    if not enabled():
+        return None
+    import jax
+    devices = devices if devices is not None else jax.devices()
+    plan = plan_for(compiled, program, ndev=len(devices), feed=feed)
+    return plan.build_mesh(devices)
+
+
+def transpile_plan(program, nranks):
+    """The GradAllReduce transpiler's hook: the collective rewrite is
+    rank-per-process data parallelism, so the layout space collapses
+    to (nranks, 1, 1) — still priced, HBM-gated, registered and
+    counted so a two-process job shows its plan on every rank."""
+    if not enabled():
+        return None
+    return build_plan(program, ndev=nranks,
+                      layouts=[(int(nranks), 1, 1)])
+
+
+def report():
+    """The /statusz ``auto_shard`` section: flag state, global digest,
+    and the bounded per-program plan registry."""
+    with _lock:
+        plans = dict(_PLANS)
+    return {
+        'enabled': enabled(),
+        'digest': digest(),
+        'programs': plans,
+        'counters': {
+            k: monitor.counter_value('parallel/' + k)
+            for k in ('plan_builds', 'plan_reused', 'plan_candidates',
+                      'plan_hbm_rejected', 'plan_unpriced',
+                      'plan_params_sharded',
+                      'plan_params_replicated')},
+        'layout': {
+            'dp': monitor.gauge_value('parallel/plan_layout_dp'),
+            'fsdp': monitor.gauge_value('parallel/plan_layout_fsdp'),
+            'tp': monitor.gauge_value('parallel/plan_layout_tp')},
+    }

@@ -1,0 +1,214 @@
+"""Auto-sharding planner gate: FLAGS_auto_shard must plan REAL jobs
+(the parallel/plan.py analog of check_comms.py's live two-process
+posture).
+
+Three postures:
+
+  1. a real two-process collective job (tests/comms_worker.py x2 with
+     FLAGS_auto_shard=1): BOTH ranks must show populated
+     parallel/plan_* counters in /metrics.json (the planner ran in
+     every process, not just rank 0) and an auto_shard section in
+     /statusz naming the chosen layout and its priced candidates;
+  2. flag-off hygiene: with FLAGS_auto_shard=0 a hand-placed mesh
+     program must train BIT-FOR-BIT identically whether or not the
+     planner machinery was exercised in the same process (the planner
+     leaves no residue), and the global digest must be the constant
+     'auto_shard(off)' so segment fingerprints are unchanged;
+  3. flag-on: an UNANNOTATED program must reach a sharded mesh at
+     loss parity with the hand-placed baseline, with the plan
+     registered and the layout gauges populated.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu; the tool forces the
+8-device host platform itself).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# one implementation of the two-process scaffolding: port pick, HTTP
+# get, and worker readiness live in check_comms — a fix to worker
+# cleanup/readiness there must not silently diverge here
+from check_comms import _free_port, _get, _wait_ready  # noqa: E402
+
+
+def check_two_process_job(failures):
+    worker = os.path.join(ROOT, 'tests', 'comms_worker.py')
+    p0, p1 = _free_port(), _free_port()
+    spec = '0=127.0.0.1:%d,1=127.0.0.1:%d' % (p0, p1)
+    base_env = dict(os.environ)
+    base_env.update({'PADDLE_TPU_STATUS_WORKERS': spec,
+                     'FLAGS_health_heartbeat_seconds': '0.5',
+                     'FLAGS_auto_shard': '1'})
+    env0 = dict(base_env, PADDLE_TRAINER_ID='0',
+                PADDLE_TPU_STATUS_AGGREGATE='1')
+    env1 = dict(base_env, PADDLE_TRAINER_ID='1',
+                PADDLE_TPU_STATUS_AGGREGATE='0')
+    procs = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(p1), '120'], env=env1,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(p0), '120'], env=env0,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        deadline = time.time() + 240
+        agg = 'http://127.0.0.1:%d' % p0
+        wrk = 'http://127.0.0.1:%d' % p1
+        _wait_ready(procs[0], wrk, deadline)
+        _wait_ready(procs[1], agg, deadline)
+
+        for name, url in (('rank0', agg), ('rank1', wrk)):
+            code, body = _get(url + '/metrics.json')
+            counters = json.loads(body)['state']['counters']
+            if counters.get('parallel/plan_builds', 0.0) <= 0:
+                failures.append('%s parallel/plan_builds is zero: '
+                                'the planner never ran' % name)
+            if counters.get('parallel/plan_candidates', 0.0) <= 0:
+                failures.append('%s parallel/plan_candidates is '
+                                'zero' % name)
+            code, body = _get(url + '/statusz')
+            sec = json.loads(body).get('auto_shard')
+            if not sec or not sec.get('enabled'):
+                failures.append('%s /statusz auto_shard section '
+                                'missing or disabled' % name)
+            elif not sec.get('programs'):
+                failures.append('%s /statusz auto_shard names no '
+                                'planned program' % name)
+            else:
+                prog = next(iter(sec['programs'].values()))
+                if not prog.get('candidates'):
+                    failures.append('%s auto_shard plan carries no '
+                                    'priced candidates' % name)
+                if 'layout' not in prog or 'digest' not in prog:
+                    failures.append('%s auto_shard plan missing '
+                                    'layout/digest' % name)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
+def check_in_process(failures):
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, monitor
+    from paddle_tpu.parallel import mesh as pmesh
+    from paddle_tpu.parallel import plan
+
+    def build(seed=9):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[32], dtype='float32')
+            y = layers.data('y', shape=[1], dtype='float32')
+            h = layers.fc(x, 64, act='relu')
+            h = layers.fc(h, 64, act='relu')
+            loss = layers.reduce_mean(layers.square_error_cost(
+                layers.fc(h, 1), y))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.rand(16, 32).astype('float32'),
+            'y': rng.rand(16, 1).astype('float32')}
+
+    def run_hand(steps=4):
+        mesh = pmesh.create_mesh(dp=8)
+        main, startup, loss = build()
+        comp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name).with_mesh(mesh)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            return [np.asarray(exe.run(comp, feed=feed,
+                                       fetch_list=[loss])[0]).copy()
+                    for _ in range(steps)]
+
+    # --- posture 2: flag off is bit-for-bit, planner leaves no residue
+    fluid.set_flags({'FLAGS_auto_shard': False})
+    baseline = run_hand()
+    if plan.digest() != 'auto_shard(off)':
+        failures.append('flag-off digest is %r, wanted the constant'
+                        % plan.digest())
+    # exercise the planner on a throwaway program, then repeat the
+    # hand-placed run with the flag back off
+    fluid.set_flags({'FLAGS_auto_shard': True})
+    m2, s2, l2 = build(seed=11)
+    comp2 = fluid.CompiledProgram(m2).with_data_parallel(
+        loss_name=l2.name)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(s2)
+        exe.run(comp2, feed=feed, fetch_list=[l2])
+    fluid.set_flags({'FLAGS_auto_shard': False})
+    again = run_hand()
+    for a, b in zip(baseline, again):
+        if not np.array_equal(a, b):
+            failures.append('FLAGS_auto_shard=0 run diverged from the '
+                            'hand-placed baseline after the planner '
+                            'ran in-process (%r vs %r)' % (a, b))
+            break
+
+    # --- posture 3: flag on, unannotated program, parity + plan
+    monitor.reset()
+    plan.reset()
+    fluid.set_flags({'FLAGS_auto_shard': True})
+    main, startup, loss = build()
+    comp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        auto = [np.asarray(exe.run(comp, feed=feed,
+                                   fetch_list=[loss])[0]).copy()
+                for _ in range(4)]
+    if not np.allclose(np.ravel(auto), np.ravel(baseline),
+                       rtol=5e-3, atol=5e-4):
+        failures.append('auto-shard losses diverge from hand-placed '
+                        'baseline: %r vs %r' % (auto, baseline))
+    if monitor.counter_value('parallel/plan_builds') < 1:
+        failures.append('flag-on run never built a plan')
+    dp = monitor.gauge_value('parallel/plan_layout_dp')
+    fsdp = monitor.gauge_value('parallel/plan_layout_fsdp')
+    tp = monitor.gauge_value('parallel/plan_layout_tp')
+    if dp * fsdp * tp != 8:
+        failures.append('plan layout gauges dp=%g fsdp=%g tp=%g do '
+                        'not cover the 8-device mesh' % (dp, fsdp, tp))
+    fluid.set_flags({'FLAGS_auto_shard': False})
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    flags = os.environ.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    sys.path.insert(0, ROOT)
+    failures = []
+    check_two_process_job(failures)
+    check_in_process(failures)
+    if failures:
+        print('check_autoshard: FAIL')
+        for f in failures:
+            print('  - %s' % f)
+        return 1
+    print('check_autoshard: two-process job planned on both ranks '
+          '(parallel/plan_* counters + /statusz auto_shard), flag-off '
+          'bit-for-bit with the hand-placed baseline, flag-on '
+          'unannotated program sharded at loss parity')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

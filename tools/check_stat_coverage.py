@@ -215,6 +215,26 @@ REQUIRED = [
     ('paddle_tpu/fluid/serving.py', 'register_scope_provider'),
     ('tools/stat_summary.py', 'memviz/live_bytes_total'),
     ('bench.py', 'memviz_overhead'),
+    # auto-sharding planner (parallel/plan.py): plan build volume, the
+    # priced-candidate table, the memviz HBM-gate rejections, the
+    # unpriced-term honesty counter, the chosen-layout gauges, and the
+    # digest folded into BOTH runner fingerprints —
+    # tools/check_autoshard.py asserts the counters move on a real
+    # two-process job with FLAGS_auto_shard=1
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_builds'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_candidates'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_hbm_rejected'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_unpriced'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_reused'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_params_sharded'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_layout_dp'),
+    ('paddle_tpu/parallel/plan.py', 'parallel/plan_seconds'),
+    ('paddle_tpu/fluid/parallel_executor.py', '_ashard.digest'),
+    ('paddle_tpu/fluid/transpiler/collective.py',
+     'auto_shard_plan.transpile_plan'),
+    ('paddle_tpu/fluid/health.py', 'auto_shard_plan.report'),
+    ('tools/stat_summary.py', 'parallel/plan_hbm_rejected'),
+    ('bench.py', '_autoshard_fields'),
 ]
 
 

@@ -22,6 +22,12 @@ Usage:
                                   # watermark, budget utilization,
                                   # per-program peaks, OOM/watermark
                                   # incident counts (fluid.memviz)
+  python tools/stat_summary.py --autoshard run.jsonl
+                                  # auto-sharding planner rollup:
+                                  # chosen dp/fsdp/tp layout, plan
+                                  # builds/reuse, candidates priced,
+                                  # HBM-gate rejections, unpriced
+                                  # terms (parallel/plan.py)
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
@@ -163,6 +169,44 @@ def plan_report(rec, out=None):
     return 0
 
 
+def autoshard_report(rec, out=None):
+    """Auto-sharding planner rollup from one monitor record: the
+    chosen (dp, fsdp, tp) layout gauges, plan build/reuse volume, the
+    candidate table size, HBM-gate rejections and the unpriced-term
+    honesty counter — the offline form of /statusz's auto_shard
+    section."""
+    out = out if out is not None else sys.stdout
+    c = rec.get('counters', {})
+    g = rec.get('gauges', {})
+    builds = c.get('parallel/plan_builds', 0.0)
+    if not builds:
+        out.write('no parallel/plan_* counters: the auto-sharding '
+                  'planner never ran in this record '
+                  '(FLAGS_auto_shard)\n')
+        return 1
+    out.write('auto-sharding planner rollup\n')
+    out.write('  layout          dp=%d fsdp=%d tp=%d\n'
+              % (g.get('parallel/plan_layout_dp', 0),
+                 g.get('parallel/plan_layout_fsdp', 0),
+                 g.get('parallel/plan_layout_tp', 0)))
+    out.write('  plan builds     %10d (reused %d)\n'
+              % (builds, c.get('parallel/plan_reused', 0.0)))
+    out.write('  candidates      %10d priced\n'
+              % c.get('parallel/plan_candidates', 0.0))
+    rej = c.get('parallel/plan_hbm_rejected', 0.0)
+    if rej:
+        out.write('  HBM gate        %10d layouts rejected before '
+                  'compile\n' % rej)
+    unpriced = c.get('parallel/plan_unpriced', 0.0)
+    if unpriced:
+        out.write('  unpriced terms  %10d (no comms_model.json '
+                  'entry: heuristic byte pricing)\n' % unpriced)
+    out.write('  params          %10d sharded, %d replicated\n'
+              % (c.get('parallel/plan_params_sharded', 0.0),
+                 c.get('parallel/plan_params_replicated', 0.0)))
+    return 0
+
+
 def _fmt_bytes(b):
     b = float(b)
     if b >= 1 << 30:
@@ -232,6 +276,11 @@ def main(argv=None):
             sys.stderr.write(__doc__)
             return 2
         return memory_report(load_last(argv[1]))
+    if argv and argv[0] == '--autoshard':
+        if len(argv) != 2:
+            sys.stderr.write(__doc__)
+            return 2
+        return autoshard_report(load_last(argv[1]))
     if argv and argv[0] == '--plan':
         if len(argv) != 2:
             sys.stderr.write(__doc__)
