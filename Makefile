@@ -64,7 +64,11 @@ bench:
 # and cost nothing when off, and the auto-sharding planner must plan
 # a real two-process job on every rank (parallel/plan_* counters +
 # /statusz auto_shard) while FLAGS_auto_shard=0 stays bit-for-bit
-# the hand-placed behavior
+# the hand-placed behavior, and the elastic resilience plane must
+# survive a real kill -9 mid-save (last-good generation loadable,
+# torn shards refused by name) and resume a checkpoint across
+# process and layout changes at loss parity with zero post-warmup
+# retraces
 check:
 	python tools/check_stat_coverage.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
@@ -75,6 +79,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_comms.py
 	JAX_PLATFORMS=cpu python tools/check_memviz.py
 	JAX_PLATFORMS=cpu python tools/check_autoshard.py
+	JAX_PLATFORMS=cpu python tools/check_elastic.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

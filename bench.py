@@ -775,6 +775,192 @@ def _run_cold(cache_dir=None, out_path=None):
     return None
 
 
+def bench_elastic_save(batch=64, steps=4, store=None):
+    """--elastic child: train LeNet under an fsdp2 layout (2 host
+    devices, fc weights + Adam moments genuinely scattered via the
+    auto-shard planner) and write one elastic checkpoint generation —
+    the save-side bandwidth number (manifest + per-shard files +
+    digests, atomic publish), and a SHARDED source so the resume
+    child's reshard schedule prices real collectives."""
+    import tempfile
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import elastic, monitor
+    from paddle_tpu.parallel import plan as _ashard
+    from paddle_tpu import models
+    store = store or tempfile.mkdtemp(prefix='pt_elastic_bench_')
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(batch, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (batch, 1)).astype('int64')}
+    fluid.set_flags({'FLAGS_auto_shard': True})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        comp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name,
+            places=[fluid.XLAPlace(i) for i in range(2)])
+        comp._auto_plan = _ashard.build_plan(main, ndev=2,
+                                             layouts=[(1, 2, 1)])
+        for _ in range(steps):
+            l, = exe.run(comp, feed=feed, fetch_list=[loss])
+        first_loss = float(np.asarray(l).ravel()[0])
+        t0 = time.time()
+        gen = elastic.save_checkpoint(store, main, executor=exe)
+        save_s = time.time() - t0
+    flat = monitor.flat()
+    save_bytes = flat.get('elastic/save_bytes', 0.0)
+    return {'metric': 'elastic_checkpoint_save_bw_mbps_b%d' % batch,
+            'value': round(save_bytes / max(save_s, 1e-9) / 1e6, 2),
+            'unit': 'MB/s',
+            'save_seconds': round(save_s, 4),
+            'save_bytes': save_bytes,
+            'shards': flat.get('elastic/shards_written', 0.0),
+            'generation': gen, 'store': store,
+            'loss_at_save': first_loss}
+
+
+def bench_elastic_resume(batch=64, steps=3, store=None):
+    """--elastic child: process-start -> resumed-first-step-complete
+    wall time on a DIFFERENT topology (single device) — the N->M
+    reconfiguration latency an autoscaling trainer pays, measured
+    cold (empty compile cache) and warm (persistent store hit) by the
+    driver.  Carries the reshard schedule's predicted-vs-measured
+    honesty ratio and the load-side bandwidth."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import elastic, monitor
+    from paddle_tpu import models
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(batch, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (batch, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        t0 = time.time()
+        info = elastic.resume(exe, store, main, feed_shapes=feed,
+                              fetch_list=[loss])
+        lowered_after_warmup = monitor.counter_value(
+            'executor/segments_lowered')
+        l, = exe.run(main, feed=feed, fetch_list=[loss])
+        first_loss = float(np.asarray(l).ravel()[0])
+        reconfig_s = time.time() - _PROC_T0
+        resume_s = time.time() - t0
+        for _ in range(steps - 1):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        lowered_total = monitor.counter_value(
+            'executor/segments_lowered')
+    flat = monitor.flat()
+    rs = info['reshard']
+    return {'metric': 'elastic_reconfig_start_to_first_step_s_b%d'
+                      % batch,
+            'value': round(reconfig_s, 3), 'unit': 'seconds',
+            'resume_s': round(resume_s, 3),
+            'first_loss': first_loss,
+            'loaded_generation': info['generation'],
+            'load_seconds': info['seconds'],
+            'load_bw_mbps': round(
+                info['bytes'] / max(info['seconds'], 1e-9) / 1e6, 2),
+            'reshard_predicted_s': rs['predicted_s'],
+            'reshard_measured_s': rs['measured_s'],
+            'reshard_pred_over_measured': rs['pred_over_measured'],
+            'reshard_by_kind': rs['by_kind'],
+            'staging_waves': rs['staging_waves'],
+            'retraces_after_warmup': lowered_total -
+                lowered_after_warmup,
+            'compile_cache': {
+                short: flat.get('executor/' + key, 0.0)
+                for short, key in (
+                    ('disk_hit', 'compile_cache_disk_hit'),
+                    ('disk_writes', 'compile_cache_disk_writes'),
+                    ('aot_compiles', 'aot_compiles'),
+                    ('segments_lowered', 'segments_lowered'),
+                    ('warmup_segments', 'warmup_segments'))}}
+
+
+def _elastic_fields(results):
+    """--elastic summary: cold vs warm N->M reconfiguration seconds
+    through the persistent compile cache, the reshard schedule's
+    predicted-vs-measured ratio, and checkpoint save/load
+    bandwidth."""
+    save, cold, warm = (results.get(k) for k in ('save', 'cold',
+                                                 'warm'))
+    if not (save and cold and warm):
+        return None
+    return {
+        'metric': 'elastic_reconfig_cold_vs_warm_s',
+        'cold_s': cold['value'],
+        'warm_s': warm['value'],
+        'speedup': round(cold['value'] / max(warm['value'], 1e-9), 2),
+        'warm_disk_hits': warm['compile_cache']['disk_hit'],
+        'warm_retraces_after_warmup': warm['retraces_after_warmup'],
+        'save_bw_mbps': save['value'],
+        'load_bw_mbps': warm['load_bw_mbps'],
+        'reshard_pred_over_measured':
+            warm['reshard_pred_over_measured'],
+        'reshard_by_kind': warm['reshard_by_kind'],
+    }
+
+
+def _run_elastic(out_path=None):
+    """--elastic driver: one dp2 child saves a generation, then two
+    single-device children resume it against one FRESH compile-cache
+    dir — cold (populates) and warm (disk hits, zero post-warmup
+    retraces).  The topology change (2 devices -> 1) is the N->M
+    reconfiguration being priced."""
+    import shutil
+    import subprocess
+    import tempfile
+    work = tempfile.mkdtemp(prefix='paddle_tpu_elastic_')
+    store = os.path.join(work, 'store')
+    cache = os.path.join(work, 'cache')
+    results = {}
+    jobs = (
+        ('save', 'elastic_save', {'store': store},
+         {'XLA_FLAGS': '--xla_force_host_platform_device_count=2'}),
+        ('cold', 'elastic_resume', {'store': store}, {}),
+        ('warm', 'elastic_resume', {'store': store}, {}),
+    )
+    try:
+        for tag, name, kwargs, extra_env in jobs:
+            env = dict(os.environ, FLAGS_compile_cache_dir=cache)
+            env.update(extra_env)
+            p = subprocess.run(
+                [sys.executable, '-u', os.path.abspath(__file__),
+                 '--one', name, json.dumps(kwargs)],
+                capture_output=True, text=True, timeout=900, env=env)
+            line = [ln for ln in p.stdout.splitlines()
+                    if ln.startswith('{')]
+            if not line:
+                sys.stderr.write('elastic child %s failed (rc=%d): '
+                                 '%s\n' % (tag, p.returncode,
+                                           p.stderr[-400:]))
+                continue
+            rec = json.loads(line[-1])
+            rec['phase'] = tag
+            results[tag] = rec
+            print(json.dumps(rec))
+        summary = _elastic_fields(results)
+        if summary:
+            print(json.dumps(summary))
+            if out_path:
+                with open(out_path, 'w') as f:
+                    json.dump({'cmd': 'JAX_PLATFORMS=cpu python '
+                                      'bench.py --elastic',
+                               'entries': list(results.values()),
+                               'summary': summary}, f, indent=1,
+                              sort_keys=True)
+        return summary
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_serving(feeders=4, requests_per_feeder=100, max_batch=32,
                   burst=16):
     """Multi-client serving soak: N concurrent feeders, two resident
@@ -1602,6 +1788,17 @@ def main():
         # Baseline recorded in BENCH_compile_cache.json.
         out = sys.argv[2] if len(sys.argv) > 2 else None
         _run_cold(out_path=out)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--elastic':
+        # elastic reconfiguration: save under dp2, resume on a
+        # different topology cold vs warm through the persistent
+        # compile cache, reshard predicted-vs-measured, checkpoint
+        # save/load bandwidth.  Baseline recorded in
+        # BENCH_elastic.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'BENCH_elastic.json')
+        _run_elastic(out_path=out)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--serving':
         # multi-client serving soak (continuous batching vs

@@ -26,7 +26,8 @@ logger = logging.getLogger('paddle_tpu.heartbeat')
 
 class HeartBeatMonitor(object):
     def __init__(self, workers, is_chief=True, monitored_var='',
-                 timeout=60.0, check_interval=1.0, on_lost=None):
+                 timeout=60.0, check_interval=1.0, on_lost=None,
+                 misses=None):
         if workers <= 0:
             raise ValueError('trainers must be one or more')
         self.workers = workers
@@ -35,8 +36,20 @@ class HeartBeatMonitor(object):
         self.timeout = timeout
         self.check_interval = check_interval
         self.on_lost = on_lost          # callback(worker_id, age_seconds)
+        # FLAGS_heartbeat_misses: consecutive expired checks before a
+        # worker flips LOST — one late packet is not a death.  A
+        # recovery short of the threshold counts a flap.
+        if misses is None:
+            try:
+                from ..fluid.flags import get_flag
+                misses = int(get_flag('FLAGS_heartbeat_misses', 3)
+                             or 3)
+            except Exception:
+                misses = 3
+        self.misses = max(1, int(misses))
         self._status = {i: UNINITED for i in range(workers)}
         self._stamp = {i: 0.0 for i in range(workers)}
+        self._miss = {i: 0 for i in range(workers)}
         self._lost = set()
         self._lock = threading.Lock()
         self._running = False
@@ -59,28 +72,51 @@ class HeartBeatMonitor(object):
     # -- worker side --------------------------------------------------
     def update(self, worker_id, status=RUNNING):
         """Heartbeat from `worker_id` (reference: Update called from the
-        request handler on every received var)."""
+        request handler on every received var).  A worker returning
+        from LOST is RE-ADMITTED (the elastic trainer-set-change leg:
+        a restarted trainer takes its dead predecessor's slot); a
+        recovery that had accumulated misses short of the threshold
+        counts a flap."""
+        from ..fluid import monitor as _monitor
         with self._lock:
             self._status[worker_id] = status
             self._stamp[worker_id] = time.monotonic()
-            self._lost.discard(worker_id)
+            if worker_id in self._lost:
+                self._lost.discard(worker_id)
+                _monitor.add('elastic/readmissions')
+                logger.warning('worker %d re-admitted after loss',
+                               worker_id)
+            elif self._miss.get(worker_id, 0) > 0:
+                _monitor.add('elastic/heartbeat_flaps')
+            self._miss[worker_id] = 0
 
     # -- chief side ---------------------------------------------------
     def _monitor_loop(self):
         while self._running:
             now = time.monotonic()
+            callbacks = []
             with self._lock:
                 for wid, st in self._status.items():
                     if st != RUNNING or wid in self._lost:
                         continue
                     age = now - self._stamp[wid]
-                    if age > self.timeout:
-                        self._lost.add(wid)
-                        logger.warning(
-                            'worker %d lost: no heartbeat for %.1fs',
-                            wid, age)
-                        if self.on_lost is not None:
-                            self.on_lost(wid, age)
+                    if age <= self.timeout:
+                        self._miss[wid] = 0
+                        continue
+                    self._miss[wid] = self._miss.get(wid, 0) + 1
+                    if self._miss[wid] < self.misses:
+                        continue
+                    self._lost.add(wid)
+                    logger.warning(
+                        'worker %d lost: no heartbeat for %.1fs '
+                        '(%d consecutive expired checks)',
+                        wid, age, self._miss[wid])
+                    if self.on_lost is not None:
+                        callbacks.append((wid, age))
+            for wid, age in callbacks:
+                # outside the lock: an on_lost that re-admits (or
+                # queries) the monitor must not deadlock
+                self.on_lost(wid, age)
             time.sleep(self.check_interval)
 
     def lost_workers(self):

@@ -82,6 +82,25 @@ def _rpc_flags():
         return 180000, 3
 
 
+def _backoff_seconds(attempt):
+    """Bounded exponential backoff with full jitter before reconnect
+    `attempt` (1-based): sleep in [0.5, 1.0] x min(base x 2^(n-1),
+    max).  A fleet of restarted trainers hammering a recovering
+    pserver in lockstep is exactly the thundering herd the jitter
+    breaks; FLAGS_rpc_backoff_ms=0 restores immediate retry."""
+    try:
+        from ..fluid.flags import get_flag
+        base = float(get_flag('FLAGS_rpc_backoff_ms', 50) or 0)
+        cap = float(get_flag('FLAGS_rpc_backoff_max_ms', 2000) or 0)
+    except Exception:
+        base, cap = 50.0, 2000.0
+    if base <= 0:
+        return 0.0
+    import random
+    bound = min(base * (2.0 ** (attempt - 1)), max(base, cap)) / 1000.0
+    return bound * (0.5 + 0.5 * random.random())
+
+
 class PsServer(object):
     """In-process handle on the native service (the listen_and_serv
     analog).  Run one of these in the pserver process; trainers connect
@@ -178,7 +197,7 @@ class PsClient(object):
         dropped instead, like the reference's async send path
         (grpc_client.h completion-queue sends are fire-and-forget for
         grads), and returns None."""
-        from ..fluid import monitor
+        from ..fluid import faultinject, monitor
         nb = name.encode()
         frame = struct.pack('<BI', op, len(nb)) + nb + payload
         msg = struct.pack('<I', len(frame)) + frame
@@ -191,9 +210,20 @@ class PsClient(object):
             for attempt in range(retries + 1):
                 sent = False
                 try:
+                    if faultinject.armed():
+                        # inside the try: an injected 'fail' is
+                        # transport-shaped and exercises the real
+                        # retry/backoff machinery below
+                        faultinject.check('rpc.call', op=op,
+                                          attempt=attempt)
                     if self._sock is None or attempt > 0:
                         if attempt > 0:
                             monitor.add('rpc/retries')
+                            b = _backoff_seconds(attempt)
+                            if b > 0:
+                                monitor.observe('rpc/backoff_seconds',
+                                                b)
+                                time.sleep(b)
                         self._connect()
                     if blocking:
                         self._sock.settimeout(None)
@@ -229,6 +259,15 @@ class PsClient(object):
                         return None
             else:
                 monitor.add('rpc/deadline_errors')
+                # retry exhaustion is an incident: the flight recorder
+                # holds the steps that led here (same contract as the
+                # refused-checkpoint and straggler dumps)
+                from ..fluid import trace as _trace
+                _trace.dump_on_error('rpc_exhausted', extra={
+                    'incident': 'rpc_retry_exhausted',
+                    'endpoint': '%s:%d' % self._addr, 'op': op,
+                    'var': name, 'attempts': retries + 1,
+                    'deadline_s': self.deadline, 'error': str(last)})
                 raise RpcDeadlineError(
                     'ps rpc to %s:%d failed after %d attempts with '
                     '%.1fs deadline each: %s'
@@ -499,8 +538,14 @@ class TrainerHeartbeat(object):
         self._thread.start()
 
     def _loop(self):
+        from ..fluid import faultinject
         while not self._stop.wait(self.interval):
             try:
+                if faultinject.armed():
+                    c = faultinject.check('heartbeat.send',
+                                          trainer=self.trainer_id)
+                    if c is not None and c['action'] == 'drop':
+                        continue  # a missed ping, sender stays alive
                 self._client.heartbeat(self.trainer_id, HB_RUNNING)
             except (PsServerError, ConnectionError, OSError):
                 pass  # server gone: nothing useful to do from here

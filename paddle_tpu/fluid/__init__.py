@@ -9,6 +9,7 @@ optional).
 
 from . import monitor  # dependency-free; first so every layer can use it
 from . import trace    # span tracer: needs only monitor + flags
+from . import faultinject  # chaos hooks: needs only monitor + flags
 from . import health   # HTTP status plane: needs only monitor + trace
 from . import core
 from .core import (CPUPlace, CUDAPlace, XLAPlace, CUDAPinnedPlace,
@@ -38,6 +39,7 @@ from . import io
 from .io import (save_params, save_persistables, load_params,
                  load_persistables, save_inference_model,
                  load_inference_model)
+from . import elastic  # crash-consistent checkpoints + resharding
 from . import metrics
 from . import profiler
 from . import trainer_desc  # noqa: F401
@@ -63,7 +65,7 @@ __all__ = [
     'Executor', 'layers', 'nets', 'optimizer', 'initializer', 'backward',
     'ParamAttr', 'CompiledProgram', 'BuildStrategy', 'io', 'metrics',
     'dygraph', 'DataFeeder', 'scope_guard', 'global_scope', 'monitor',
-    'trace', 'serving',
+    'trace', 'serving', 'elastic', 'faultinject',
 ]
 from . import dataset
 from .dataset import DatasetFactory

@@ -1,0 +1,934 @@
+"""fluid.elastic — crash-consistent checkpoints + cross-topology
+resharding: the elastic resilience plane (ROADMAP item 4).
+
+The runtime restarts in seconds (the PR-3 compile cache), but until
+now a checkpoint only loaded back onto the mesh that wrote it, and a
+``kill -9`` mid-save could shadow a previously-good checkpoint with a
+torn directory.  This module closes both gaps:
+
+**Crash-consistent store.**  ``save_checkpoint(dir)`` writes a
+manifest-led GENERATION::
+
+    <dir>/
+      LATEST                  -> "3"            (atomic tmp+rename)
+      gen-00000002/           last-good, kept
+      gen-00000003/
+        manifest.json         written LAST inside the tmp dir
+        s00__fc_0.w_0.npy     one file per (param, distinct shard)
+        ...
+
+Shards land in a ``.tmp-gen*`` staging dir; ``manifest.json`` (shapes,
+dtypes, PartitionSpecs, per-shard start offsets and sha256 content
+digests, the source dp x fsdp x tp layout, the executor step) is
+written last; one ``os.replace`` publishes the whole generation (the
+``compile_cache`` atomic-entry pattern, directory-sized).  A kill at
+ANY instant therefore leaves either the old store or the new one —
+never a half-written generation that shadows a good checkpoint.  On
+load every shard is digest-verified: a torn/partial generation is
+REFUSED with a named reason (``ElasticCheckpointError.shard``), counted
+(``elastic/refused_generations``), flight-recorder-dumped, and the
+newest intact generation loads instead.
+
+**Cross-topology reshard on load** (arXiv:2112.01075 — memory-efficient
+array redistribution through portable collectives, never
+gather-to-host).  A checkpoint saved under any (dp, fsdp, tp) plan
+loads onto a DIFFERENT mesh/plan: per parameter the source shard grid
+and the target shard grid synthesize a redistribution step — ``keep``
+(grids match), ``slice`` (refinement: every target box sits inside a
+source box, zero wire), ``allgather`` (coarsening: source boxes merge
+into target boxes), or ``ppermute`` (boxes moved/re-cut) — priced with
+the calibrated comms cost model (``comms.model_predict`` via
+``comms_plan.predict_seconds``, heuristic byte-count fallback counted
+``elastic/reshard_unpriced``).  Execution streams shard FILES: each
+target shard assembles only its own bytes from the overlapping source
+shards (numpy mmap, so a coarse source shard is never fully read for a
+fine target) and is ``device_put`` directly to its devices —
+``jax.make_array_from_single_device_arrays`` builds the global array
+without the full tensor ever existing in host memory.  Assembly runs
+in WAVES bounded by ``FLAGS_elastic_stage_bytes`` and the ``memviz``
+budget watermark, counted ``elastic/staging_waves``.  ``resume()``
+then drives ``Executor.warmup()`` so the persistent compile cache
+makes N->M reconfiguration a warmup away — zero post-warmup retraces.
+
+**Trainer-set changes.**  ``rejoin_trainer()`` is the re-admission
+leg: a restarted trainer re-registers its heartbeat with the pserver
+(the dead predecessor's slot expires via the ``FLAGS_heartbeat_misses``
+tolerance) and resumes from the last-good generation
+(``elastic/readmissions``).
+
+Wired under ``fluid.io``: ``save_persistables`` routes here when
+``FLAGS_elastic_checkpoint`` is on; ``load_persistables`` auto-detects
+an elastic store regardless of the flag.
+
+Observability: ``elastic/*`` counters + gauges, the ``/statusz``
+``elastic`` section (``report()``: last generation, the reshard
+schedule with predicted-vs-measured seconds, refusals, RPC
+retry/backoff tallies), flight dumps on refusals.  No jax imports at
+module level; nothing here runs per step.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from . import monitor
+from . import trace
+from .flags import get_flag
+
+__all__ = [
+    'ElasticCheckpointError', 'is_elastic_store', 'save_checkpoint',
+    'load_checkpoint', 'resume', 'rejoin_trainer', 'list_generations',
+    'latest_generation', 'read_manifest', 'verify_generation',
+    'plan_reshard', 'report', 'reset',
+]
+
+FORMAT = 'paddle_tpu.elastic/1'
+MANIFEST = 'manifest.json'
+_GEN_PREFIX = 'gen-'
+_TMP_PREFIX = '.tmp-gen'
+
+# heuristic pricing when comms_model.json is absent/partial (the
+# parallel/plan.py byte-count fallback, counted elastic/reshard_unpriced)
+_HEUR_LATENCY_S = 20e-6
+_HEUR_BW_BYTES_PER_S = 10e9
+
+_lock = threading.Lock()
+_last = {'save': None, 'load': None, 'dir': None}
+_refusals = []          # bounded: the /statusz refusal trail
+_REFUSALS_CAP = 8
+
+
+class ElasticCheckpointError(RuntimeError):
+    """A checkpoint store problem with a NAMED reason: `.reason` is a
+    stable token ('torn_shard', 'missing_shard', 'bad_manifest',
+    'no_generation', 'uncovered_param'), `.shard` names the offending
+    file when one exists, `.generation` the refused generation."""
+
+    def __init__(self, msg, reason=None, shard=None, generation=None):
+        super(ElasticCheckpointError, self).__init__(msg)
+        self.reason = reason
+        self.shard = shard
+        self.generation = generation
+
+
+def reset():
+    """Drop the report registry (tests)."""
+    with _lock:
+        _last.update({'save': None, 'load': None, 'dir': None})
+        del _refusals[:]
+
+
+# ---------------------------------------------------------- spec (de)ser
+def spec_to_jsonable(spec):
+    """PartitionSpec -> JSON-able nested lists (None = replicated)."""
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def spec_from_jsonable(doc):
+    if doc is None:
+        return None
+    from jax.sharding import PartitionSpec as P
+    return P(*[tuple(e) if isinstance(e, list) else e for e in doc])
+
+
+def _box_from_index(index, shape):
+    """jax shard index (tuple of slices) -> ((start, stop), ...) over
+    the concrete `shape` (scalars get the empty box)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        out.append((int(sl.start or 0),
+                    int(sl.stop if sl.stop is not None else dim)))
+    return tuple(out)
+
+
+def _box_volume(box):
+    v = 1
+    for a, b in box:
+        v *= max(0, b - a)
+    return v
+
+
+def _box_contains(outer, inner):
+    return all(oa <= ia and ib <= ob
+               for (oa, ob), (ia, ib) in zip(outer, inner))
+
+
+def _box_overlap(a, b):
+    out = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+# ------------------------------------------------------------- inventory
+def _value_shards(name, val):
+    """Decompose one scope value into its DISTINCT shards:
+    (np_dtype, global_shape, spec_jsonable, layout | None,
+    [(box, np.ndarray)]).  A host value is one full-cover shard; a
+    sharded jax.Array contributes one entry per distinct shard index
+    (replicas dedupe).  Raises when this process cannot address full
+    coverage — a save that silently dropped shards would be a torn
+    checkpoint by construction."""
+    spec = None
+    layout = None
+    try:
+        import jax
+        from jax.sharding import NamedSharding
+        if isinstance(val, jax.Array):
+            sh = getattr(val, 'sharding', None)
+            if isinstance(sh, NamedSharding):
+                spec = spec_to_jsonable(sh.spec)
+                layout = {str(a): int(sh.mesh.shape[a])
+                          for a in sh.mesh.axis_names}
+            shape = tuple(int(s) for s in val.shape)
+            seen = {}
+            for s in val.addressable_shards:
+                box = _box_from_index(s.index, shape)
+                if box not in seen:
+                    seen[box] = np.asarray(s.data)
+            total = sum(_box_volume(b) for b in seen)
+            want = int(np.prod(shape)) if shape else 1
+            if total != want:
+                raise ElasticCheckpointError(
+                    'save: param %r is not fully addressable from this '
+                    'process (%d of %d elements) — save from a process '
+                    'set that addresses every shard, or replicate the '
+                    'param before saving' % (name, total, want),
+                    reason='uncovered_param')
+            arrs = list(seen.items())
+            dt = np.dtype(val.dtype)
+            return dt, shape, spec, layout, arrs
+    except ImportError:
+        pass
+    arr = np.asarray(val)
+    shape = tuple(int(s) for s in arr.shape)
+    box = tuple((0, d) for d in shape)
+    return arr.dtype, shape, None, None, [(box, arr)]
+
+
+def _safe_name(name):
+    return name.replace(os.sep, '%2F').replace('..', '%2E%2E')
+
+
+# ------------------------------------------------------------------ save
+def save_checkpoint(dirname, program=None, scope=None, executor=None,
+                    vars=None):
+    """Write one new generation of the elastic store at `dirname`.
+    Returns the generation number.  Crash-consistent: every byte lands
+    in a staging dir, the manifest is written last, one rename
+    publishes — a kill at any instant leaves the previous generation
+    untouched and loadable."""
+    from . import core, framework, faultinject
+    from .io import _persistable_vars, _program_ps_tables
+    t0 = time.perf_counter()
+    scope = scope or core.global_scope()
+    if vars is None:
+        program = program or framework.default_main_program()
+        vars = _persistable_vars(program)
+        names = [v.name for v in vars]
+    else:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+    os.makedirs(dirname, exist_ok=True)
+    gen = (latest_generation(dirname) or 0) + 1
+    tmp = os.path.join(dirname, '%s%08d-%d' % (_TMP_PREFIX, gen,
+                                               os.getpid()))
+    os.makedirs(tmp, exist_ok=True)
+    injecting = faultinject.armed()
+    total_bytes = 0
+    nshards = 0
+    manifest = {
+        'format': FORMAT,
+        'generation': gen,
+        'wall_unix': time.time(),
+        'step': int(getattr(executor, '_step', 0) or 0),
+        'layout': None,
+        'params': {},
+        'files': {},
+    }
+    try:
+        for name in names:
+            val = scope.find_var(name)
+            if val is None:
+                raise RuntimeError('save: var %s not in scope' % name)
+            dt, shape, spec, layout, shards = _value_shards(
+                name, core.as_array(val))
+            if layout and manifest['layout'] is None:
+                manifest['layout'] = layout
+            rec = {'shape': list(shape), 'dtype': dt.name,
+                   'spec': spec, 'shards': []}
+            for k, (box, arr) in enumerate(shards):
+                fname = 's%02d__%s.npy' % (k, _safe_name(name))
+                raw = np.ascontiguousarray(arr)
+                digest = hashlib.sha256(raw.tobytes()).hexdigest()
+                path = os.path.join(tmp, fname)
+                clause = faultinject.check(
+                    'elastic.shard_write', file=fname) \
+                    if injecting else None
+                np.save(path, raw)
+                if clause is not None and clause['action'] == 'torn':
+                    # truncated shard: the digest in the manifest no
+                    # longer matches the bytes on disk — exactly what
+                    # a torn write looks like to the loader
+                    with open(path, 'r+b') as f:
+                        f.truncate(max(1, os.path.getsize(path) // 2))
+                rec['shards'].append({
+                    'file': fname,
+                    'start': [a for a, _b in box],
+                    'shape': [b - a for a, b in box],
+                    'sha256': digest,
+                    'bytes': int(raw.nbytes),
+                })
+                total_bytes += int(raw.nbytes)
+                nshards += 1
+            manifest['params'][name] = rec
+        if program is not None:
+            tables = _program_ps_tables(program)
+            if tables:
+                arrs = {}
+                for t in tables:
+                    arrs.update(t.state_dict())
+                tpath = os.path.join(tmp, '__dist_tables__.npz')
+                np.savez(tpath, **arrs)
+                with open(tpath, 'rb') as f:
+                    manifest['files']['__dist_tables__.npz'] = \
+                        hashlib.sha256(f.read()).hexdigest()
+        if injecting:
+            faultinject.check('elastic.publish', generation=gen)
+        # manifest LAST: its presence is the generation's commit mark
+        with open(os.path.join(tmp, MANIFEST), 'w') as f:
+            json.dump(manifest, f)
+        os.replace(tmp, _gen_dir(dirname, gen))
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _publish_latest(dirname, gen)
+    _prune(dirname, gen)
+    wall = time.perf_counter() - t0
+    monitor.add('elastic/checkpoints_saved')
+    monitor.add('elastic/save_bytes', float(total_bytes))
+    monitor.add('elastic/shards_written', float(nshards))
+    monitor.observe('elastic/save_seconds', wall)
+    monitor.set_gauge('elastic/last_generation', float(gen))
+    with _lock:
+        _last['dir'] = os.path.abspath(dirname)
+        _last['save'] = {
+            'generation': gen, 'seconds': round(wall, 6),
+            'bytes': total_bytes, 'shards': nshards,
+            'params': len(manifest['params']),
+            'layout': manifest['layout'], 'step': manifest['step'],
+        }
+    return gen
+
+
+def _gen_dir(dirname, gen):
+    return os.path.join(dirname, '%s%08d' % (_GEN_PREFIX, int(gen)))
+
+
+def _publish_latest(dirname, gen):
+    tmp = os.path.join(dirname, '.LATEST.tmp-%d' % os.getpid())
+    with open(tmp, 'w') as f:
+        f.write(str(int(gen)))
+    os.replace(tmp, os.path.join(dirname, 'LATEST'))
+
+
+def _light_intact(dirname, gen):
+    """Cheap integrity probe (no data reads): manifest parses, every
+    shard file exists and is at least its recorded payload size.
+    Catches torn-by-truncation without the digest pass — enough to
+    decide whether pruning may trust this generation."""
+    try:
+        doc = read_manifest(dirname, gen)
+    except ElasticCheckpointError:
+        return False
+    gdir = _gen_dir(dirname, gen)
+    for rec in doc['params'].values():
+        for s in rec['shards']:
+            try:
+                if os.path.getsize(os.path.join(gdir, s['file'])) < \
+                        int(s['bytes']):
+                    return False
+            except OSError:
+                return False
+    return True
+
+
+def _prune(dirname, newest):
+    keep = max(1, int(get_flag('FLAGS_elastic_keep_generations', 2)
+                      or 2))
+    gens = list_generations(dirname)
+    if len(gens) > keep:
+        # never let torn NEWER generations evict the last loadable
+        # one: prune only beyond the newest `keep` generations that
+        # look intact (cheap probe) — if fewer than `keep` intact ones
+        # exist, everything from the oldest intact on survives
+        intact = [g for g in reversed(gens) if _light_intact(dirname,
+                                                             g)]
+        floor = min(intact[:keep]) if intact else gens[0]
+        for g in gens:
+            if g >= floor or g == newest:
+                continue
+            shutil.rmtree(_gen_dir(dirname, g), ignore_errors=True)
+            monitor.add('elastic/generations_pruned')
+    # staging debris from crashed saves never shadows a generation —
+    # but drop it once a NEWER publish succeeded
+    for e in os.listdir(dirname):
+        if e.startswith(_TMP_PREFIX):
+            try:
+                if int(e[len(_TMP_PREFIX):].split('-')[0]) <= newest:
+                    shutil.rmtree(os.path.join(dirname, e),
+                                  ignore_errors=True)
+            except (ValueError, OSError):
+                pass
+
+
+# ------------------------------------------------------------- inventory
+def is_elastic_store(dirname):
+    """True when `dirname` holds (or held) an elastic generation —
+    the io.load_persistables auto-detection hook."""
+    if not dirname or not os.path.isdir(dirname):
+        return False
+    if os.path.isfile(os.path.join(dirname, 'LATEST')):
+        return True
+    return bool(list_generations(dirname))
+
+
+def list_generations(dirname):
+    """Published generation numbers, ascending (staging dirs and
+    foreign entries ignored)."""
+    out = []
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return out
+    for e in entries:
+        if e.startswith(_GEN_PREFIX):
+            try:
+                g = int(e[len(_GEN_PREFIX):])
+            except ValueError:
+                continue
+            if os.path.isfile(os.path.join(dirname, e, MANIFEST)):
+                out.append(g)
+    return sorted(out)
+
+
+def latest_generation(dirname):
+    """The newest PUBLISHED generation (a generation is complete by
+    construction — its manifest lands before the atomic rename), or
+    None.  The LATEST pointer is a human-readable marker only and is
+    deliberately not trusted for ordering: a crash in the window
+    between a generation's rename and the pointer update must neither
+    hide the newer checkpoint nor wedge future saves on a stale
+    number."""
+    gens = list_generations(dirname)
+    return gens[-1] if gens else None
+
+
+def read_manifest(dirname, gen):
+    path = os.path.join(_gen_dir(dirname, gen), MANIFEST)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ElasticCheckpointError(
+            'generation %d: unreadable manifest %s (%s)'
+            % (gen, path, e), reason='bad_manifest', generation=gen)
+    if doc.get('format') != FORMAT or 'params' not in doc:
+        raise ElasticCheckpointError(
+            'generation %d: manifest %s is not a %s document'
+            % (gen, path, FORMAT), reason='bad_manifest',
+            generation=gen)
+    return doc
+
+
+def verify_generation(dirname, gen, digests=True):
+    """Full integrity pass over one generation: every shard file must
+    exist, carry the manifest's byte count, and (digests=True) hash to
+    the manifest's sha256.  Returns the manifest; raises
+    ElasticCheckpointError NAMING the torn shard otherwise."""
+    doc = read_manifest(dirname, gen)
+    gdir = _gen_dir(dirname, gen)
+    for name, rec in doc['params'].items():
+        for s in rec['shards']:
+            path = os.path.join(gdir, s['file'])
+            if not os.path.isfile(path):
+                raise ElasticCheckpointError(
+                    'generation %d: shard %s (param %r) is missing'
+                    % (gen, s['file'], name), reason='missing_shard',
+                    shard=s['file'], generation=gen)
+            try:
+                arr = np.load(path, mmap_mode='r')
+                raw = np.ascontiguousarray(arr)
+                ok = raw.nbytes == int(s['bytes'])
+                if ok and digests:
+                    ok = hashlib.sha256(
+                        raw.tobytes()).hexdigest() == s['sha256']
+            except Exception:
+                ok = False
+            if not ok:
+                raise ElasticCheckpointError(
+                    'generation %d: shard %s (param %r) is torn — '
+                    'content does not match its manifest digest; '
+                    'refusing this generation'
+                    % (gen, s['file'], name), reason='torn_shard',
+                    shard=s['file'], generation=gen)
+    for fname, digest in (doc.get('files') or {}).items():
+        path = os.path.join(gdir, fname)
+        try:
+            with open(path, 'rb') as f:
+                ok = hashlib.sha256(f.read()).hexdigest() == digest
+        except OSError:
+            ok = False
+        if not ok:
+            raise ElasticCheckpointError(
+                'generation %d: side file %s is torn or missing'
+                % (gen, fname), reason='torn_shard', shard=fname,
+                generation=gen)
+    return doc
+
+
+# -------------------------------------------------------- reshard plane
+def _predict_seconds(kind, wire, unpriced):
+    if wire <= 0:
+        return 0.0
+    pred = None
+    try:
+        from . import comms_plan
+        pred = comms_plan.predict_seconds(kind, wire)
+    except Exception:
+        pred = None
+    if pred is None:
+        unpriced[0] += 1
+        return _HEUR_LATENCY_S + wire / _HEUR_BW_BYTES_PER_S
+    return float(pred)
+
+
+def plan_reshard(manifest, targets):
+    """Synthesize the redistribution schedule from the manifest's
+    source shard grids to `targets` ({param: [box, ...] | None}).
+    Per param one entry: the collective step ('keep' / 'slice' /
+    'allgather' / 'ppermute'), its wire bytes under the ring formulas
+    (``comms.wire_bytes``), and model-predicted seconds.  Returns
+    {'entries': {...}, 'predicted_s', 'wire_bytes', 'by_kind',
+    'unpriced'}."""
+    from . import comms
+    entries = {}
+    unpriced = [0]
+    total_wire = 0.0
+    total_pred = 0.0
+    by_kind = {}
+    for name, rec in manifest['params'].items():
+        shape = tuple(rec['shape'])
+        nbytes = int(np.prod([max(1, int(s)) for s in shape])) * \
+            np.dtype(rec['dtype']).itemsize if shape else \
+            np.dtype(rec['dtype']).itemsize
+        src = [tuple((int(a), int(a) + int(w)) for a, w in
+                     zip(s['start'], s['shape']))
+               for s in rec['shards']]
+        dst = targets.get(name)
+        if not dst:
+            dst = [tuple((0, int(d)) for d in shape)]
+        dst = sorted(set(dst))
+        srcset = sorted(set(src))
+        if srcset == dst:
+            kind, wire = 'keep', 0.0
+        elif all(any(_box_contains(s, d) for s in srcset)
+                 for d in dst):
+            kind, wire = 'slice', 0.0
+        elif all(any(_box_contains(d, s) for d in dst)
+                 for s in srcset):
+            kind = 'allgather'
+            ratio = max(2, len(srcset) // max(1, len(dst)))
+            wire = comms.wire_bytes('allgather',
+                                    nbytes / max(1, len(srcset)),
+                                    ratio)
+        else:
+            # boxes moved or re-cut across dims: the arXiv:2112.01075
+            # general case — a ppermute/all-to-all style rotation in
+            # which every byte travels once
+            kind, wire = 'ppermute', float(nbytes)
+        pred = _predict_seconds(
+            'allgather' if kind == 'ppermute' else kind,
+            wire, unpriced)
+        entries[name] = {'kind': kind, 'wire_bytes': wire,
+                         'predicted_s': pred,
+                         'src_shards': len(srcset),
+                         'dst_shards': len(dst)}
+        total_wire += wire
+        total_pred += pred
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    return {'entries': entries, 'predicted_s': total_pred,
+            'wire_bytes': total_wire, 'by_kind': by_kind,
+            'unpriced': unpriced[0]}
+
+
+def _stage_cap():
+    """Host-side bytes one assembly wave may stage: the flag, tightened
+    to a quarter of the memviz budget when the device reports one —
+    the reshard must fit under the watermark, not race it."""
+    cap = int(get_flag('FLAGS_elastic_stage_bytes', 256 << 20) or
+              (256 << 20))
+    try:
+        from . import memviz
+        budget = memviz.budget_bytes()
+        if budget:
+            cap = max(1 << 20, min(cap, int(budget) // 4))
+    except Exception:
+        pass
+    return cap
+
+
+def _target_sharding(name, shape, plan=None, mesh=None, specs=None):
+    """The NamedSharding a param loads under, or None (plain host
+    array).  `plan` (parallel.plan.Plan) supplies specs + mesh;
+    explicit `mesh`/`specs` override."""
+    if mesh is None and plan is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.plan import validate_spec
+    if mesh is None:
+        mesh = plan.build_mesh()
+    axis_sizes = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    spec = None
+    if specs is not None and name in specs:
+        spec = specs[name]
+    elif plan is not None:
+        spec = plan.param_rule(name, shape)
+    spec = validate_spec(spec, shape, axis_sizes)
+    return NamedSharding(mesh, spec if spec is not None else P())
+
+
+def _assemble_box(gdir, rec, box, dtype):
+    """One target shard's bytes, copied slice-by-slice from the source
+    shard files that overlap it (mmap reads: only the overlap is ever
+    touched) — the never-gather-to-host contract in code: no buffer
+    larger than one target shard exists."""
+    out = np.empty([b - a for a, b in box], dtype=dtype)
+    filled = 0
+    for s in rec['shards']:
+        sbox = tuple((int(a), int(a) + int(w))
+                     for a, w in zip(s['start'], s['shape']))
+        ov = _box_overlap(sbox, box) if box else \
+            (() if sbox == () else None)
+        if ov is None and box:
+            continue
+        src = np.load(os.path.join(gdir, s['file']), mmap_mode='r')
+        if not box:
+            return np.asarray(src).astype(dtype, copy=False)
+        src_idx = tuple(slice(lo - sa, hi - sa)
+                        for (lo, hi), (sa, _sb) in zip(ov, sbox))
+        dst_idx = tuple(slice(lo - ba, hi - ba)
+                        for (lo, hi), (ba, _bb) in zip(ov, box))
+        out[dst_idx] = src[src_idx]
+        filled += _box_volume(ov)
+    want = _box_volume(box) if box else 1
+    if filled != want:
+        raise ElasticCheckpointError(
+            'reshard: source shards cover %d of %d elements of a '
+            'target shard — manifest is inconsistent' % (filled, want),
+            reason='uncovered_param')
+    return out
+
+
+def load_checkpoint(dirname, program=None, scope=None, executor=None,
+                    generation=None, plan=None, mesh=None, specs=None):
+    """Load the newest intact generation (or `generation`, strictly)
+    into `scope`, resharding onto the target topology.
+
+    Target resolution, in order: explicit `mesh`/`specs`, a
+    ``parallel.plan.Plan``, the auto-shard planner when
+    ``FLAGS_auto_shard`` is on and a program is given, else plain host
+    arrays (the single-device posture — the runner re-places them).
+
+    With `generation` unset, torn generations are REFUSED (counted,
+    flight-dumped, reason recorded) and the scan continues to the next
+    older one; with it set, the refusal raises.  Returns an info dict:
+    generation, step, and the executed reshard schedule with predicted
+    vs measured seconds."""
+    from . import core
+    t0 = time.perf_counter()
+    scope = scope or core.global_scope()
+    gens = list_generations(dirname)
+    if not gens:
+        raise ElasticCheckpointError(
+            'no published generation under %s' % dirname,
+            reason='no_generation')
+    if generation is not None:
+        manifest = verify_generation(dirname, generation)
+        gen = int(generation)
+    else:
+        manifest = None
+        candidates = [latest_generation(dirname)] + \
+            [g for g in reversed(gens)]
+        seen = set()
+        for g in candidates:
+            if g is None or g in seen:
+                continue
+            seen.add(g)
+            try:
+                manifest = verify_generation(dirname, g)
+                gen = g
+                break
+            except ElasticCheckpointError as e:
+                _record_refusal(dirname, e)
+        if manifest is None:
+            raise ElasticCheckpointError(
+                'every generation under %s is torn (%s) — nothing '
+                'loadable' % (dirname,
+                              ', '.join(sorted(
+                                  '%s%08d' % (_GEN_PREFIX, g)
+                                  for g in gens))),
+                reason='no_generation')
+    gdir = _gen_dir(dirname, gen)
+    if program is not None:
+        # the native loader's missing-var guard, kept: a program
+        # persistable the checkpoint lacks (optimizer switched, layer
+        # added) must fail loudly, not silently train from fresh init
+        from .io import _persistable_vars
+        missing = [v.name for v in _persistable_vars(program)
+                   if v.name not in manifest['params']]
+        if missing:
+            raise ElasticCheckpointError(
+                'generation %d: program persistables missing from the '
+                'checkpoint: %s' % (gen, ', '.join(sorted(missing))),
+                reason='missing_var', generation=gen)
+    if plan is None and mesh is None and specs is None and \
+            program is not None:
+        try:
+            from ..parallel import plan as _ashard
+            if _ashard.enabled():
+                plan = _ashard.build_plan(program)
+        except Exception:
+            plan = None
+    # target shard grids: per param the distinct device boxes under
+    # the target sharding (None = one full-cover host box)
+    shardings = {}
+    targets = {}
+    for name, rec in manifest['params'].items():
+        shape = tuple(int(s) for s in rec['shape'])
+        sh = _target_sharding(name, shape, plan=plan, mesh=mesh,
+                              specs=specs)
+        shardings[name] = sh
+        if sh is None:
+            targets[name] = None
+        else:
+            boxes = set()
+            for _d, idx in sh.devices_indices_map(shape).items():
+                boxes.add(_box_from_index(idx, shape))
+            targets[name] = sorted(boxes)
+    schedule = plan_reshard(manifest, targets)
+    cap = _stage_cap()
+    wave_bytes = 0
+    waves = 1
+    pending = []
+    total_bytes = 0
+    t_reshard = time.perf_counter()
+    for name, rec in manifest['params'].items():
+        shape = tuple(int(s) for s in rec['shape'])
+        dtype = np.dtype(rec['dtype'])
+        sh = shardings[name]
+        if sh is None:
+            full_box = tuple((0, d) for d in shape)
+            arr = _assemble_box(gdir, rec, full_box, dtype)
+            value = arr.reshape(shape)
+            nbytes = value.nbytes
+        else:
+            import jax
+            per_box = {}
+            arrays = []
+            nbytes = 0
+            for dev, idx in sh.devices_indices_map(shape).items():
+                box = _box_from_index(idx, shape)
+                buf = per_box.get(box)
+                if buf is None:
+                    buf = _assemble_box(gdir, rec, box, dtype)
+                    per_box[box] = buf
+                    nbytes += buf.nbytes
+                arrays.append(jax.device_put(buf, dev))
+            value = jax.make_array_from_single_device_arrays(
+                shape, sh, arrays)
+        scope.set_var(name, value)
+        pending.append(value)
+        total_bytes += nbytes
+        wave_bytes += nbytes
+        if wave_bytes >= cap:
+            _drain_wave(pending)
+            pending = []
+            wave_bytes = 0
+            waves += 1
+    _drain_wave(pending)
+    measured = time.perf_counter() - t_reshard
+    # PS-resident tables ride the generation as a side file
+    tpath = os.path.join(gdir, '__dist_tables__.npz')
+    if program is not None and os.path.exists(tpath):
+        from .io import _program_ps_tables
+        data = dict(np.load(tpath).items())
+        for t in _program_ps_tables(program):
+            t.load_state_dict(data)
+    if executor is not None and manifest.get('step'):
+        # stochastic lowerings key RNG on (op_seed, step): a resumed
+        # trainer continues the SAME step sequence the save froze
+        executor._step = int(manifest['step'])
+    wall = time.perf_counter() - t0
+    ratio = (schedule['predicted_s'] / measured) if measured > 0 \
+        else 0.0
+    monitor.add('elastic/checkpoints_loaded')
+    monitor.add('elastic/load_bytes', float(total_bytes))
+    monitor.add('elastic/reshard_params',
+                float(len(manifest['params'])))
+    monitor.add('elastic/reshard_wire_bytes',
+                float(schedule['wire_bytes']))
+    monitor.add('elastic/staging_waves', float(waves))
+    if schedule['unpriced']:
+        monitor.add('elastic/reshard_unpriced',
+                    float(schedule['unpriced']))
+    monitor.observe('elastic/load_seconds', wall)
+    monitor.set_gauge('elastic/reshard_predicted_seconds',
+                      schedule['predicted_s'])
+    monitor.set_gauge('elastic/reshard_measured_seconds', measured)
+    monitor.set_gauge('elastic/reshard_pred_over_measured', ratio)
+    monitor.set_gauge('elastic/last_generation', float(gen))
+    dst_layout = None
+    if plan is not None:
+        dp, fsdp, tp = plan.layout
+        dst_layout = {'dp': dp, 'fsdp': fsdp, 'tp': tp}
+    elif mesh is not None:
+        dst_layout = {str(a): int(mesh.shape[a])
+                      for a in mesh.axis_names}
+    info = {
+        'generation': gen, 'step': manifest.get('step', 0),
+        'bytes': total_bytes, 'seconds': round(wall, 6),
+        'src_layout': manifest.get('layout'),
+        'dst_layout': dst_layout,
+        'reshard': {
+            'by_kind': schedule['by_kind'],
+            'wire_bytes': schedule['wire_bytes'],
+            'predicted_s': round(schedule['predicted_s'], 6),
+            'measured_s': round(measured, 6),
+            'pred_over_measured': round(ratio, 4),
+            'unpriced': schedule['unpriced'],
+            'staging_waves': waves,
+        },
+    }
+    with _lock:
+        _last['dir'] = os.path.abspath(dirname)
+        _last['load'] = info
+    return info
+
+
+def _drain_wave(pending):
+    """Seal one staging wave: block until the device owns every byte,
+    so the wave's host buffers can be dropped before the next wave
+    stages — the bounded-footprint half of the staging contract."""
+    if not pending:
+        return
+    try:
+        import jax
+        jax.block_until_ready([p for p in pending
+                               if isinstance(p, jax.Array)])
+    except Exception:
+        pass
+
+
+def _record_refusal(dirname, err):
+    monitor.add('elastic/refused_generations')
+    rec = {'dir': os.path.abspath(dirname),
+           'generation': err.generation, 'reason': err.reason,
+           'shard': err.shard, 'error': str(err),
+           'wall_unix': time.time()}
+    with _lock:
+        _refusals.append(rec)
+        del _refusals[:-_REFUSALS_CAP]
+    path = trace.dump_on_error(
+        'ckpt_refused_gen%s' % err.generation,
+        extra={'incident': 'refused_checkpoint', 'refusal': rec})
+    if path:
+        monitor.add('elastic/refusal_dumps')
+
+
+# ------------------------------------------------------------ resumption
+def resume(executor, dirname, program=None, feed_shapes=None,
+           fetch_list=None, scope=None, plan=None, mesh=None,
+           generation=None):
+    """Load the last-good generation onto THIS topology and drive
+    ``Executor.warmup`` through the persistent compile cache — the
+    N->M reconfiguration entry: seconds to first step, zero
+    post-warmup retraces.  Returns the load info dict."""
+    info = load_checkpoint(dirname, program=program, scope=scope,
+                           executor=executor, generation=generation,
+                           plan=plan, mesh=mesh)
+    if feed_shapes:
+        executor.warmup(program, feed_shapes, fetch_list,
+                        scope=scope, wait=True)
+        info['warmed'] = True
+    return info
+
+
+def rejoin_trainer(endpoint, trainer_id, dirname=None, program=None,
+                   scope=None, executor=None, timeout=60.0,
+                   interval=None):
+    """Re-admission of a restarted trainer: re-register the heartbeat
+    slot the dead predecessor's expiry freed (the pserver monitor's
+    ``FLAGS_heartbeat_misses`` tolerance decides when that happens)
+    and resume from the last-good generation.  Returns
+    (load_info | None, TrainerHeartbeat)."""
+    from ..distributed.rpc_ps import TrainerHeartbeat
+    hb = TrainerHeartbeat(endpoint, trainer_id, timeout=timeout,
+                          interval=interval)
+    info = None
+    if dirname and is_elastic_store(dirname):
+        info = load_checkpoint(dirname, program=program, scope=scope,
+                               executor=executor)
+    monitor.add('elastic/readmissions')
+    return info, hb
+
+
+# ----------------------------------------------------------- /statusz
+def report():
+    """The /statusz ``elastic`` section: store state, last save/load
+    (with the reshard schedule + predicted vs measured), refusal
+    trail, retry/backoff tallies."""
+    with _lock:
+        last = {k: v for k, v in _last.items()}
+        refusals = list(_refusals)
+    return {
+        'store_dir': last['dir'],
+        'last_generation': monitor.gauge_value(
+            'elastic/last_generation') or None,
+        'last_save': last['save'],
+        'last_load': last['load'],
+        'refusals': refusals,
+        'counters': {
+            k: monitor.counter_value('elastic/' + k)
+            for k in ('checkpoints_saved', 'checkpoints_loaded',
+                      'refused_generations', 'reshard_params',
+                      'staging_waves', 'readmissions',
+                      'heartbeat_flaps')},
+        'rpc': {
+            'retries': monitor.counter_value('rpc/retries'),
+            'backoff_seconds':
+                (monitor.histogram_value('rpc/backoff_seconds')
+                 or {}).get('sum', 0.0),
+            'deadline_errors':
+                monitor.counter_value('rpc/deadline_errors'),
+            'dropped_pushes':
+                monitor.counter_value('rpc/dropped_pushes'),
+        },
+    }

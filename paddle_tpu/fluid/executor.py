@@ -25,6 +25,7 @@ import jax
 
 from . import compile_cache
 from . import core
+from . import faultinject as _finject
 from . import framework
 from . import memviz as _memviz
 from . import monitor
@@ -1554,6 +1555,9 @@ class Executor(object):
                               tuple(fetch_names),
                               use_cache=use_program_cache)
         self._step += 1
+        if _finject.armed():
+            # chaos hook: 'executor.step:die@N' is worker death mid-run
+            _finject.check('executor.step', step=self._step)
         t0 = _time_mod.perf_counter()
         with _trace.step_span(self._step):
             out = self._run_plan(program, plan, feed, fetch_names,

@@ -199,6 +199,43 @@ _DEFAULTS = {
     # executables and unchanged plans never retrace.  Off (the
     # default) is bit-for-bit the hand-placed behavior.
     'FLAGS_auto_shard': False,
+    # elastic resilience plane (fluid/elastic.py): with the flag on,
+    # fluid.io.save_persistables writes the manifest-led elastic
+    # checkpoint format — per-shard files + sharding metadata +
+    # content digests, atomic tmp+rename publish, last-good
+    # generations kept — instead of the one-.npz native format.
+    # load_persistables auto-DETECTS an elastic store regardless of
+    # the flag (a manifest directory loads back, with cross-topology
+    # resharding, wherever it came from).  Off (the default) keeps
+    # the v1.6-shaped single-file save byte-identical.
+    'FLAGS_elastic_checkpoint': False,
+    # how many intact generations an elastic store retains after a
+    # successful publish (the newest is never pruned; >= 1)
+    'FLAGS_elastic_keep_generations': 2,
+    # host-side staging cap (bytes) for the reshard-on-load assembly:
+    # target shards are assembled and device_put in waves no larger
+    # than this (further bounded by the memviz budget headroom when
+    # the device reports one), so an N->M reshard never gathers a
+    # full model onto the host
+    'FLAGS_elastic_stage_bytes': 256 << 20,
+    # fault-injection harness (fluid/faultinject.py): semicolon-
+    # separated '<site>:<action>[:<arg>][@n[+]]' clauses armed at
+    # import — e.g. 'elastic.shard_write:die@2' kills the process on
+    # the 2nd checkpoint shard write.  Empty (the default) disarms:
+    # every instrumented site costs one module-global read.
+    'FLAGS_faultinject': '',
+    # worker-liveness miss tolerance (distributed/heartbeat.py + the
+    # rank-0 health aggregator): this many CONSECUTIVE missed
+    # scrapes/expired checks before a worker flips to down/lost — one
+    # dropped packet is not a death.  Recoveries short of the
+    # threshold count elastic/heartbeat_flaps.
+    'FLAGS_heartbeat_misses': 3,
+    # PS/RPC retry backoff (distributed/rpc_ps.py): bounded
+    # exponential backoff with full jitter between reconnect attempts
+    # — sleep in [0.5, 1.0] x min(base x 2^(attempt-1), max).  base
+    # 0 disables (the pre-elastic immediate-retry behavior).
+    'FLAGS_rpc_backoff_ms': 50,
+    'FLAGS_rpc_backoff_max_ms': 2000,
     # f32 conv MXU precision: 'highest' (6-pass bf16 emulation,
     # reference-accurate fp32 — the default), 'high' (3-pass), or
     # 'default' (single-pass bf16 inputs).  Escape hatch for an XLA

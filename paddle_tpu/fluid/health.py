@@ -249,6 +249,23 @@ def statusz():
             auto_shard_section = rep
     except Exception:
         pass
+    # elastic resilience plane (fluid.elastic + fluid.faultinject):
+    # last checkpoint generation, the executed reshard schedule with
+    # predicted-vs-measured seconds, refusals, RPC retry/backoff
+    # tallies, and the fault-injection harness state — 'can this job
+    # die and come back, and did anything get injected' in one scrape
+    elastic_section = None
+    try:
+        from . import elastic, faultinject
+        rep = elastic.report()
+        fi = faultinject.report()
+        if rep.get('last_generation') or rep.get('last_load') or \
+                rep.get('refusals') or fi.get('armed') or \
+                rep['rpc'].get('retries') or \
+                rep['counters'].get('readmissions'):
+            elastic_section = dict(rep, faultinject=fi)
+    except Exception:
+        pass
     # aggregator rank: per-rank liveness + last-heartbeat skew, so one
     # /statusz answers 'is the job healthy and who is the straggler'
     job_section = None
@@ -266,6 +283,7 @@ def statusz():
         'memory': memory_section,
         'comms_plan': comms_plan_section,
         'auto_shard': auto_shard_section,
+        'elastic': elastic_section,
         'job': job_section,
         'flags': _all_flags(),
         'versions': versions,
@@ -468,8 +486,10 @@ class _Aggregator(object):
     """Rank 0's merged view of the job: a background prober scrapes
     every worker's /metrics.json each heartbeat interval; /metrics and
     /healthz on the owning server read the cached results, so a dead
-    worker flips readiness within one interval without any request
-    traffic."""
+    worker flips readiness within ``FLAGS_heartbeat_misses`` intervals
+    (default 3 — ONE dropped scrape of a previously-up worker is a
+    flap, ``elastic/heartbeat_flaps``, not a death) without any
+    request traffic."""
 
     def __init__(self, self_rank, workers, interval):
         self.self_rank = str(self_rank)
@@ -477,6 +497,10 @@ class _Aggregator(object):
         self.workers = [(r, ep) for r, ep in self.all_workers
                         if r != self.self_rank]
         self.interval = float(interval)
+        self.misses = max(1, int(get_flag('FLAGS_heartbeat_misses', 3)
+                                 or 3))
+        self._miss = {r: 0 for r, _ep in self.workers}
+        self._was_up = set()
         self._lock = threading.Lock()
         self._peers = {r: {'endpoint': ep, 'up': False, 'ready': False,
                            'state': None, 'status': None, 'error': None,
@@ -515,9 +539,33 @@ class _Aggregator(object):
                         'status': None, 'rollup': None,
                         'error': str(e)})
         with self._lock:
+            prev = self._peers[rank]
+            if rec['up']:
+                misses = self._miss.get(rank, 0)
+                if 0 < misses < self.misses and rank in self._was_up:
+                    # recovered short of the threshold: a flap, not a
+                    # death-and-readmission
+                    monitor.add('elastic/heartbeat_flaps')
+                elif misses >= self.misses and rank in self._was_up:
+                    # a worker declared down answering again is a
+                    # RE-ADMISSION (restarted, or partition healed) —
+                    # the heartbeat.py accounting, mirrored.  A fresh
+                    # worker's slow boot is neither.
+                    monitor.add('elastic/readmissions')
+                self._was_up.add(rank)
+                self._miss[rank] = 0
+            else:
+                self._miss[rank] = self._miss.get(rank, 0) + 1
+                if prev['up'] and self._miss[rank] < self.misses:
+                    # tolerated miss: keep the last good scrape's
+                    # up/ready/state so one dropped packet does not
+                    # flip job readiness (the error is still recorded)
+                    rec = {'endpoint': rec['endpoint'],
+                           'ts': rec['ts'], 'error': rec['error']}
             self._peers[rank].update(rec)
+            up_now = self._peers[rank]['up']
         monitor.set_gauge('health/worker_up/%s' % rank,
-                          1.0 if rec['up'] else 0.0)
+                          1.0 if up_now else 0.0)
 
     # ------------------------------------------- straggler / skew
     def skew(self):

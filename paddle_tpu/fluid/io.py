@@ -14,7 +14,49 @@ import numpy as np
 
 from . import core
 from . import framework
+from .flags import get_flag
 from .framework import Program, Parameter
+
+
+def _atomic_write(path, write_fn):
+    """One atomic-publish helper for every save this module performs:
+    `write_fn(tmp)` produces the bytes at a `<path>.tmp-<pid>`
+    sibling (returning the actual file it wrote when the writer
+    renames/suffixes, e.g. np.savez appending '.npz'), then ONE
+    ``os.replace`` publishes — the compile_cache entry pattern, so a
+    kill mid-save can never shadow a previously-good file with a torn
+    one, and a failed write leaves no debris."""
+    tmp = path + '.tmp-%d' % os.getpid()
+    wrote = None
+    try:
+        wrote = write_fn(tmp) or tmp
+        os.replace(wrote, path)
+    finally:
+        for t in {tmp, wrote or tmp}:
+            if os.path.exists(t):
+                os.unlink(t)
+
+
+def _atomic_savez(path, arrs):
+    def write(tmp):
+        # np.savez appends .npz to a suffix-less target: report (and
+        # on failure, clean) the name it actually wrote
+        suffixed = tmp if tmp.endswith('.npz') else tmp + '.npz'
+        try:
+            np.savez(tmp, **arrs)
+        except BaseException:
+            if os.path.exists(suffixed):
+                os.unlink(suffixed)
+            raise
+        return suffixed if os.path.exists(suffixed) else tmp
+    _atomic_write(path, write)
+
+
+def _atomic_json_dump(path, doc):
+    def write(tmp):
+        with open(tmp, 'w') as f:
+            json.dump(doc, f)
+    _atomic_write(path, write)
 
 
 def _persistable_vars(program):
@@ -47,19 +89,24 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         arrs.append((v.name, np.asarray(core.as_array(val))))
     if save_format == 'paddle':
         from . import paddle_format
+
+        def _atomic_tensors(path, records):
+            _atomic_write(
+                path, lambda tmp: paddle_format.save_tensors(tmp,
+                                                             records))
         if filename is not None:
-            paddle_format.save_tensors(os.path.join(dirname, filename),
-                                       arrs)
+            _atomic_tensors(os.path.join(dirname, filename), arrs)
         else:
             for name, arr in arrs:
-                paddle_format.save_tensors(os.path.join(dirname, name),
-                                           [(name, arr)])
+                _atomic_tensors(os.path.join(dirname, name),
+                                [(name, arr)])
         return
     if save_format != 'native':
         raise ValueError("save_format must be 'native' or 'paddle'")
     if filename is None:
         filename = '__model_params__'
-    np.savez(os.path.join(dirname, filename + '.npz'), **dict(arrs))
+    _atomic_savez(os.path.join(dirname, filename + '.npz'),
+                  dict(arrs))
 
 
 def _load_vars_paddle_format(dirname, vars, filename):
@@ -168,6 +215,16 @@ def _program_ps_tables(program):
 def save_persistables(executor, dirname, main_program=None, filename=None,
                       save_format='native'):
     main_program = main_program or framework.default_main_program()
+    if save_format == 'native' and \
+            get_flag('FLAGS_elastic_checkpoint', False):
+        # elastic resilience plane: manifest-led generations with
+        # per-shard digests, atomic publish, last-good kept —
+        # cross-topology-reloadable via load_persistables' detection
+        # (filename has no meaning in the manifest format)
+        from . import elastic
+        ex = executor if hasattr(executor, '_step') else None
+        elastic.save_checkpoint(dirname, main_program, executor=ex)
+        return
     save_vars(executor, dirname, main_program,
               vars=_persistable_vars(main_program), filename=filename,
               save_format=save_format)
@@ -176,11 +233,19 @@ def save_persistables(executor, dirname, main_program=None, filename=None,
         arrs = {}
         for t in tables:
             arrs.update(t.state_dict())
-        np.savez(os.path.join(dirname, '__dist_tables__.npz'), **arrs)
+        _atomic_savez(os.path.join(dirname, '__dist_tables__.npz'),
+                      arrs)
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):
     main_program = main_program or framework.default_main_program()
+    from . import elastic
+    if elastic.is_elastic_store(dirname):
+        # elastic store (any writer): newest intact generation, torn
+        # ones refused by name, resharded onto this topology
+        ex = executor if hasattr(executor, '_step') else None
+        elastic.load_checkpoint(dirname, main_program, executor=ex)
+        return
     load_vars(executor, dirname, main_program,
               vars=_persistable_vars(main_program), filename=filename)
     path = os.path.join(dirname, '__dist_tables__.npz')
@@ -222,8 +287,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                         for v in target_vars],
     }
     model_filename = model_filename or '__model__'
-    with open(os.path.join(dirname, model_filename + '.json'), 'w') as f:
-        json.dump(model, f)
+    _atomic_json_dump(os.path.join(dirname, model_filename + '.json'),
+                      model)
     if not program_only:
         save_persistables(executor, dirname, main_program,
                           filename=params_filename)
@@ -274,17 +339,16 @@ def save_train_model(dirname, main_program, startup_program, feed_names,
     saved by fluid.io.save_inference_model's training counterpart.
     """
     os.makedirs(dirname, exist_ok=True)
-    with open(os.path.join(dirname, 'main.json'), 'w') as f:
-        json.dump(main_program.to_dict(), f)
-    with open(os.path.join(dirname, 'startup.json'), 'w') as f:
-        json.dump(startup_program.to_dict(), f)
+    _atomic_json_dump(os.path.join(dirname, 'main.json'),
+                      main_program.to_dict())
+    _atomic_json_dump(os.path.join(dirname, 'startup.json'),
+                      startup_program.to_dict())
     spec = {
         'feed_names': list(feed_names),
         'fetch_names': [v.name if isinstance(v, framework.Variable) else v
                         for v in fetch_vars],
     }
-    with open(os.path.join(dirname, 'train_spec.json'), 'w') as f:
-        json.dump(spec, f)
+    _atomic_json_dump(os.path.join(dirname, 'train_spec.json'), spec)
 
 
 def load_train_model(dirname):

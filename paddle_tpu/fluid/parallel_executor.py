@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import comms
 from . import compile_cache
 from . import core
+from . import faultinject as _finject
 from . import memviz as _memviz
 from . import monitor
 from . import trace as _trace
@@ -510,6 +511,10 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         seg.comms_key = fp
     recs = comms.records_for(seg.comms_key)
     try:
+        if _finject.armed():
+            # chaos hook: 'collective.dispatch:stall:<s>' is a
+            # straggling collective, 'fail' a fabric fault
+            _finject.check('collective.dispatch', step=executor._step)
         t0 = _time_mod.perf_counter()
         if first_run:
             # the first call runs the deferred jit trace: collect the
@@ -693,6 +698,9 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
             step = jnp.asarray(executor._step)
         recs = comms.records_for(seg.comms_key)
         try:
+            if _finject.armed():
+                _finject.check('collective.dispatch',
+                               step=executor._step)
             t0 = _time_mod.perf_counter()
             if first_run:
                 # first call runs the deferred jit trace: collect the

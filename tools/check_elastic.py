@@ -1,0 +1,326 @@
+"""Elastic-resilience gate: the crash-consistent checkpoint store and
+cross-topology resharding must survive REAL process boundaries (the
+fluid/elastic.py analog of check_compile_cache.py's posture).
+
+Note on topology: cross-process jax collectives are unavailable on
+this container's CPU backend (the known env-level limitation the
+tier-1 suite documents), so "rank" here is the suite's standard
+cluster-in-a-box posture — devices of a virtual host platform — while
+every save/restart boundary is a REAL OS process boundary, which is
+what the store and the compile cache actually gate.
+
+Phases, one shared store + compile-cache dir:
+
+  1. a child process trains a dp2 layout over 2 host devices
+     (CompiledProgram runner) with FLAGS_elastic_checkpoint=1 and
+     saves MID-RUN through fluid.io.save_persistables, then keeps
+     training (the continuation trajectory is the parity reference);
+  2. a fresh process restarts as ONE device: load_persistables
+     auto-detects the store, resumes on the same global batches at
+     loss parity, and Executor.warmup() + the persistent compile
+     cache give ZERO post-warmup retraces;
+  3. a fresh process restarts as a DIFFERENT layout (fsdp2 via the
+     auto-shard planner): parity again, elastic/reshard_* populated;
+  4. chaos: a child killed MID-SAVE (faultinject
+     'elastic.shard_write:die') must leave the previous generation
+     loadable; a child writing a TORN shard must publish a generation
+     the loader refuses BY NAME before falling back to last-good.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRE_STEPS = 3     # steps before the checkpoint
+POST_STEPS = 3    # steps after it (the compared trajectory)
+
+
+def build_model():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[8], dtype='float32')
+        y = fluid.layers.data('y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(x, 16, act='relu')
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def make_batches(steps=PRE_STEPS + POST_STEPS, n=8):
+    import numpy as np
+    rng = np.random.RandomState(5)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(n, 8).astype('float32')
+        y = x.sum(1, keepdims=True).astype('float32') * 0.5
+        out.append((x, y))
+    return out
+
+
+def _f(v):
+    import numpy as np
+    return float(np.asarray(v).ravel()[0])
+
+
+def _param_sample(main):
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.parallel_executor import _fetch_to_host
+    pname = main.all_parameters()[0].name
+    return np.asarray(_fetch_to_host(
+        fluid.global_scope().find_var(pname))).tolist()
+
+
+def _compiled(main, loss, ndev, layout=None):
+    import paddle_tpu.fluid as fluid
+    comp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name,
+        places=[fluid.XLAPlace(i) for i in range(ndev)])
+    if layout is not None:
+        from paddle_tpu.parallel import plan as ashard
+        comp._auto_plan = ashard.build_plan(main, ndev=ndev,
+                                            layouts=[layout])
+    return comp
+
+
+def child_main(mode, ckpt_dir):
+    """One process of the gate.  Modes: 'save2' (dp2 trainer that
+    saves mid-run), 'single' (1-device resume through warmup),
+    'fsdp2' (different-layout resume), 'chaos-save' (one more
+    generation under the parent's FLAGS_faultinject)."""
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import elastic, monitor
+    main, startup, loss = build_model()
+    batches = make_batches()
+    out = {}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        if mode == 'save2':
+            target = _compiled(main, loss, 2)
+            exe.run(startup)
+            losses = []
+            for i, (x, y) in enumerate(batches):
+                l, = exe.run(target, feed={'x': x, 'y': y},
+                             fetch_list=[loss])
+                losses.append(_f(l))
+                if i + 1 == PRE_STEPS:
+                    fluid.io.save_persistables(exe, ckpt_dir, main)
+            out = {'losses': losses,
+                   'saved': monitor.counter_value(
+                       'elastic/checkpoints_saved'),
+                   'save_bytes': monitor.counter_value(
+                       'elastic/save_bytes')}
+        elif mode == 'chaos-save':
+            fluid.io.load_persistables(exe, ckpt_dir, main)
+            x, y = batches[0]
+            exe.run(main, feed={'x': x, 'y': y}, fetch_list=[loss])
+            elastic.save_checkpoint(ckpt_dir, main, executor=exe)
+            print('SAVE_DONE')
+            return
+        else:
+            if mode == 'fsdp2':
+                fluid.set_flags({'FLAGS_auto_shard': True})
+                target = _compiled(main, loss, 2, layout=(1, 2, 1))
+                fluid.io.load_persistables(exe, ckpt_dir, main)
+                lowered_after_warmup = None
+            else:       # 'single': 1 device, warmup, zero retraces
+                target = main
+                fluid.io.load_persistables(exe, ckpt_dir, main)
+                x0, y0 = batches[PRE_STEPS]
+                exe.warmup(main, feed_shapes={'x': x0, 'y': y0},
+                           fetch_list=[loss], wait=True)
+                lowered_after_warmup = monitor.counter_value(
+                    'executor/segments_lowered')
+            losses = []
+            for x, y in batches[PRE_STEPS:]:
+                l, = exe.run(target, feed={'x': x, 'y': y},
+                             fetch_list=[loss])
+                losses.append(_f(l))
+            rep = elastic.report()
+            out = {
+                'losses': losses,
+                'loaded_generation':
+                    (rep['last_load'] or {}).get('generation'),
+                'reshard_by_kind':
+                    ((rep['last_load'] or {}).get('reshard')
+                     or {}).get('by_kind'),
+                'reshard_params': monitor.counter_value(
+                    'elastic/reshard_params'),
+                'staging_waves': monitor.counter_value(
+                    'elastic/staging_waves'),
+                'refused': monitor.counter_value(
+                    'elastic/refused_generations'),
+                'refusal_shard': (rep['refusals'][-1]['shard']
+                                  if rep['refusals'] else None),
+                'lowered_after_warmup': lowered_after_warmup,
+                'lowered_total': monitor.counter_value(
+                    'executor/segments_lowered'),
+                'disk_hit': monitor.counter_value(
+                    'executor/compile_cache_disk_hit'),
+            }
+        out['param'] = _param_sample(main)
+    print('CHECK_JSON ' + json.dumps(out))
+
+
+# ------------------------------------------------------------- driver
+def _spawn(mode, ckpt, extra_env=None, timeout=540):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--child', mode,
+         ckpt],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _child_json(p):
+    for line in p.stdout.splitlines():
+        if line.startswith('CHECK_JSON '):
+            return json.loads(line[len('CHECK_JSON '):])
+    raise RuntimeError('child produced no CHECK_JSON (rc=%d)\n%s\n%s'
+                       % (p.returncode, p.stdout[-2000:],
+                          p.stderr[-2000:]))
+
+
+def main():
+    if '--child' in sys.argv:
+        i = sys.argv.index('--child')
+        sys.path.insert(0, REPO)
+        return child_main(sys.argv[i + 1], sys.argv[i + 2])
+
+    import numpy as np
+    work = tempfile.mkdtemp(prefix='pt_elastic_check_')
+    ckpt = os.path.join(work, 'store')
+    cache = os.path.join(work, 'cache')
+    dev2 = {'XLA_FLAGS': '--xla_force_host_platform_device_count=2'}
+    failures = []
+    try:
+        # ---- phase 1: dp2 trainer saves mid-run, keeps training
+        p1 = _child_json(_spawn(
+            'save2', ckpt, dict(dev2, FLAGS_elastic_checkpoint='1',
+                                FLAGS_compile_cache_dir=cache)))
+        print('phase 1: dp2 trainer saved %d bytes mid-run, trained '
+              '%d steps' % (p1['save_bytes'], len(p1['losses'])))
+        if p1['saved'] != 1:
+            failures.append('saver wrote %r generations, wanted 1'
+                            % p1['saved'])
+        ref_losses = p1['losses'][PRE_STEPS:]
+        ref_param = np.asarray(p1['param'])
+
+        # ---- phase 2: restart as ONE device, warmup, zero retraces
+        p2 = _child_json(_spawn(
+            'single', ckpt, {'FLAGS_compile_cache_dir': cache}))
+        print('phase 2: single-device resume, gen %s, %d reshard '
+              'params, %d segments lowered post-warmup'
+              % (p2['loaded_generation'], p2['reshard_params'],
+                 p2['lowered_total'] - p2['lowered_after_warmup']))
+        if p2['loaded_generation'] != 1:
+            failures.append('restart loaded generation %r, wanted 1'
+                            % p2['loaded_generation'])
+        if not np.allclose(p2['losses'], ref_losses, rtol=1e-4,
+                           atol=1e-6):
+            failures.append('single-device resume diverged: %r vs %r'
+                            % (p2['losses'], ref_losses))
+        if not np.allclose(p2['param'], ref_param, rtol=1e-4,
+                           atol=1e-6):
+            failures.append('single-device resumed params diverged')
+        if p2['lowered_total'] != p2['lowered_after_warmup']:
+            failures.append('%d segments re-traced AFTER warmup '
+                            '(must be 0)'
+                            % (p2['lowered_total'] -
+                               p2['lowered_after_warmup']))
+        if p2['reshard_params'] <= 0:
+            failures.append('restart reported no resharded params')
+
+        # ---- phase 3: restart as a DIFFERENT layout (fsdp2)
+        p3 = _child_json(_spawn(
+            'fsdp2', ckpt, dict(dev2, FLAGS_compile_cache_dir=cache)))
+        print('phase 3: fsdp2-layout resume, gen %s, schedule %s, '
+              'staging waves %d'
+              % (p3['loaded_generation'], p3['reshard_by_kind'],
+                 p3['staging_waves']))
+        if p3['loaded_generation'] != 1:
+            failures.append('fsdp2 restart loaded generation %r'
+                            % p3['loaded_generation'])
+        if not np.allclose(p3['losses'], ref_losses, rtol=1e-4,
+                           atol=1e-6):
+            failures.append('fsdp2 resume diverged: %r vs %r'
+                            % (p3['losses'], ref_losses))
+        if not np.allclose(p3['param'], ref_param, rtol=1e-4,
+                           atol=1e-6):
+            failures.append('fsdp2 resumed params diverged')
+        if not p3['reshard_by_kind']:
+            failures.append('fsdp2 restart recorded no reshard '
+                            'schedule')
+        if p3['staging_waves'] <= 0:
+            failures.append('fsdp2 restart recorded no staging waves')
+
+        # ---- phase 4a: kill -9 mid-save never corrupts last-good
+        gens_before = sorted(e for e in os.listdir(ckpt)
+                             if e.startswith('gen-'))
+        pk = _spawn('chaos-save', ckpt,
+                    {'FLAGS_faultinject': 'elastic.shard_write:die@2'})
+        if pk.returncode != 9:
+            failures.append('mid-save kill child exited %d, wanted 9'
+                            % pk.returncode)
+        gens_after = sorted(e for e in os.listdir(ckpt)
+                            if e.startswith('gen-'))
+        if gens_before != gens_after:
+            failures.append('killed save published a generation: %r '
+                            '-> %r' % (gens_before, gens_after))
+        pv = _child_json(_spawn('single', ckpt))
+        if pv['loaded_generation'] != 1:
+            failures.append('store unloadable after mid-save kill '
+                            '(gen %r)' % pv['loaded_generation'])
+        print('phase 4a: mid-save kill left generation 1 loadable')
+
+        # ---- phase 4b: a torn PUBLISHED shard is refused by name
+        pt = _spawn('chaos-save', ckpt,
+                    {'FLAGS_faultinject': 'elastic.shard_write:torn@2'})
+        if pt.returncode != 0 or 'SAVE_DONE' not in pt.stdout:
+            failures.append('torn-write child failed rc=%d\n%s'
+                            % (pt.returncode, pt.stderr[-1000:]))
+        pr = _child_json(_spawn('single', ckpt))
+        if pr['refused'] != 1:
+            failures.append('torn generation was not refused '
+                            '(refused=%r)' % pr['refused'])
+        if not pr['refusal_shard'] or \
+                not str(pr['refusal_shard']).endswith('.npy'):
+            failures.append('refusal did not name the torn shard '
+                            '(%r)' % pr['refusal_shard'])
+        if pr['loaded_generation'] != 1:
+            failures.append('loader did not fall back to last-good '
+                            '(gen %r)' % pr['loaded_generation'])
+        print('phase 4b: torn shard %s refused by name, last-good '
+              'loaded' % pr['refusal_shard'])
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    if failures:
+        print('\ncheck_elastic FAILURES:')
+        for f in failures:
+            print('  - ' + f)
+        return 1
+    print('\ncheck_elastic OK: crash-consistent store survives '
+          'kill -9, torn shards refused by name, dp2 -> single and '
+          'dp2 -> fsdp2 resumes at parity with zero post-warmup '
+          'retraces')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -235,6 +235,47 @@ REQUIRED = [
     ('paddle_tpu/fluid/health.py', 'auto_shard_plan.report'),
     ('tools/stat_summary.py', 'parallel/plan_hbm_rejected'),
     ('bench.py', '_autoshard_fields'),
+    # elastic resilience plane (fluid/elastic.py + fluid/faultinject.py
+    # + the rpc/heartbeat retry satellites): crash-consistent store
+    # volume, refusal accounting, the reshard schedule's predicted-vs-
+    # measured honesty, staged-assembly waves, trainer re-admission,
+    # heartbeat flap tolerance, rpc backoff, and the fault-injection
+    # tallies — tools/check_elastic.py exercises the plane across real
+    # process boundaries including a kill -9 mid-save
+    ('paddle_tpu/fluid/elastic.py', 'elastic/checkpoints_saved'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/checkpoints_loaded'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/save_bytes'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/save_seconds'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/load_seconds'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/shards_written'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/last_generation'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/generations_pruned'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/refused_generations'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/refusal_dumps'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/reshard_params'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/reshard_wire_bytes'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/reshard_unpriced'),
+    ('paddle_tpu/fluid/elastic.py',
+     'elastic/reshard_predicted_seconds'),
+    ('paddle_tpu/fluid/elastic.py',
+     'elastic/reshard_measured_seconds'),
+    ('paddle_tpu/fluid/elastic.py',
+     'elastic/reshard_pred_over_measured'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/staging_waves'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/readmissions'),
+    ('paddle_tpu/distributed/heartbeat.py', 'elastic/readmissions'),
+    ('paddle_tpu/distributed/heartbeat.py',
+     'elastic/heartbeat_flaps'),
+    ('paddle_tpu/fluid/health.py', 'elastic/heartbeat_flaps'),
+    ('paddle_tpu/fluid/faultinject.py', 'faultinject/armed'),
+    ('paddle_tpu/fluid/faultinject.py', 'faultinject/hits'),
+    ('paddle_tpu/fluid/faultinject.py', 'faultinject/fired'),
+    ('paddle_tpu/distributed/rpc_ps.py', 'rpc/backoff_seconds'),
+    ('paddle_tpu/distributed/rpc_ps.py', 'rpc_exhausted'),
+    ('paddle_tpu/fluid/executor.py', '_finject.check'),
+    ('paddle_tpu/fluid/parallel_executor.py', '_finject.check'),
+    ('paddle_tpu/fluid/health.py', 'elastic.report'),
+    ('bench.py', '_elastic_fields'),
 ]
 
 
