@@ -69,8 +69,15 @@ bench:
 # torn shards refused by name) and resume a checkpoint across
 # process and layout changes at loss parity with zero post-warmup
 # retraces
+# and the static program verifier must catch every seeded defect
+# class by name in a real executor run while the tier-1 model corpus
+# verifies clean and the disabled path stays within the hot-path
+# budgets, and the repo must hold its flag-hygiene and
+# lock-discipline lints
 check:
 	python tools/check_stat_coverage.py
+	python tools/staticcheck.py
+	JAX_PLATFORMS=cpu python tools/check_progcheck.py
 	JAX_PLATFORMS=cpu python tools/check_hot_path.py
 	JAX_PLATFORMS=cpu python tools/check_compile_cache.py
 	JAX_PLATFORMS=cpu python tools/check_trace.py

@@ -417,6 +417,50 @@ def bucket_grads(grads, cap_bytes=None, fuse_cutoff=None):
     return buckets
 
 
+def verify_buckets(block, buckets):
+    """Static legality of a bucket rewrite BEFORE the collective ops
+    land (fluid.progcheck discipline — legality first, pricing
+    second): every bucketed grad must be a declared block var, carry
+    the bucket's dtype, and appear in exactly one bucket.  A planner
+    rewrite that tears one of these produces an elementwise-wrong (or
+    untraceable) fused reduction; raise with the defect named instead.
+    Returns the verified bucket list unchanged."""
+    import time as _time
+    from . import progcheck
+    t0 = _time.perf_counter()
+    rep = progcheck.Report('comms_plan', 'transpile:bucket')
+    seen = {}
+    for bi, b in enumerate(buckets):
+        for name in b['names']:
+            if name in seen:
+                rep.add(progcheck.Diagnostic(
+                    'shard_conflict',
+                    'grad %r appears in buckets %d and %d — it would '
+                    'reduce twice' % (name, seen[name], bi), var=name))
+            seen[name] = bi
+            v = block._find_var_recursive(name)
+            if v is None:
+                rep.add(progcheck.Diagnostic(
+                    'undefined_read',
+                    'bucket %d names grad %r which no block declares'
+                    % (bi, name), var=name))
+                continue
+            if len(b['names']) > 1 and v.dtype != b['dtype']:
+                rep.add(progcheck.Diagnostic(
+                    'dtype_mismatch',
+                    'grad %r is %s but joined a %s fused bucket — the '
+                    'concat would silently cast'
+                    % (name, v.dtype, b['dtype']), var=name))
+    rep.ops_checked = len(buckets)
+    rep.seconds = _time.perf_counter() - t0
+    # the shared recording path: counters, /statusz report trail,
+    # stat_summary --verify all see bucket verifications too
+    progcheck._record(rep)
+    if not rep.ok():
+        raise progcheck.ProgramVerifyError(rep)
+    return buckets
+
+
 def order_axes(axes):
     """Deterministic mesh-axis order for a multi-axis reduce
     synthesized as per-axis phases: largest axis first

@@ -1372,8 +1372,30 @@ class Executor(object):
                 tuple(int(s) for s in shape), canon(dtype))
 
         feed_specs = {k: as_spec(v) for k, v in feed_shapes.items()}
-        plan = self._get_plan(program, tuple(sorted(feed_specs)),
-                              tuple(fetch_names), prefer_test)
+        # suppress the plan-build verify hook for this _get_plan: the
+        # forced warmup verification below re-runs the pass with the
+        # richer boundary feed_specs — verifying twice would double
+        # every verify/* stat and burn the /statusz trail
+        self._warmup_verifies = True
+        try:
+            plan = self._get_plan(program, tuple(sorted(feed_specs)),
+                                  tuple(fetch_names), prefer_test)
+        finally:
+            self._warmup_verifies = False
+        # FORCED static verification (flag or not): warmup is the
+        # declared pre-compile step, so an illegal graph must fail
+        # here with a named diagnostic, not as a tracer stack five
+        # frames deep.  Flag off runs the O(ops) invariant + donation
+        # pass; flag on adds the shape/dtype walk seeded with the
+        # warmup boundary specs.
+        from . import progcheck as _progcheck
+        _progcheck.verify_program(
+            program, feed_names=tuple(sorted(feed_specs)),
+            fetch_names=tuple(fetch_names),
+            feed_specs={k: (tuple(v.shape), v.dtype)
+                        for k, v in feed_specs.items()},
+            plan=plan, origin='warmup',
+            level='full' if _progcheck.enabled() else 'fast')
         auto = bool(get_flag('FLAGS_segment_auto_layout'))
         wpg = bool(get_flag('FLAGS_whole_program_grad'))
         device = self.place.jax_device()
@@ -1660,6 +1682,8 @@ class Executor(object):
                 for it in plan:
                     if isinstance(it, _Segment):
                         it.prefer_test = True
+            self._verify_plan_build(program, plan, feed_names,
+                                    fetch_names)
             return plan
         # prefer_test keys the cache so test-mode lowering never shares
         # executables with the training-mode plan
@@ -1675,8 +1699,30 @@ class Executor(object):
                 for it in plan:
                     if isinstance(it, _Segment):
                         it.prefer_test = True
+            self._verify_plan_build(program, plan, feed_names,
+                                    fetch_names)
             program._exec_cache[key] = plan
         return plan
+
+    def _verify_plan_build(self, program, plan, feed_names,
+                           fetch_names):
+        """Static-verification hook on the plan-BUILD path (cache
+        misses only — the steady state never comes here): consult the
+        'progcheck.mutate' chaos site, then run the fluid.progcheck
+        pass when FLAGS_program_verify is on.  Error-class findings
+        raise ProgramVerifyError before anything traces."""
+        from .flags import get_flag
+        if _finject.armed():
+            c = _finject.check('progcheck.mutate')
+            if c is not None and c['action'] == 'mutate':
+                from . import progcheck
+                progcheck.mutate(program, c['arg'] or 1, plan=plan)
+        if get_flag('FLAGS_program_verify') and \
+                not getattr(self, '_warmup_verifies', False):
+            from . import progcheck
+            progcheck.verify_program(program, feed_names=feed_names,
+                                     fetch_names=fetch_names,
+                                     plan=plan, origin='run')
 
     # host ops with no program-state writes (print/save write stdout /
     # files, never scope vars): deferring one past later device ops is

@@ -20,7 +20,8 @@ semicolon-separated clauses::
   engages), ``raise`` (raise ``FaultInjected``), ``delay``/``stall``
   (sleep ``arg`` seconds, default 0.05), ``torn`` (returned to the
   caller, which truncates its write), ``drop`` (returned to the
-  caller, which skips its send)
+  caller, which skips its send), ``mutate`` (returned to the caller,
+  which corrupts an op desc per ``arg`` = progcheck defect kind)
 - ``@n``     — fire on the n'th hit of the site only (1-based);
   ``@n+`` fires on the n'th and every later hit; absent = ``@1+``
 
@@ -49,6 +50,13 @@ failing chaos run replays exactly.
                         (``stall`` = a straggling collective)
 ``heartbeat.send``      per trainer heartbeat ping (``drop`` = a
                         missed heartbeat without killing the sender)
+``progcheck.mutate``    per executor plan build (``mutate:<kind>`` =
+                        deterministically corrupt one op desc —
+                        dangling input, dtype flip, torn sub-block,
+                        ... — see ``progcheck.MUTATIONS``; the static
+                        verifier must then catch the defect class BY
+                        NAME, which ``tools/check_progcheck.py``
+                        proves in ``make check``)
 ====================== ===============================================
 
 Disabled cost: one module-global read per site (``_armed`` is None
@@ -76,9 +84,11 @@ __all__ = [
 SITES = (
     'elastic.shard_write', 'elastic.publish', 'rpc.call',
     'executor.step', 'collective.dispatch', 'heartbeat.send',
+    'progcheck.mutate',
 )
 
-_ACTIONS = ('die', 'fail', 'raise', 'delay', 'stall', 'torn', 'drop')
+_ACTIONS = ('die', 'fail', 'raise', 'delay', 'stall', 'torn', 'drop',
+            'mutate')
 
 
 class FaultInjected(RuntimeError):
@@ -119,7 +129,13 @@ def _parse_clause(text):
                          % (action, ', '.join(_ACTIONS)))
     arg = None
     if len(parts) > 2:
-        arg = float(parts[2])
+        raw = parts[2].strip()
+        try:
+            arg = float(raw)
+        except ValueError:
+            # named args: 'progcheck.mutate:mutate:dtype_flip' — the
+            # consumer (progcheck.mutate) resolves the name
+            arg = raw
     return {'site': site, 'action': action, 'arg': arg,
             'nth': nth, 'plus': plus}
 
@@ -206,7 +222,7 @@ def check(site, **ctx):
     if action in ('delay', 'stall'):
         time.sleep(c['arg'] if c['arg'] is not None else 0.05)
         return None
-    return c   # 'torn' / 'drop': the caller implements the damage
+    return c   # 'torn'/'drop'/'mutate': the caller implements the damage
 
 
 def fired(site=None):

@@ -218,6 +218,18 @@ _DEFAULTS = {
     # the device reports one), so an N->M reshard never gathers a
     # full model onto the host
     'FLAGS_elastic_stage_bytes': 256 << 20,
+    # static Program verifier (fluid/progcheck.py): with the flag on,
+    # every plan build runs the FULL static pass — graph invariants
+    # (dangling reads, undeclared writes, torn sub-blocks), the
+    # shape/dtype inference walk over the op descs, donation-hazard
+    # analysis of the built plan, and fingerprint-stability lint —
+    # BEFORE anything traces; error-class findings raise
+    # ProgramVerifyError naming the op, the class and the fix.  Off
+    # (the default) costs one flag read per plan BUILD (zero per
+    # step: plan-cache hits never reach the gate); invariant+donation
+    # verification still runs FORCED (level='fast') in
+    # Executor.warmup and on every transpiler/planner output.
+    'FLAGS_program_verify': False,
     # fault-injection harness (fluid/faultinject.py): semicolon-
     # separated '<site>:<action>[:<arg>][@n[+]]' clauses armed at
     # import — e.g. 'elastic.shard_write:die@2' kills the process on
@@ -245,6 +257,25 @@ _DEFAULTS = {
     # round-4 and tools/repro_conv_wedge.py.
     'FLAGS_conv_precision': 'highest',
 }
+
+# v1.6 scripts set these; the TPU runtime ACCEPTS them for script
+# compatibility but nothing reads them — XLA subsumes the behavior
+# (buffer liveness, stream sync, allocator fractions, host threading).
+# tools/staticcheck.py exempts exactly this tuple from its
+# dead-flag lint; adding a flag here is a statement that it is
+# compat-only surface.
+V16_COMPAT_ONLY = (
+    'FLAGS_benchmark',
+    'FLAGS_communicator_fake_rpc',
+    'FLAGS_cpu_deterministic',
+    'FLAGS_cudnn_deterministic',
+    'FLAGS_eager_delete_tensor_gb',
+    'FLAGS_fraction_of_gpu_memory_to_use',
+    'FLAGS_paddle_num_threads',
+    'FLAGS_print_op_timing',
+    'FLAGS_sync_nccl_allreduce',
+    'FLAGS_use_pinned_memory',
+)
 
 _flags = {}
 

@@ -15,6 +15,7 @@ the IR never drifts from the kernels.
 import contextlib
 import os
 import sys
+import weakref
 
 import numpy as np
 
@@ -388,6 +389,17 @@ def _normalize_io(io):
     return out
 
 
+# every live Program, weakly held — fluid.progcheck's CLI
+# (tools/progcheck.py) execs a model file and verifies whatever
+# Programs it built, without the file having to hand them over
+_all_programs = weakref.WeakSet()
+
+
+def all_live_programs():
+    """Snapshot of every Program still alive in this process."""
+    return list(_all_programs)
+
+
 class Program(object):
     """Reference: python/paddle/fluid/framework.py:3579."""
 
@@ -400,6 +412,7 @@ class Program(object):
         self._seed_base = np.random.randint(0, 2 ** 31 - 1)
         self._exec_cache = _new_exec_cache()
         self._current_role = 'forward'
+        _all_programs.add(self)
 
     @contextlib.contextmanager
     def _role_guard(self, role):
@@ -464,6 +477,7 @@ class Program(object):
         program never mutates parameters or optimizer state."""
         import copy
         p = Program.__new__(Program)
+        _all_programs.add(p)
         p.random_seed = self.random_seed
         p._version = 0
         p._op_seed_counter = list(self._op_seed_counter)

@@ -263,6 +263,15 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     if plan is None:
         plan = executor._build_plan(program, tuple(sorted(feed.keys())),
                                     tuple(fetch_names))
+        # plan-BUILD verification hook (same discipline as the
+        # single-device executor): cache misses only, one flag read
+        from .flags import get_flag as _gf
+        if _gf('FLAGS_program_verify'):
+            from . import progcheck
+            progcheck.verify_program(
+                program, feed_names=tuple(sorted(feed.keys())),
+                fetch_names=tuple(fetch_names), plan=plan,
+                origin='parallel')
         compiled._exec_cache[key] = plan
 
     executor._step += 1
@@ -363,6 +372,23 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
                     shape[0] > 1:
                 return P(zero_axis)
             return None
+    from .flags import get_flag as _gf2
+    if _gf2('FLAGS_program_verify') and param_rule is not None and \
+            not getattr(compiled, '_progcheck_shard_ok', False):
+        # static sharding legality of the RESOLVED rule (user
+        # with_param_shardings specs are otherwise unvalidated until
+        # NamedSharding throws mid-trace): unknown axes, indivisible
+        # dims, axis reuse — checked once per CompiledProgram, before
+        # the first segment traces
+        from . import progcheck
+        shapes = {p.name: tuple(p.shape)
+                  for p in program.all_parameters()}
+        progcheck.check_sharding(
+            shapes, {n: param_rule(n, s) for n, s in shapes.items()},
+            {a: int(mesh.shape[a]) for a in mesh.axis_names},
+            label=_memviz.program_label(program),
+            origin='with_param_shardings')
+        compiled._progcheck_shard_ok = True
     batch_feeds = _batch_feed_names(program, feed)
     # ambient program label: per-(program, segment) memory attribution
     # and the collective planner's per-program HBM headroom resolve
@@ -595,6 +621,13 @@ def run_collective(executor, program, feed, fetch_list, scope,
     if plan is None:
         plan = executor._build_plan(program, tuple(sorted(feed.keys())),
                                     tuple(fetch_names))
+        from .flags import get_flag as _gf
+        if _gf('FLAGS_program_verify'):
+            from . import progcheck
+            progcheck.verify_program(
+                program, feed_names=tuple(sorted(feed.keys())),
+                fetch_names=tuple(fetch_names), plan=plan,
+                origin='collective')
         program._exec_cache[key] = plan
 
     executor._step += 1

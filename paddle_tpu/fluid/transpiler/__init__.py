@@ -94,6 +94,14 @@ class DistributeTranspiler(object):
         if not sync_mode:
             self._strip_dense_optimizer(program, block)
         self.trainer_program = program
+        # FORCED static verification of the PS rewrite (flag or not):
+        # the sparse-table / dense-strip surgery above mutates op
+        # descs in place — a dangling Ids/Grad name or an orphaned
+        # optimizer state read must fail at transpile time by name
+        from .. import progcheck
+        progcheck.verify_program(
+            program, origin='transpile:DistributeTranspiler',
+            level='full' if progcheck.enabled() else 'fast')
 
     def _rewrite_sparse_tables(self, program, block):
         ops = list(block.ops)

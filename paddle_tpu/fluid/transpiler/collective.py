@@ -61,6 +61,14 @@ class Collective(object):
         monitor.add('collective/transpile_calls')
         self._transpile_main_program()
         main_program._collective_dp = True
+        # FORCED static verification of the rewrite output (flag or
+        # not): a collective insertion that dangles a grad name or
+        # tears a block must fail HERE with a named diagnostic, not
+        # as a tracer error at the first parallel step
+        from .. import progcheck
+        progcheck.verify_program(
+            main_program, origin='transpile:%s' % type(self).__name__,
+            level='full' if progcheck.enabled() else 'fast')
 
     def _transpile_main_program(self):
         raise NotImplementedError
@@ -128,7 +136,8 @@ class GradAllReduce(Collective):
         with memviz.program_scope(memviz.program_label(
                 self.main_program)):
             grads = [(g,) + _var_nbytes(block, g) for g in uniq]
-            buckets = comms_plan.bucket_grads(grads)
+            buckets = comms_plan.verify_buckets(
+                block, comms_plan.bucket_grads(grads))
             summary = {'nranks': self.nranks, 'grads': len(uniq),
                        'buckets': []}
             for b in buckets:

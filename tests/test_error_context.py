@@ -58,7 +58,12 @@ def test_undefined_var_error_names_op():
     with fluid.scope_guard(scope):
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
+        # lazily at bind time, or — with FLAGS_program_verify on (the
+        # PADDLE_TPU_VERIFY sweep) — statically at plan build, where
+        # fluid.progcheck names the op and the dangling input
         with pytest.raises(RuntimeError,
-                           match='undefined var|not initialized'):
+                           match='undefined var|not initialized'
+                                 '|undefined_read') as ei:
             exe.run(main, feed={'x': np.zeros((4, 8), np.float32)},
                     fetch_list=[out])
+        assert 'nonexistent_var' in str(ei.value)

@@ -1,0 +1,534 @@
+"""fluid.progcheck — static Program verifier.
+
+One seeded-defect test per diagnostic class, a clean bill on the
+model-program corpus (LeNet/BERT/GPT), the executor/warmup/transpiler
+wiring, the disabled-path cost contract, and the /statusz section
+schema.  The regression pins at the bottom cover the real-program
+idioms the tier-1 verify sweep surfaced (AMP master-f32 declarations,
+loop-carry dtype pinning, LoD-representation sequence ops)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, monitor, progcheck
+from paddle_tpu.fluid.flags import _DEFAULTS, set_flags
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    set_flags({'FLAGS_program_verify':
+               _DEFAULTS['FLAGS_program_verify']})
+    from paddle_tpu.fluid import faultinject
+    faultinject.reset()
+
+
+def _mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        h = layers.fc(x, 16, act='relu')
+        pred = layers.fc(h, 4)
+        loss = layers.reduce_mean(pred)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _verify(main, loss=None, **kw):
+    kw.setdefault('feed_names', ('x',))
+    if loss is not None:
+        kw.setdefault('fetch_names', (loss.name,))
+    kw.setdefault('level', 'full')
+    kw.setdefault('raise_on_error', False)
+    return progcheck.verify_program(main, **kw)
+
+
+# ------------------------------------------------ one test per class
+
+def test_clean_program_verifies_clean():
+    main, startup, loss = _mlp()
+    rep = _verify(main, loss, startup_program=startup)
+    assert rep.ok(), rep.format()
+    assert rep.ops_checked > 0 and rep.shape_checked > 0
+    assert rep.counts() == {}
+
+
+def test_undefined_read():
+    main, _, loss = _mlp()
+    main.global_block().ops[0].inputs['X'][0] = '__nope__'
+    rep = _verify(main, loss)
+    assert [d.cls for d in rep.errors] and \
+        rep.errors[0].cls == 'undefined_read'
+    assert rep.errors[0].var == '__nope__'
+    assert rep.errors[0].hint
+
+
+def test_undeclared_write():
+    main, _, loss = _mlp()
+    main.global_block().ops[0].outputs['Out'][0] = '__orphan__'
+    rep = _verify(main, loss)
+    assert any(d.cls == 'undeclared_write' for d in rep.errors)
+
+
+def test_read_before_init_warns():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        b = main.global_block()
+        b.create_var(name='ghost', shape=[4], dtype='float32')
+        layers.reduce_mean(b.vars['ghost'])
+    rep = progcheck.verify_program(main, level='fast',
+                                   raise_on_error=False)
+    assert rep.ok()   # warning, not error: the scope may hold it
+    assert any(d.cls == 'read_before_init' for d in rep.warnings)
+
+
+def test_persistable_uninit_needs_startup_view():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name='stat', shape=[4], dtype='float32',
+                     persistable=True)
+        layers.reduce_mean(b.vars['stat'])
+    # without a startup program the check stays silent (unknowable)
+    rep = progcheck.verify_program(main, level='fast',
+                                   raise_on_error=False)
+    assert not any(d.cls == 'persistable_uninit'
+                   for d in rep.diagnostics)
+    rep = progcheck.verify_program(main, level='fast',
+                                   startup_program=startup,
+                                   raise_on_error=False)
+    assert any(d.cls == 'persistable_uninit' for d in rep.warnings)
+
+
+def test_dead_op_and_dead_var_warn():
+    main, _, loss = _mlp()
+    b = main.global_block()
+    b.create_var(name='unused', shape=[2], dtype='float32')
+    assert progcheck.mutate(main, 7) is not None   # appends dead op
+    rep = _verify(main, loss)
+    assert rep.ok()
+    assert any(d.cls == 'dead_op' for d in rep.warnings)
+    assert any(d.cls == 'dead_var' and d.var == 'unused'
+               for d in rep.warnings)
+
+
+def test_torn_subblock():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        i = layers.fill_constant([1], 'int64', 0)
+        n = layers.fill_constant([1], 'int64', 2)
+        cond = layers.less_than(i, n)
+        wl = layers.While(cond, max_trip_count=4)
+        with wl.block():
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+        loss = layers.reduce_mean(layers.fc(x, 4))
+    assert progcheck.mutate(main, 3) == ('torn_subblock',
+                                         'torn_subblock')
+    rep = _verify(main, loss)
+    assert any(d.cls == 'torn_subblock' for d in rep.errors)
+
+
+def test_shape_mismatch_names_op_and_callstack():
+    main, _, loss = _mlp()
+    assert progcheck.mutate(main, 5) is not None
+    rep = _verify(main, loss)
+    errs = [d for d in rep.errors if d.cls == 'shape_mismatch']
+    assert errs, rep.format()
+    # the static NaN-provenance analog: op desc + creation callstack
+    assert errs[0].op_type and errs[0].callstack
+    assert 'test_progcheck.py' in errs[0].callstack[0]
+
+
+def test_dtype_mismatch():
+    main, _, loss = _mlp()
+    assert progcheck.mutate(main, 2) is not None
+    rep = _verify(main, loss)
+    assert any(d.cls == 'dtype_mismatch' for d in rep.errors)
+
+
+def test_infer_fail_on_untraceable_op():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data('x', shape=[4, 8], dtype='float32',
+                        append_batch_size=False)
+        b = main.global_block()
+        b.create_var(name='bad_w', shape=[7, 5], dtype='float32',
+                     persistable=True)
+        b.create_var(name='bad_out', shape=[4, 5], dtype='float32')
+        b.append_op('mul', inputs={'X': 'x', 'Y': 'bad_w'},
+                    outputs={'Out': 'bad_out'}, infer_shape=False)
+    rep = progcheck.verify_program(main, feed_names=('x',),
+                                   fetch_names=('bad_out',),
+                                   level='full', raise_on_error=False)
+    assert any(d.cls == 'infer_fail' and d.op_type == 'mul'
+               for d in rep.errors), rep.format()
+
+
+def test_dynamic_batch_factoring_op_skips_inference():
+    """An op appended with infer_shape=False because the sentinel
+    batch cannot divide (temporal_shift's N -> N/seg) must SKIP, not
+    infer_fail (tier-1 sweep: test_api_surface)."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data('x', shape=[4, 8, 8], dtype='float32')
+        t = layers.temporal_shift(x, seg_num=2)
+        loss = layers.reduce_mean(t)
+    rep = progcheck.verify_program(
+        main, feed_names=('x',), fetch_names=(loss.name,),
+        level='full', raise_on_error=False)
+    assert rep.ok(), rep.format()
+
+
+def test_host_op_scope_resolution_exempt():
+    """Host ops (save/load/print) resolve names through the SCOPE at
+    runtime; a save program naming undeclared scope vars is the v1.6
+    idiom, not a dangling read (tier-1 sweep: test_fastpath
+    save/load roundtrip)."""
+    prog = fluid.Program()
+    prog.global_block().append_op(
+        'save', inputs={'X': ['some_scope_var']},
+        attrs={'file_path': '/tmp/x'}, infer_shape=False)
+    rep = progcheck.verify_program(prog, level='fast',
+                                   raise_on_error=False)
+    assert rep.ok(), rep.format()
+
+
+def test_first_inconsistent_op_only():
+    """Downstream cascades of the first break stay unreported."""
+    main, _, loss = _mlp()
+    assert progcheck.mutate(main, 2) is not None
+    rep = _verify(main, loss)
+    assert len([d for d in rep.errors
+                if d.cls in ('dtype_mismatch', 'shape_mismatch',
+                             'infer_fail')]) == 1
+
+
+def test_unstable_attr_warns():
+    main, _, loss = _mlp()
+    main.global_block().ops[0].attrs['bad'] = object()
+    main.global_block().ops[1].attrs['worse'] = lambda: None
+    rep = _verify(main, loss)
+    assert rep.ok()
+    hits = [d for d in rep.warnings if d.cls == 'unstable_attr']
+    assert len(hits) == 2
+    # the volatile attrs the fingerprint skips stay exempt
+    assert all('__op_callstack__' not in d.message for d in hits)
+
+
+def test_sharding_classes():
+    from jax.sharding import PartitionSpec as P
+    sizes = {'dp': 4, 'mp': 2}
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        progcheck.check_sharding({'w': (8, 8)}, {'w': P('ep')}, sizes)
+    assert 'shard_unknown_axis' in str(ei.value)
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        progcheck.check_sharding({'w': (6, 8)}, {'w': P('dp')}, sizes)
+    assert 'shard_indivisible' in str(ei.value)
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        progcheck.check_sharding({'w': (8, 8)},
+                                 {'w': P('dp', 'dp')}, sizes)
+    assert 'shard_conflict' in str(ei.value)
+    # aliased vars carrying different specs conflict
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        progcheck.check_sharding(
+            {'w': (8, 8), 'w@ZERO': (8, 8)},
+            {'w': P('dp'), 'w@ZERO': P('mp')}, sizes,
+            aliases={'w@ZERO': 'w'})
+    assert 'shard_conflict' in str(ei.value)
+    # and a legal layout sails through
+    rep = progcheck.check_sharding({'w': (8, 8)},
+                                   {'w': P('dp', 'mp')}, sizes)
+    assert rep.ok()
+
+
+def test_use_after_donate_via_plan():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        loss = layers.reduce_mean(layers.fc(x, 4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        w = main.global_block().all_parameters()[0]
+        probe = main.current_block().create_var(
+            name='probe', shape=list(w.shape), dtype='float32')
+        layers.py_func(lambda a: a, w, probe)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    plan = exe._get_plan(main, ('x',), (loss.name,))
+    assert progcheck.verify_plan(plan).ok()
+    assert progcheck.mutate(main, 8, plan=plan) is not None
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        progcheck.verify_plan(plan)
+    assert 'use_after_donate' in str(ei.value)
+
+
+# ------------------------------------------------------ corpus + wiring
+
+def test_model_corpus_clean():
+    from paddle_tpu.models import bert, gpt, lenet
+    progs = []
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        feeds, _p, loss, _a = lenet.build()
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    progs.append((m, s, tuple(feeds), loss))
+    cfg = bert.BertConfig(vocab_size=128, hidden=32, layers=1, heads=2)
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        feeds, _e, loss = bert.build_pretrain(cfg, seq_len=8)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    progs.append((m, s, tuple(feeds), loss))
+    gcfg = gpt.GptConfig(vocab_size=128, hidden=32, layers=1, heads=2)
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        feeds, _l, loss = gpt.build_lm(gcfg, seq_len=8)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    progs.append((m, s, tuple(feeds), loss))
+    for m, s, feeds, loss in progs:
+        rep = progcheck.verify_program(
+            m, feed_names=feeds, fetch_names=(loss.name,),
+            level='full', startup_program=s, raise_on_error=False)
+        assert rep.ok(), rep.format()
+        assert progcheck.verify_program(
+            s, level='full', raise_on_error=False).ok()
+
+
+def test_executor_flag_gates_and_raises():
+    set_flags({'FLAGS_program_verify': True})
+    main, startup, loss = _mlp()
+    main.global_block().ops[0].inputs['X'][0] = '__nope__'
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(progcheck.ProgramVerifyError) as ei:
+            exe.run(main, feed={'x': np.zeros((2, 8), 'float32')},
+                    fetch_list=[loss])
+    assert 'undefined_read' in str(ei.value)
+    assert '__nope__' in str(ei.value)
+
+
+def test_executor_flag_off_no_verify_and_no_step_cost():
+    set_flags({'FLAGS_program_verify': False})
+    main, startup, loss = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={'x': np.zeros((2, 8), 'float32')},
+                fetch_list=[loss])
+        before = monitor.counter_value('verify/programs')
+        for _ in range(4):   # steady state: plan cache hits
+            exe.run(main, feed={'x': np.zeros((2, 8), 'float32')},
+                    fetch_list=[loss])
+        assert monitor.counter_value('verify/programs') == before
+
+
+def test_warmup_forces_fast_verification():
+    from paddle_tpu.fluid import compile_cache
+    set_flags({'FLAGS_program_verify': False})
+    main, startup, loss = _mlp()
+    main.global_block().ops[0].inputs['X'][0] = '__nope__'
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with pytest.raises(progcheck.ProgramVerifyError):
+                exe.warmup(main,
+                           feed_shapes={'x': ((2, 8), 'float32')},
+                           fetch_list=[loss], wait=True)
+    finally:
+        # warmup marks the process-wide plane warmed before planning;
+        # drop that so later tests keep the lazy-jit run path (the
+        # test_compile_cache convention)
+        compile_cache.reset_plane()
+
+
+def test_transpiler_output_verified():
+    from paddle_tpu.fluid.transpiler import GradAllReduce
+    main, startup, loss = _mlp()
+    before = monitor.counter_value('verify/programs')
+    GradAllReduce().transpile(startup, main, 0,
+                              ['127.0.0.1:0'], '127.0.0.1:0')
+    assert monitor.counter_value('verify/programs') > before
+
+
+def test_transpiler_catches_torn_rewrite():
+    from paddle_tpu.fluid.transpiler import GradAllReduce
+
+    class Torn(GradAllReduce):
+        def _transpile_main_program(self):
+            super(Torn, self)._transpile_main_program()
+            block = self.main_program.global_block()
+            for op in block.ops:
+                if op.type.startswith('c_allreduce'):
+                    op.inputs['X'][0] = '__torn_grad__'
+                    break
+
+    main, startup, loss = _mlp()
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        Torn().transpile(startup, main, 0,
+                         ['127.0.0.1:0'], '127.0.0.1:0')
+    assert 'undefined_read' in str(ei.value)
+
+
+def test_comms_plan_bucket_legality():
+    from paddle_tpu.fluid import comms_plan
+    main, _, _ = _mlp()
+    block = main.global_block()
+    w = block.all_parameters()[0].name
+    good = [{'names': [w], 'bytes': 512.0, 'dtype': 'float32'}]
+    assert comms_plan.verify_buckets(block, good) is good
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        comms_plan.verify_buckets(block, [
+            {'names': ['__no_such_grad__'], 'bytes': 4.0,
+             'dtype': 'float32'}])
+    assert 'undefined_read' in str(ei.value)
+    with pytest.raises(progcheck.ProgramVerifyError) as ei:
+        comms_plan.verify_buckets(block, [
+            {'names': [w], 'bytes': 4.0, 'dtype': 'float32'},
+            {'names': [w], 'bytes': 4.0, 'dtype': 'float32'}])
+    assert 'shard_conflict' in str(ei.value)
+
+
+def test_faultinject_mutate_clause_parses():
+    from paddle_tpu.fluid import faultinject
+    assert faultinject.configure('progcheck.mutate:mutate:3@1')
+    assert 'progcheck.mutate' in faultinject.SITES
+    c = faultinject.check('progcheck.mutate')
+    assert c is not None and c['action'] == 'mutate' \
+        and c['arg'] == 3.0
+    assert faultinject.check('progcheck.mutate') is None  # @1 one-shot
+    # kinds spell as names too, end to end through the executor hook
+    assert faultinject.configure('progcheck.mutate:mutate:dtype_flip')
+    c = faultinject.check('progcheck.mutate')
+    assert c is not None and c['arg'] == 'dtype_flip'
+    faultinject.reset()
+    main, _, loss = _mlp()
+    assert progcheck.mutate(main, 'dtype_flip') == (
+        'dtype_flip', 'dtype_mismatch')
+    rep = _verify(main, loss)
+    assert any(d.cls == 'dtype_mismatch' for d in rep.errors)
+
+
+def test_warmup_verifies_once_with_flag_on():
+    from paddle_tpu.fluid import compile_cache
+    set_flags({'FLAGS_program_verify': True})
+    main, startup, loss = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            before = monitor.counter_value('verify/programs')
+            exe.warmup(main, feed_shapes={'x': ((2, 8), 'float32')},
+                       fetch_list=[loss], wait=True)
+            # the plan-build hook defers to warmup's forced pass —
+            # exactly ONE verification (no double stats, one trail
+            # entry), and it carries the warmup origin
+            assert monitor.counter_value('verify/programs') \
+                == before + 1
+            assert progcheck.report()['reports'][-1]['origin'] \
+                == 'warmup'
+    finally:
+        compile_cache.reset_plane()
+
+
+def test_bucket_verification_reaches_statusz():
+    from paddle_tpu.fluid import comms_plan
+    main, _, _ = _mlp()
+    block = main.global_block()
+    w = block.all_parameters()[0].name
+    before = monitor.counter_value('verify/programs')
+    comms_plan.verify_buckets(
+        block, [{'names': [w], 'bytes': 4.0, 'dtype': 'float32'}])
+    assert monitor.counter_value('verify/programs') == before + 1
+    assert progcheck.report()['reports'][-1]['origin'] \
+        == 'transpile:bucket'
+
+
+def test_statusz_verify_section_schema():
+    main, startup, loss = _mlp()
+    _verify(main, loss)
+    from paddle_tpu.fluid import health
+    sz = health.statusz()
+    v = sz['verify']
+    assert v is not None
+    assert set(v) == {'enabled', 'counters', 'by_class', 'reports'}
+    assert v['counters']['programs'] >= 1
+    rep = v['reports'][-1]
+    assert {'label', 'origin', 'ok', 'counts',
+            'diagnostics'} <= set(rep)
+    json.dumps(sz['verify'])   # JSON-able end to end
+
+
+def test_report_trail_bounded():
+    progcheck.reset()
+    main, startup, loss = _mlp()
+    for _ in range(40):
+        _verify(main, loss, level='fast')
+    assert len(progcheck.report()['reports']) <= 32
+
+
+# ------------------------- regression pins from the tier-1 verify sweep
+
+def test_amp_master_f32_declarations_verify_clean():
+    """AMP programs declare f32 master params/activations while the
+    lowering runs bf16 — a float-WIDTH change is the design, not a
+    dtype_mismatch (tier-1 sweep: test_amp_semantics)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(layers.fc(x, 16, act='relu'), 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(0.01), use_dynamic_loss_scaling=True)
+        opt.minimize(loss)
+    rep = progcheck.verify_program(
+        main, feed_names=('x', 'y'), fetch_names=(loss.name,),
+        level='full', raise_on_error=False)
+    assert rep.ok(), rep.format()
+    # a float->int flip still reports even under AMP
+    assert progcheck._dtype_conflict('float32', 'int32', amp=True)
+    assert not progcheck._dtype_conflict('float32', 'bfloat16',
+                                         amp=True)
+    assert progcheck._dtype_conflict('float32', 'bfloat16', amp=False)
+
+
+def test_loop_carry_dtype_pinning_exempt():
+    """The executor pins while-carry dtypes to the loop-entry dtype;
+    build-time inference may stamp the body's promoted dtype on the
+    declaration (int carry + float step) — not a defect (tier-1
+    sweep: test_amp_semantics while-loop case)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[4], dtype='float32')
+        i = layers.fill_constant([1], 'int64', 0)
+        n = layers.fill_constant([1], 'int64', 3)
+        cond = layers.less_than(i, n)
+        wl = layers.While(cond, max_trip_count=4)
+        with wl.block():
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+        loss = layers.reduce_mean(layers.fc(x, 4))
+    rep = progcheck.verify_program(
+        main, feed_names=('x',), fetch_names=(loss.name,),
+        level='full', raise_on_error=False)
+    assert rep.ok(), rep.format()
+
+
+def test_sequence_ops_skip_static_inference():
+    """Sequence lowerings consume the padded(+mask) representation,
+    not the declared LoD shape — the walk must skip them rather than
+    guess (tier-1 sweep: test_bucketing)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32', lod_level=1)
+        h = layers.sequence_pool(x, 'sum')
+        loss = layers.reduce_mean(layers.fc(h, 4))
+    rep = progcheck.verify_program(
+        main, feed_names=('x',), fetch_names=(loss.name,),
+        level='full', raise_on_error=False)
+    assert rep.ok(), rep.format()

@@ -1,0 +1,222 @@
+"""Program-verifier gate: every diagnostic class must FIRE on a
+fault-injected corrupt program — by name, in a real executor run — and
+the repo's model-program corpus must verify CLEAN.
+
+Three legs (run from `make check`, CPU):
+
+1. **Seeded defects.**  For each ``progcheck.MUTATIONS`` kind, arm the
+   ``progcheck.mutate`` faultinject site, run a REAL Executor.run over
+   a fresh program, and require the named diagnostic class in the
+   raised ProgramVerifyError (error classes) or the verify counters
+   (warning classes).  Sharding classes fire through the auto-shard
+   planner path (progcheck.check_sharding on corrupt specs against a
+   real mesh) since op-desc mutation cannot express them.
+
+2. **Clean corpus.**  LeNet, BERT and GPT training programs (the
+   tier-1 model set) verify with zero error-class diagnostics at
+   level='full'.
+
+3. **Disabled-path budget.**  With FLAGS_program_verify=0 the hot
+   path pays nothing: tools/check_hot_path.py runs as a subprocess
+   with the flag pinned off and must hold its existing budgets.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_mutation_program(fluid, layers, kind):
+    """A program every mutation kind has an eligible site in: two
+    device segments around a host op (donation hazards need a later
+    consumer), a while loop (torn sub-blocks need a sub_block attr),
+    and a param-reading host probe (use-after-donate needs donated
+    state read downstream)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        i = layers.fill_constant([1], 'int64', 0)
+        n = layers.fill_constant([1], 'int64', 2)
+        cond = layers.less_than(i, n)
+        wl = layers.While(cond, max_trip_count=4)
+        with wl.block():
+            layers.increment(i)
+            layers.less_than(i, n, cond=cond)
+        h = layers.fc(x, 8, act='relu')
+        loss = layers.reduce_mean(h)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+        w = main.global_block().all_parameters()[0]
+        probe = main.current_block().create_var(
+            name='w_probe', shape=list(w.shape), dtype='float32')
+        layers.py_func(lambda a: a, w, probe)
+    return main, startup, loss
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    sys.path.insert(0, ROOT)
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import (faultinject, layers, monitor,
+                                  progcheck)
+    from paddle_tpu.fluid.flags import set_flags
+
+    failures = []
+    set_flags({'FLAGS_program_verify': True})
+
+    # ---- leg 1: every mutation kind fires its class BY NAME --------
+    for kind in sorted(progcheck.MUTATIONS):
+        mname, cls = progcheck.MUTATIONS[kind]
+        main_p, startup, loss = build_mutation_program(fluid, layers,
+                                                       kind)
+        c0 = monitor.counter_value('verify/diagnostics/%s' % cls)
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            faultinject.configure('progcheck.mutate:mutate:%d@1'
+                                  % kind)
+            raised = None
+            try:
+                exe.run(main_p,
+                        feed={'x': np.zeros((4, 8), 'float32')},
+                        fetch_list=[loss])
+            except progcheck.ProgramVerifyError as e:
+                raised = e
+            except Exception as e:   # pragma: no cover - diagnosis aid
+                failures.append(
+                    'kind %d (%s): wrong exception %s: %s'
+                    % (kind, mname, type(e).__name__, e))
+                faultinject.reset()
+                continue
+            finally:
+                faultinject.reset()
+        c1 = monitor.counter_value('verify/diagnostics/%s' % cls)
+        if cls in progcheck.ERROR_CLASSES:
+            if raised is None:
+                failures.append(
+                    'kind %d (%s): executor run did NOT raise '
+                    'ProgramVerifyError' % (kind, mname))
+            elif cls not in str(raised):
+                failures.append(
+                    'kind %d (%s): error does not name class %s: %s'
+                    % (kind, mname, cls, str(raised)[:200]))
+        else:
+            if raised is not None:
+                failures.append(
+                    'kind %d (%s): warning class %s raised: %s'
+                    % (kind, mname, cls, str(raised)[:200]))
+            if c1 <= c0:
+                failures.append(
+                    'kind %d (%s): verify/diagnostics/%s did not '
+                    'count (%g -> %g)' % (kind, mname, cls, c0, c1))
+        if faultinject.fired('progcheck.mutate') != 0:
+            failures.append('kind %d: faultinject.reset left state'
+                            % kind)
+        print('defect kind %d %-15s -> %-18s %s'
+              % (kind, mname, cls,
+                 'RAISED' if raised is not None else 'counted'))
+
+    # ---- leg 1b: sharding classes through the planner path ---------
+    from jax.sharding import PartitionSpec as P
+    shard_cases = [
+        ('shard_unknown_axis', {'w': P('bogus_axis')}),
+        ('shard_indivisible', {'w': P('dp')}),
+        ('shard_conflict', {'w': P('dp', 'dp')}),
+    ]
+    for cls, specs in shard_cases:
+        try:
+            progcheck.check_sharding({'w': (6, 6)}, specs,
+                                     {'dp': 4, 'mp': 2},
+                                     origin='check_progcheck')
+            failures.append('%s: check_sharding did not raise' % cls)
+        except progcheck.ProgramVerifyError as e:
+            if cls not in str(e):
+                failures.append('%s: error does not name the class: %s'
+                                % (cls, str(e)[:200]))
+            print('defect shard %-22s -> RAISED' % cls)
+
+    # ---- leg 2: the model corpus verifies clean --------------------
+    from paddle_tpu.models import bert, gpt, lenet
+    corpus = []
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        feeds, _pred, loss, _acc = lenet.build()
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    corpus.append(('lenet', m, s, tuple(feeds), loss))
+    cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=1, heads=2)
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        feeds, _enc, loss = bert.build_pretrain(cfg, seq_len=8)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    corpus.append(('bert', m, s, tuple(feeds), loss))
+    gcfg = gpt.GptConfig(vocab_size=256, hidden=32, layers=1, heads=2)
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        feeds, _logits, loss = gpt.build_lm(gcfg, seq_len=8)
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+    corpus.append(('gpt', m, s, tuple(feeds), loss))
+    for name, main_p, startup, feed_names, loss in corpus:
+        rep = progcheck.verify_program(
+            main_p, feed_names=feed_names, fetch_names=(loss.name,),
+            level='full', startup_program=startup,
+            raise_on_error=False)
+        if not rep.ok():
+            failures.append('%s program has verifier errors: %s'
+                            % (name, [d.format().splitlines()[0]
+                                      for d in rep.errors[:4]]))
+        srep = progcheck.verify_program(startup, level='full',
+                                        raise_on_error=False)
+        if not srep.ok():
+            failures.append('%s STARTUP program has errors: %s'
+                            % (name, [d.format().splitlines()[0]
+                                      for d in srep.errors[:4]]))
+        print('corpus %-6s ok=%s ops=%d shape-checked=%d (%s)'
+              % (name, rep.ok(), rep.ops_checked, rep.shape_checked,
+                 ', '.join('%s=%d' % kv
+                           for kv in sorted(rep.counts().items()))
+                 or 'clean'))
+
+    # ---- leg 2b: /statusz verify section is populated --------------
+    from paddle_tpu.fluid import health
+    sz = health.statusz()
+    v = sz.get('verify')
+    if not v or not v.get('counters', {}).get('programs'):
+        failures.append('/statusz verify section missing or empty: %r'
+                        % (v,))
+    elif not v.get('reports'):
+        failures.append('/statusz verify report trail is empty')
+    else:
+        print('/statusz verify: %d programs, %d report(s) on the '
+              'trail' % (v['counters']['programs'],
+                         len(v['reports'])))
+
+    # ---- leg 3: disabled path holds the hot-path budgets -----------
+    set_flags({'FLAGS_program_verify': False})
+    env = dict(os.environ, FLAGS_program_verify='0',
+               JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, 'tools',
+                                      'check_hot_path.py')],
+        env=env, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        failures.append('check_hot_path with FLAGS_program_verify=0 '
+                        'failed:\n%s%s' % (r.stdout[-1500:],
+                                           r.stderr[-1500:]))
+    else:
+        print('disabled path: ' + r.stdout.strip().splitlines()[-1])
+
+    if failures:
+        for f in failures:
+            print('PROGCHECK GATE FAILURE  ' + f)
+        return 1
+    print('progcheck gate: %d defect classes fire by name, corpus '
+          'clean, disabled path within budgets'
+          % (len(progcheck.MUTATIONS) + len(shard_cases)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -276,6 +276,27 @@ REQUIRED = [
     ('paddle_tpu/fluid/parallel_executor.py', '_finject.check'),
     ('paddle_tpu/fluid/health.py', 'elastic.report'),
     ('bench.py', '_elastic_fields'),
+    # static Program verifier (fluid/progcheck.py): programs checked,
+    # per-class diagnostic counters, seeded mutations, wall time —
+    # tools/check_progcheck.py proves every class fires by name and
+    # the /statusz verify section renders the report trail
+    ('paddle_tpu/fluid/progcheck.py', 'verify/programs'),
+    ('paddle_tpu/fluid/progcheck.py', 'verify/clean'),
+    ('paddle_tpu/fluid/progcheck.py', 'verify/errors'),
+    ('paddle_tpu/fluid/progcheck.py', 'verify/warnings'),
+    ('paddle_tpu/fluid/progcheck.py', 'verify/diagnostics/'),
+    ('paddle_tpu/fluid/progcheck.py', 'verify/seconds'),
+    ('paddle_tpu/fluid/progcheck.py', 'verify/mutations'),
+    ('paddle_tpu/fluid/executor.py', '_verify_plan_build'),
+    ('paddle_tpu/fluid/executor.py', 'progcheck.mutate'),
+    ('paddle_tpu/fluid/parallel_executor.py', 'FLAGS_program_verify'),
+    ('paddle_tpu/fluid/transpiler/collective.py',
+     'progcheck.verify_program'),
+    ('paddle_tpu/fluid/transpiler/__init__.py',
+     'progcheck.verify_program'),
+    ('paddle_tpu/fluid/comms_plan.py', 'verify_buckets'),
+    ('paddle_tpu/parallel/plan.py', 'progcheck.check_sharding'),
+    ('paddle_tpu/fluid/health.py', 'progcheck.report'),
 ]
 
 

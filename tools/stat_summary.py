@@ -28,6 +28,12 @@ Usage:
                                   # builds/reuse, candidates priced,
                                   # HBM-gate rejections, unpriced
                                   # terms (parallel/plan.py)
+  python tools/stat_summary.py --verify run.jsonl
+                                  # static-verifier rollup: programs
+                                  # checked/clean, diagnostics by
+                                  # class, seeded chaos mutations,
+                                  # verify wall time
+                                  # (fluid.progcheck)
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
@@ -269,8 +275,51 @@ def memory_report(rec, out=None):
     return 0
 
 
+def verify_report(rec, out=None):
+    """Static-verifier rollup from one monitor record: programs
+    checked vs clean, error/warning volume, the per-diagnostic-class
+    breakdown (sorted loudest first), seeded chaos mutations, and the
+    verification wall-time histogram — the offline form of /statusz's
+    verify section (fluid.progcheck)."""
+    out = out if out is not None else sys.stdout
+    c = rec.get('counters', {})
+    h = rec.get('histograms', {})
+    programs = c.get('verify/programs', 0.0)
+    if not programs:
+        out.write('no verify/* counters: the static verifier never '
+                  'ran in this record (FLAGS_program_verify, '
+                  'Executor.warmup, or a transpiler output)\n')
+        return 1
+    out.write('program-verifier rollup\n')
+    out.write('  programs checked %9d (%d fully clean)\n'
+              % (programs, c.get('verify/clean', 0.0)))
+    out.write('  errors           %9d\n' % c.get('verify/errors', 0.0))
+    out.write('  warnings         %9d\n'
+              % c.get('verify/warnings', 0.0))
+    prefix = 'verify/diagnostics/'
+    by_class = sorted(((k[len(prefix):], v) for k, v in c.items()
+                       if k.startswith(prefix)),
+                      key=lambda kv: -kv[1])
+    for cls, n in by_class:
+        out.write('    %-22s %8d\n' % (cls, n))
+    mut = c.get('verify/mutations', 0.0)
+    if mut:
+        out.write('  seeded mutations %9d (faultinject '
+                  'progcheck.mutate)\n' % mut)
+    vs = h.get('verify/seconds')
+    if vs and vs.get('count'):
+        out.write('  verify wall      %9.1f ms mean over %d runs\n'
+                  % (1e3 * vs['sum'] / vs['count'], vs['count']))
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == '--verify':
+        if len(argv) != 2:
+            sys.stderr.write(__doc__)
+            return 2
+        return verify_report(load_last(argv[1]))
     if argv and argv[0] == '--memory':
         if len(argv) != 2:
             sys.stderr.write(__doc__)
