@@ -73,7 +73,13 @@ bench:
 # class by name in a real executor run while the tier-1 model corpus
 # verifies clean and the disabled path stays within the hot-path
 # budgets, and the repo must hold its flag-hygiene and
-# lock-discipline lints
+# lock-discipline lints, and the self-healing supervisor must confirm
+# a real kill -9 through the aggregator and degrade to the survivor
+# inside the rejoin budget at bitwise loss parity, and the chaos soak
+# must drive >= 4 injected fault kinds (worker kill, torn shard, rpc
+# fault, heartbeat flap, collective stall) to zero-intervention
+# completion with bounded lost work and every fault matched to a
+# named supervisor decision in /statusz
 check:
 	python tools/check_stat_coverage.py
 	python tools/staticcheck.py
@@ -87,6 +93,8 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_memviz.py
 	JAX_PLATFORMS=cpu python tools/check_autoshard.py
 	JAX_PLATFORMS=cpu python tools/check_elastic.py
+	JAX_PLATFORMS=cpu python tools/check_supervisor.py
+	JAX_PLATFORMS=cpu python tools/check_chaos.py
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

@@ -881,17 +881,46 @@ def resume(executor, dirname, program=None, feed_shapes=None,
     return info
 
 
+def _admit_with_backoff(endpoint, trainer_id, timeout, interval):
+    """TrainerHeartbeat registration under the rpc_ps bounded-backoff
+    policy, retried until the rejoin `timeout` deadline: a trainer
+    rejoins exactly when rank 0 (pserver/aggregator) is most likely
+    mid-restart, so a transient connection refusal — which exhausts
+    PsClient's own FLAGS_rpc_retry_times window in well under a
+    second — must be RETRIED here (``elastic/rejoin_retries``), not
+    treated as fatal.  Raises the last transport error only once the
+    deadline passes."""
+    from ..distributed import rpc_ps
+    deadline = time.monotonic() + max(0.0, float(timeout))
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return rpc_ps.TrainerHeartbeat(
+                endpoint, trainer_id, timeout=timeout,
+                interval=interval)
+        except (ConnectionError, OSError):
+            # RpcDeadlineError subclasses ConnectionError: both the
+            # refused connect and the exhausted-retry shapes land here
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise
+            monitor.add('elastic/rejoin_retries')
+            b = rpc_ps._backoff_seconds(attempt) or 0.05
+            time.sleep(min(b, remaining))
+
+
 def rejoin_trainer(endpoint, trainer_id, dirname=None, program=None,
                    scope=None, executor=None, timeout=60.0,
                    interval=None):
     """Re-admission of a restarted trainer: re-register the heartbeat
     slot the dead predecessor's expiry freed (the pserver monitor's
     ``FLAGS_heartbeat_misses`` tolerance decides when that happens)
-    and resume from the last-good generation.  Returns
-    (load_info | None, TrainerHeartbeat)."""
-    from ..distributed.rpc_ps import TrainerHeartbeat
-    hb = TrainerHeartbeat(endpoint, trainer_id, timeout=timeout,
-                          interval=interval)
+    and resume from the last-good generation.  The registration runs
+    under the rpc_ps bounded-backoff policy for the whole `timeout`
+    window, so a briefly unreachable rank 0 is retried, not fatal.
+    Returns (load_info | None, TrainerHeartbeat)."""
+    hb = _admit_with_backoff(endpoint, trainer_id, timeout, interval)
     info = None
     if dirname and is_elastic_store(dirname):
         info = load_checkpoint(dirname, program=program, scope=scope,

@@ -29,6 +29,7 @@ from . import faultinject as _finject
 from . import framework
 from . import memviz as _memviz
 from . import monitor
+from . import supervisor as _sup
 from . import trace as _trace
 from ..ops import registry
 
@@ -1560,13 +1561,24 @@ class Executor(object):
         uncached Executor.run semantics, paid in recompiles."""
         from .compiler import CompiledProgram
         from .parallel_executor import run_parallel, run_collective
+        if _sup.active():
+            # self-healing controller: a pending recovery executes at
+            # this step boundary (and raises supervisor.Recovered so
+            # the train loop re-reads the rewound step counter)
+            _sup.on_step_begin(self)
         if isinstance(program, CompiledProgram):
-            return run_parallel(self, program, feed, fetch_list, scope,
-                                return_numpy)
+            out = run_parallel(self, program, feed, fetch_list, scope,
+                               return_numpy)
+            if _sup.active():
+                _sup.on_step_end(self)
+            return out
         program = program or framework.default_main_program()
         if getattr(program, '_collective_dp', False):
-            return run_collective(self, program, feed, fetch_list, scope,
-                                  return_numpy)
+            out = run_collective(self, program, feed, fetch_list,
+                                 scope, return_numpy)
+            if _sup.active():
+                _sup.on_step_end(self)
+            return out
         scope = scope or core.global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -1594,6 +1606,10 @@ class Executor(object):
         # complete a step (one clock read + dict store)
         monitor.set_gauge('executor/last_step_unix_ts',
                           _time_mod.time())
+        if _sup.active():
+            # checkpoint cadence runs at the step boundary, on this
+            # thread: a snapshot here can never mix two steps' params
+            _sup.on_step_end(self)
         return out
 
     def program_cost(self, program, feed, fetch_list=None, scope=None):
@@ -2265,6 +2281,31 @@ class Executor(object):
             with jax.default_device(device):
                 return c(self._step, state, data)
 
+        # hung-step watchdog (FLAGS_step_timeout_s): steady-state
+        # dispatches run under supervisor.guard_dispatch — a dispatch
+        # blocked past the deadline dumps the flight recorder with
+        # this segment named and raises StepTimeoutError instead of
+        # hanging the process.  First runs (compiles) are exempt: a
+        # legitimate cold compile can exceed any step deadline.
+        # Disabled (the default) this is one flag read per segment.
+        step_timeout = float(get_flag('FLAGS_step_timeout_s', 0.0)
+                             or 0.0)
+
+        def _guarded_dispatch():
+            if _finject.armed():
+                # chaos hook: 'executor.dispatch:stall:<s>' is a hung
+                # device call — the watchdog's test vehicle on the
+                # single-device executor
+                _finject.check('executor.dispatch', step=self._step)
+            res = _call(compiled)
+            # the execution sync must park INSIDE the guarded region:
+            # jit dispatch is async, so a wedged device call would
+            # otherwise hang later at fetch — outside the watchdog.
+            # Armed-mode cost: the step loses dispatch/compute overlap
+            # (the watchdog is an opt-in debugging/resilience posture).
+            jax.block_until_ready(res)
+            return res
+
         try:
             if first_run:
                 # the first call of a jitted segment traces + compiles
@@ -2278,8 +2319,18 @@ class Executor(object):
                 # cost must stay one call + one global load, allocation
                 # free (the merged timeline names the segment anyway
                 # via the jit scope)
-                with _trace.span('compile' if first_run else 'dispatch'):
-                    out = _call(compiled)
+                if step_timeout > 0 and not first_run:
+                    with _trace.span('dispatch'):
+                        out = _sup.guard_dispatch(
+                            _guarded_dispatch,
+                            '%dops:%s' % (
+                                len(seg.ops),
+                                ','.join(sorted(seg.output_names)[:3])),
+                            step_timeout, step=self._step)
+                else:
+                    with _trace.span('compile' if first_run
+                                     else 'dispatch'):
+                        out = _call(compiled)
             except TypeError:
                 if first_run or not (plane.active and not auto):
                     raise

@@ -46,6 +46,11 @@ failing chaos run replays exactly.
                         (``fail``/``delay`` exercise retry/backoff)
 ``executor.step``       per Executor.run entry (``die`` = worker
                         death mid-run)
+``executor.dispatch``   per single-device segment dispatch, consulted
+                        only while the hung-step watchdog
+                        (``FLAGS_step_timeout_s``) is armed
+                        (``stall`` = a hung device call — the
+                        watchdog's test vehicle)
 ``collective.dispatch`` per parallel/collective segment dispatch
                         (``stall`` = a straggling collective)
 ``heartbeat.send``      per trainer heartbeat ping (``drop`` = a
@@ -83,8 +88,8 @@ __all__ = [
 
 SITES = (
     'elastic.shard_write', 'elastic.publish', 'rpc.call',
-    'executor.step', 'collective.dispatch', 'heartbeat.send',
-    'progcheck.mutate',
+    'executor.step', 'executor.dispatch', 'collective.dispatch',
+    'heartbeat.send', 'progcheck.mutate',
 )
 
 _ACTIONS = ('die', 'fail', 'raise', 'delay', 'stall', 'torn', 'drop',

@@ -236,6 +236,35 @@ _DEFAULTS = {
     # the 2nd checkpoint shard write.  Empty (the default) disarms:
     # every instrumented site costs one module-global read.
     'FLAGS_faultinject': '',
+    # self-healing supervisor (fluid/supervisor.py): the freeze/revert
+    # switch for an ATTACHED controller — 0 keeps the controller
+    # watching and LOGGING intents (supervisor/frozen_intents,
+    # acted=False in the decision log) but executes nothing: no saves,
+    # no recoveries.  The primitives stay hand-drivable either way;
+    # supervision only exists at all once supervisor.attach() ran.
+    'FLAGS_supervisor': True,
+    # periodic-checkpoint cadence, in executor steps (0 = no periodic
+    # checkpoints): every N steps the attached supervisor snapshots
+    # the program's persistables at the step boundary and writes an
+    # elastic generation on a background thread — never two saves in
+    # flight (backpressure defers), and the cadence DOUBLES when the
+    # write wall approaches the distance between cadence points
+    # (supervisor/cadence_stretched)
+    'FLAGS_supervisor_checkpoint_steps': 0,
+    # rejoin-wait budget (seconds) for a confirmed worker death: when
+    # the priced reshard schedule costs MORE than this, the supervisor
+    # waits up to the budget for the dead worker to rejoin before
+    # degrading to the survivors; cheaper reshards degrade immediately
+    'FLAGS_supervisor_rejoin_wait_s': 10.0,
+    # hung-step watchdog (fluid/supervisor.py guard_dispatch): a
+    # nonzero deadline (seconds) runs every steady-state segment
+    # dispatch — executor and both parallel runners — under a guard
+    # thread; a dispatch blocked past the deadline (collective waiting
+    # on a dead peer) dumps the flight recorder with the segment
+    # named, counts executor/step_timeouts and raises StepTimeoutError
+    # instead of hanging the process forever.  0 (the default) costs
+    # one flag read per segment.
+    'FLAGS_step_timeout_s': 0.0,
     # worker-liveness miss tolerance (distributed/heartbeat.py + the
     # rank-0 health aggregator): this many CONSECUTIVE missed
     # scrapes/expired checks before a worker flips to down/lost — one

@@ -279,6 +279,20 @@ def statusz():
             verify_section = rep
     except Exception:
         pass
+    # self-healing supervisor (fluid.supervisor): controller state,
+    # the bounded decision trail (checkpoints, confirmed deaths,
+    # wait-vs-degrade choices, recoveries, tolerated flaps/backoffs)
+    # and the counter rollup — 'what did the controller decide and
+    # did it act' in one scrape
+    supervisor_section = None
+    try:
+        from . import supervisor
+        rep = supervisor.report()
+        if rep.get('active') or rep.get('decisions') or \
+                rep.get('step_timeouts'):
+            supervisor_section = rep
+    except Exception:
+        pass
     # aggregator rank: per-rank liveness + last-heartbeat skew, so one
     # /statusz answers 'is the job healthy and who is the straggler'
     job_section = None
@@ -298,6 +312,7 @@ def statusz():
         'auto_shard': auto_shard_section,
         'elastic': elastic_section,
         'verify': verify_section,
+        'supervisor': supervisor_section,
         'job': job_section,
         'flags': _all_flags(),
         'versions': versions,
@@ -687,6 +702,29 @@ class _Aggregator(object):
     def peers(self):
         with self._lock:
             return {r: dict(p) for r, p in self._peers.items()}
+
+    def peer_health(self):
+        """Per-worker liveness with the consecutive-miss state — the
+        signal the self-healing supervisor consumes: `misses` is the
+        current consecutive-miss run, `confirmed_down` flips only at
+        the FLAGS_heartbeat_misses threshold (and only for a worker
+        that was ever up: a fresh worker's slow boot is not a death),
+        `up` is the last scrape's verdict."""
+        with self._lock:
+            out = {}
+            for r, p in self._peers.items():
+                misses = self._miss.get(r, 0)
+                was_up = r in self._was_up
+                out[r] = {
+                    'up': bool(p['up']),
+                    'ready': bool(p['ready']),
+                    'endpoint': p['endpoint'],
+                    'misses': misses,
+                    'was_up': was_up,
+                    'confirmed_down': bool(was_up and
+                                           misses >= self.misses),
+                }
+            return out
 
     def healthz(self):
         own = status()

@@ -22,6 +22,7 @@ from . import core
 from . import faultinject as _finject
 from . import memviz as _memviz
 from . import monitor
+from . import supervisor as _sup
 from . import trace as _trace
 from .executor import (_Segment, _SegmentBinder, FetchHandle,
                        _make_segment_fn, _add_note,
@@ -62,6 +63,42 @@ def _dispatch_span(name, key, records):
         if annot:
             return _trace.span(name, **annot)
     return _trace.span(name)
+
+
+def _collective_dispatch(executor, compiled, args, seg, recs):
+    """Steady-state dispatch of a parallel/collective segment, under
+    the hung-step watchdog when FLAGS_step_timeout_s arms it: the
+    faultinject 'collective.dispatch' site, the jit call AND the
+    execution sync run inside the guarded region — a collective
+    blocked on a dead peer hangs at block_until_ready, which is
+    exactly what the watchdog must convert into a named timeout.
+    Disarmed (the default) this is one flag read per dispatch."""
+    from .flags import get_flag
+    timeout = float(get_flag('FLAGS_step_timeout_s', 0.0) or 0.0)
+
+    def _do():
+        if _finject.armed():
+            # chaos hook: 'collective.dispatch:stall:<s>' is a
+            # straggling collective, 'fail' a fabric fault
+            _finject.check('collective.dispatch',
+                           step=executor._step)
+        out = compiled(*args)
+        if timeout > 0:
+            # the execution sync must sit INSIDE the guarded region
+            # (the caller's later block_until_ready is then a no-op):
+            # a dead peer parks the dispatch here.  Unconditional —
+            # a segment whose comms records were evicted still hangs
+            # on a dead peer, and an async dispatch that returns
+            # immediately would dodge the watchdog entirely.
+            jax.block_until_ready(out)
+        return out
+
+    if timeout > 0:
+        return _sup.guard_dispatch(
+            _do,
+            '%dops@%s' % (len(seg.ops), str(seg.comms_key)[:8]),
+            timeout, step=executor._step)
+    return _do()
 
 
 def _default_mesh(places=None):
@@ -537,9 +574,11 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         seg.comms_key = fp
     recs = comms.records_for(seg.comms_key)
     try:
-        if _finject.armed():
+        if first_run and _finject.armed():
             # chaos hook: 'collective.dispatch:stall:<s>' is a
-            # straggling collective, 'fail' a fabric fault
+            # straggling collective, 'fail' a fabric fault (the
+            # steady-state branch consults the site inside the
+            # watchdog-guarded dispatch below)
             _finject.check('collective.dispatch', step=executor._step)
         t0 = _time_mod.perf_counter()
         if first_run:
@@ -561,7 +600,9 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
                 state, data, outputs=out, seg=seg)
         else:
             with _dispatch_span('dispatch', seg.comms_key, recs):
-                out = compiled(executor._step, state, data)
+                out = _collective_dispatch(
+                    executor, compiled, (executor._step, state, data),
+                    seg, recs)
         if recs:
             # achieved bandwidth needs the EXECUTION wall, not the
             # async dispatch: block here — the donated-state release
@@ -731,7 +772,9 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
             step = jnp.asarray(executor._step)
         recs = comms.records_for(seg.comms_key)
         try:
-            if _finject.armed():
+            if first_run and _finject.armed():
+                # steady-state dispatches consult the site inside the
+                # watchdog-guarded _collective_dispatch below
                 _finject.check('collective.dispatch',
                                step=executor._step)
             t0 = _time_mod.perf_counter()
@@ -753,7 +796,9 @@ def _run_collective_plan(executor, plan, feed, scope, mesh, ndev,
                     state, data, outputs=out, seg=seg)
             else:
                 with _dispatch_span('dispatch', seg.comms_key, recs):
-                    out = compiled(step, state, data)
+                    out = _collective_dispatch(
+                        executor, compiled, (step, state, data),
+                        seg, recs)
             if recs:
                 # bandwidth needs the execution wall, not the async
                 # dispatch; the donated-state release below blocks on

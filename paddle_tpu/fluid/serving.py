@@ -69,7 +69,50 @@ from .reader import bucket_for, mask_name, pow2_bucket_ladder
 __all__ = [
     'ServingExecutor', 'pad_rows_to_bucket', 'slice_rows',
     'readiness', 'resident_report', 'OCCUPANCY_BUCKETS',
+    'DeadlineExpired', 'ServingDegraded', 'enter_degraded',
+    'exit_degraded', 'degraded_reason',
 ]
+
+
+class DeadlineExpired(RuntimeError):
+    """A request's submit-time deadline passed while it was still
+    queued: it was SHED (completed exceptionally,
+    ``serving/shed_expired``) instead of padded into a batch — a
+    stalled dispatcher must not burn compute on answers nobody is
+    waiting for."""
+
+
+class ServingDegraded(RuntimeError):
+    """The replica is shedding load (``enter_degraded`` — e.g. the
+    self-healing supervisor is mid-recovery): the request failed fast
+    instead of queueing into a backend that cannot serve it."""
+
+
+# recovery-degradation latch (the supervisor's serving leg): while a
+# reason is set, /healthz reports not-ready and submit() sheds
+_deg_lock = threading.Lock()
+_degraded_reason = None
+
+
+def enter_degraded(reason):
+    """Flip this replica to degraded: readiness() goes False and every
+    submit() completes exceptionally (``serving/shed_degraded``) until
+    ``exit_degraded``.  Idempotent; the latest reason wins."""
+    global _degraded_reason
+    with _deg_lock:
+        _degraded_reason = str(reason)
+    monitor.set_gauge('serving/degraded', 1.0)
+
+
+def exit_degraded():
+    global _degraded_reason
+    with _deg_lock:
+        _degraded_reason = None
+    monitor.set_gauge('serving/degraded', 0.0)
+
+
+def degraded_reason():
+    return _degraded_reason
 
 # batch-occupancy histogram edges (fraction of the bucket that carried
 # real rows: 1.0 = perfectly full batches)
@@ -137,14 +180,18 @@ def _deliver(future, result=None, exc=None):
 
 # ------------------------------------------------------------- requests
 class _Request(object):
-    __slots__ = ('tenant', 'feed', 'rows', 'future', 't_admit')
+    __slots__ = ('tenant', 'feed', 'rows', 'future', 't_admit',
+                 'deadline')
 
-    def __init__(self, tenant, feed, rows, future):
+    def __init__(self, tenant, feed, rows, future, deadline_s=None):
         self.tenant = tenant
         self.feed = feed
         self.rows = rows
         self.future = future
         self.t_admit = _time.perf_counter()
+        # absolute expiry on the monotonic clock; None = no deadline
+        self.deadline = (self.t_admit + float(deadline_s)
+                         if deadline_s is not None else None)
 
 
 class _Batch(object):
@@ -383,12 +430,29 @@ class ServingExecutor(object):
         return all(t.warmed for t in self._tenant_list())
 
     # -- admission -----------------------------------------------------
-    def submit(self, tenant, feed):
+    def submit(self, tenant, feed, deadline_s=None):
         """Enqueue one request (a dict of batch-aligned arrays, any
         row count up to the largest bucket) and return a
         ``concurrent.futures.Future`` resolving to the fetch list,
-        sliced back to the request's rows."""
+        sliced back to the request's rows.
+
+        `deadline_s` bounds the request's useful life from SUBMIT
+        time: a request still queued when its deadline passes is shed
+        — completed exceptionally with ``DeadlineExpired``
+        (``serving/shed_expired``) instead of padded into a batch and
+        dispatched.  While the replica is degraded (supervisor
+        recovery), every submit completes exceptionally with
+        ``ServingDegraded`` immediately."""
         from concurrent.futures import Future
+        if _degraded_reason is not None:
+            # shed, don't queue: a mid-recovery backend answering
+            # "try another replica" NOW beats a request parked behind
+            # a dead dispatcher
+            monitor.add('serving/shed_degraded')
+            fut = Future()
+            fut.set_exception(ServingDegraded(
+                'replica degraded: %s' % _degraded_reason))
+            return fut
         t = self._tenants.get(tenant)
         if t is None:
             raise KeyError('unknown tenant %r (resident: %r)'
@@ -415,7 +479,7 @@ class ServingExecutor(object):
                 'the tenant with a larger bucket ladder'
                 % (rows, t.ladder[-1]))
         fut = Future()
-        req = _Request(tenant, feed, rows, fut)
+        req = _Request(tenant, feed, rows, fut, deadline_s=deadline_s)
         with self._cond:
             if self._closed or self._stopping:
                 raise RuntimeError('ServingExecutor is stopped')
@@ -456,9 +520,22 @@ class ServingExecutor(object):
                 reqs = []
                 rows = 0
                 cap = t.ladder[-1]
+                now = _time.perf_counter()
                 while t.pending and \
                         rows + t.pending[0].rows <= cap:
                     req = t.pending.popleft()
+                    if req.deadline is not None and \
+                            now > req.deadline:
+                        # expired while queued: shed it — padding it
+                        # into a batch would spend device time on an
+                        # answer whose caller already gave up
+                        monitor.add('serving/shed_expired')
+                        _deliver(req.future, exc=DeadlineExpired(
+                            'request for %r expired %.3fs before '
+                            'dispatch (deadline %.3fs after submit)'
+                            % (name, now - req.deadline,
+                               req.deadline - req.t_admit)))
+                        continue
                     # claim the future: a request cancelled while
                     # queued is dropped here, and a claimed future can
                     # no longer be cancelled mid-flight (delivery in
@@ -638,6 +715,10 @@ def readiness():
     if not execs:
         return None, []
     reasons = []
+    if _degraded_reason is not None:
+        # the supervisor's recovery leg: /healthz flips so routers
+        # stop sending traffic while submit() sheds what still arrives
+        reasons.append('degraded: %s' % _degraded_reason)
     for s in execs:
         for t in s._tenant_list():
             if not t.warmed:

@@ -276,6 +276,40 @@ REQUIRED = [
     ('paddle_tpu/fluid/parallel_executor.py', '_finject.check'),
     ('paddle_tpu/fluid/health.py', 'elastic.report'),
     ('bench.py', '_elastic_fields'),
+    # self-healing supervisor (fluid/supervisor.py + the hung-step
+    # watchdog + serving shedding satellites): decision volume, the
+    # checkpoint plane's backpressure/stretch/torn-resave accounting,
+    # confirmed deaths -> recoveries with lost-work totals, step
+    # timeouts, and the serving deadline/degraded shed counters —
+    # tools/check_supervisor.py and tools/check_chaos.py exercise the
+    # whole loop across real process boundaries
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/decisions'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/checkpoints_taken'),
+    ('paddle_tpu/fluid/supervisor.py',
+     'supervisor/checkpoint_deferred'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/checkpoint_torn'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/cadence_stretched'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/save_seconds'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/snapshot_seconds'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/deaths_confirmed'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/recoveries'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/recovery_seconds'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/lost_steps'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/hung_steps'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/rejoins_admitted'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/frozen_intents'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/state_transitions'),
+    ('paddle_tpu/fluid/supervisor.py', 'executor/step_timeouts'),
+    ('paddle_tpu/fluid/executor.py', '_sup.guard_dispatch'),
+    ('paddle_tpu/fluid/executor.py', '_sup.on_step_begin'),
+    ('paddle_tpu/fluid/parallel_executor.py', '_sup.guard_dispatch'),
+    ('paddle_tpu/fluid/serving.py', 'serving/shed_expired'),
+    ('paddle_tpu/fluid/serving.py', 'serving/shed_degraded'),
+    ('paddle_tpu/fluid/serving.py', 'serving/degraded'),
+    ('paddle_tpu/fluid/elastic.py', 'elastic/rejoin_retries'),
+    ('paddle_tpu/fluid/health.py', 'supervisor.report'),
+    ('paddle_tpu/fluid/health.py', 'peer_health'),
+    ('bench.py', '_chaos_fields'),
     # static Program verifier (fluid/progcheck.py): programs checked,
     # per-class diagnostic counters, seeded mutations, wall time —
     # tools/check_progcheck.py proves every class fires by name and

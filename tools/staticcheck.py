@@ -44,6 +44,7 @@ LOCK_MODULES = [
     'paddle_tpu/fluid/comms_plan.py',
     'paddle_tpu/fluid/elastic.py',
     'paddle_tpu/fluid/faultinject.py',
+    'paddle_tpu/fluid/supervisor.py',
     'paddle_tpu/parallel/plan.py',
 ]
 # documented GIL-discipline exemption: registries with NO lock at all
