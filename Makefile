@@ -79,7 +79,13 @@ bench:
 # must drive >= 4 injected fault kinds (worker kill, torn shard, rpc
 # fault, heartbeat flap, collective stall) to zero-intervention
 # completion with bounded lost work and every fault matched to a
-# named supervisor decision in /statusz
+# named supervisor decision in /statusz, and the time-series telemetry
+# plane must serve schema-valid /timeseries windows (per-worker AND
+# aggregated on a real two-process job), fire a deliberately-tight SLO
+# at /alertz with the breaching series cited in the supervisor
+# decision log, hold the hot-path budgets with sampling off, and the
+# run-to-run regression gate must pass an honest rerun while failing
+# a seeded faultinject slowdown by name
 check:
 	python tools/check_stat_coverage.py
 	python tools/staticcheck.py
@@ -95,6 +101,8 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_elastic.py
 	JAX_PLATFORMS=cpu python tools/check_supervisor.py
 	JAX_PLATFORMS=cpu python tools/check_chaos.py
+	JAX_PLATFORMS=cpu python tools/check_timeseries.py
+	JAX_PLATFORMS=cpu python tools/check_regress.py --selftest
 
 wheel: all
 	python setup.py bdist_wheel 2>/dev/null || python setup.py sdist

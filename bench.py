@@ -168,6 +168,48 @@ def _monitor_fields():
         return {}
 
 
+def _flatten_metrics(rec, prefix='', out=None):
+    """Numeric leaves of one bench record as dotted-path series names
+    ('value', 'monitor.run_seconds', 'step_phases.dispatch_ms') — the
+    per-series form BENCH_history.jsonl keeps and
+    tools/check_regress.py gates on.  Bools and strings are not
+    metrics; lists are positional noise and skipped."""
+    out = {} if out is None else out
+    if isinstance(rec, dict):
+        for k, v in rec.items():
+            _flatten_metrics(v, prefix + '%s.' % k, out)
+    elif isinstance(rec, bool):
+        pass
+    elif isinstance(rec, (int, float)):
+        out[prefix[:-1]] = float(rec)
+    return out
+
+
+def append_history(entry, rec, path=None):
+    """Run-to-run regression substrate: every bench entry appends ONE
+    JSON line (wall time, entry name, flattened numeric metrics) to
+    BENCH_history.jsonl — the recorded trajectory
+    tools/check_regress.py compares a fresh run against, so a
+    regression between runs is a named CI failure instead of a human
+    diffing BENCH_*.json by hand.  PADDLE_TPU_BENCH_HISTORY overrides
+    the path (the regression gate's self-test isolates there);
+    PADDLE_TPU_BENCH_RUN_ID groups lines from one sweep.  Never
+    raises — history must not cost a bench its result."""
+    try:
+        if path is None:
+            path = os.environ.get('PADDLE_TPU_BENCH_HISTORY') or \
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             'BENCH_history.jsonl')
+        line = {'ts': round(time.time(), 3), 'entry': str(entry),
+                'run_id': os.environ.get('PADDLE_TPU_BENCH_RUN_ID'),
+                'metrics': _flatten_metrics(rec)}
+        with open(path, 'a') as f:
+            f.write(json.dumps(line, sort_keys=True) + '\n')
+        return path
+    except Exception:
+        return None
+
+
 def _perf_fields(step_s, cost):
     if not cost or not cost.get('flops'):
         return {}
@@ -1817,15 +1859,19 @@ def main():
         kwargs = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
         if sys.argv[2] == 'resnet50':
             ips = bench_resnet50(**kwargs)
-            print(json.dumps(dict({
+            rec = dict({
                 'metric': 'resnet50_train_images_per_sec_chip',
                 'value': round(ips, 2), 'unit': 'images/sec',
                 'vs_baseline': round(ips / 365.0, 3)},
                 **LAST_PERF, **_step_phase_fields(),
-                **_monitor_fields())))
+                **_monitor_fields())
         else:
-            print(json.dumps(
-                globals()['bench_' + sys.argv[2]](**kwargs)))
+            rec = globals()['bench_' + sys.argv[2]](**kwargs)
+        print(json.dumps(rec))
+        # every entry (--one is also how --all/--cold/--elastic spawn
+        # children) lands one line in the run-to-run history
+        if isinstance(rec, dict):
+            append_history(sys.argv[2], rec)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--cold':
         # process-restart latency: cold (populate the persistent
@@ -1855,6 +1901,7 @@ def main():
                          'BENCH_chaos.json')
         rec = bench_chaos()
         print(json.dumps(rec))
+        append_history('chaos', rec)
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--chaos',
@@ -1869,6 +1916,7 @@ def main():
                          'BENCH_serving.json')
         rec = bench_serving()
         print(json.dumps(rec))
+        append_history('serving_soak', rec)
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--serving',
@@ -1884,6 +1932,7 @@ def main():
                          'BENCH_autoshard.json')
         rec = bench_autoshard()
         print(json.dumps(rec))
+        append_history('autoshard', rec)
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--auto-shard',
@@ -1898,6 +1947,7 @@ def main():
                          'BENCH_comms.json')
         rec = bench_parallel()
         print(json.dumps(rec))
+        append_history('parallel', rec)
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--parallel',

@@ -30,6 +30,7 @@ from . import framework
 from . import memviz as _memviz
 from . import monitor
 from . import supervisor as _sup
+from . import timeseries as _tseries
 from . import trace as _trace
 from ..ops import registry
 
@@ -1190,6 +1191,9 @@ class CompiledPipeline(object):
                         _time_mod.perf_counter() - t0)
         monitor.set_gauge('executor/last_step_unix_ts',
                           _time_mod.time())
+        # windowed-history sample at the step boundary (one flag read
+        # when FLAGS_timeseries is off — the memviz.maybe_sample deal)
+        _tseries.maybe_sample(exe._step)
         return out
 
 
@@ -1606,6 +1610,9 @@ class Executor(object):
         # complete a step (one clock read + dict store)
         monitor.set_gauge('executor/last_step_unix_ts',
                           _time_mod.time())
+        # windowed-history sample at the step boundary (one flag read
+        # when FLAGS_timeseries is off — the memviz.maybe_sample deal)
+        _tseries.maybe_sample(self._step)
         if _sup.active():
             # checkpoint cadence runs at the step boundary, on this
             # thread: a snapshot here can never mix two steps' params

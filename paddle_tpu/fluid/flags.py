@@ -285,6 +285,41 @@ _DEFAULTS = {
     # producer) hang this service's compiler — see BENCHMARKS.md
     # round-4 and tools/repro_conv_wedge.py.
     'FLAGS_conv_precision': 'highest',
+    # windowed history plane (fluid/timeseries.py): on, the executor's
+    # step boundary and the rank-0 aggregator's heartbeat each append
+    # one point per monitor registry entry into a bounded ring
+    # (FLAGS_timeseries_window points per series, sampling every
+    # FLAGS_timeseries_sample_steps steps); rates/deltas/windowed
+    # percentiles are derived at read time at /timeseries.  Off (the
+    # default) the step boundary pays one flag read —
+    # tools/check_timeseries.py holds that against check_hot_path's
+    # budgets.
+    'FLAGS_timeseries': False,
+    'FLAGS_timeseries_window': 512,
+    'FLAGS_timeseries_sample_steps': 1,
+    # declarative SLOs (fluid/slo.py): ';'-separated clauses like
+    # 'serving/admit_to_done_seconds p99 < 20ms;
+    #  executor/step_timeouts rate == 0', evaluated on the sampling
+    # cadence over a fast/slow window pair (the 5m/1h burn-rate
+    # analogs, scaled to the recorded step count) with
+    # FLAGS_slo_hysteresis consecutive evaluations required to fire
+    # or resolve; firing alerts surface at /alertz, land in the
+    # supervisor decision log, and leave one flight dump per
+    # FLAGS_slo_dump_interval_s.
+    'FLAGS_slo': '',
+    # nonzero: every ServingExecutor declares the standing
+    # 'serving/admit_to_done_seconds p99 < X' objective at
+    # construction (seconds)
+    'FLAGS_serving_slo_p99_s': 0.0,
+    'FLAGS_slo_fast_points': 12,
+    'FLAGS_slo_slow_points': 96,
+    'FLAGS_slo_hysteresis': 3,
+    'FLAGS_slo_dump_interval_s': 60.0,
+    # supervisor state-transition flight dumps go through
+    # trace.rate_limited_dump under this interval; 0 (the default)
+    # keeps the one-dump-per-transition behavior, a positive value
+    # bounds a transition storm to one dump per interval
+    'FLAGS_supervisor_dump_interval_s': 0.0,
 }
 
 # v1.6 scripts set these; the TPU runtime ACCEPTS them for script

@@ -23,7 +23,13 @@ thread exposing:
   rollup, compile/plan/segment cache stats, flags, jax/backend
   versions;
 - ``/trace/dump`` — on-demand flight-recorder dump (the curl-able
-  form of ``trace.dump()``).
+  form of ``trace.dump()``);
+- ``/timeseries`` — windowed history queries over fluid.timeseries
+  (``?name=&window=&points=&resolution=&rank=``: per-series points
+  plus derived rates/deltas/percentiles; job history per rank on the
+  aggregator);
+- ``/alertz`` — fluid.slo objective states (firing/pending/resolved
+  with burn rates), freshly evaluated per read.
 
 ``distributed/launch.py`` assigns each worker a port and marks rank 0
 the **aggregator**: a background prober scrapes every worker each
@@ -293,6 +299,26 @@ def statusz():
             supervisor_section = rep
     except Exception:
         pass
+    # windowed history (fluid.timeseries): sparkline-style trend per
+    # key series — 'which way is this trainer drifting' at a glance,
+    # with the full window queries one /timeseries call away
+    timeseries_section = None
+    try:
+        from . import timeseries
+        if timeseries.enabled() or timeseries.report()['samples']:
+            timeseries_section = timeseries.statusz_rollup()
+    except Exception:
+        pass
+    # SLO plane (fluid.slo): objective states without forcing an
+    # evaluation — /alertz is the evaluating surface
+    slo_section = None
+    try:
+        from . import slo
+        rep = slo.report()
+        if rep.get('objectives'):
+            slo_section = rep
+    except Exception:
+        pass
     # aggregator rank: per-rank liveness + last-heartbeat skew, so one
     # /statusz answers 'is the job healthy and who is the straggler'
     job_section = None
@@ -313,6 +339,8 @@ def statusz():
         'elastic': elastic_section,
         'verify': verify_section,
         'supervisor': supervisor_section,
+        'timeseries': timeseries_section,
+        'slo': slo_section,
         'job': job_section,
         'flags': _all_flags(),
         'versions': versions,
@@ -408,20 +436,48 @@ def prom_lint(text):
                             % family)
             continue
         prev = -1.0
+        prev_le = None
         inf_v = None
+        max_finite = None
         for le, v in h['buckets']:
             if le is None:
                 problems.append('histogram %s bucket missing le label'
                                 % family)
                 continue
+            if le == '+Inf':
+                le_num = float('inf')
+            else:
+                try:
+                    le_num = float(le)
+                except ValueError:
+                    problems.append('histogram %s bucket le=%r is not '
+                                    'a number' % (family, le))
+                    continue
+            # le bounds must ascend with +Inf last: an out-of-order
+            # bucket makes the cumulative check below meaningless
+            if prev_le is not None and le_num <= prev_le:
+                problems.append('histogram %s bucket le=%s out of '
+                                'order' % (family, le))
+            prev_le = le_num
             if v < prev:
                 problems.append('histogram %s buckets not cumulative '
-                                'at le=%s' % (family, le))
+                                'at le=%s (per-bucket counts instead '
+                                'of the running total?)' % (family, le))
             prev = v
             if le == '+Inf':
                 inf_v = v
+            elif max_finite is None or v > max_finite:
+                max_finite = v
         if inf_v is None:
             problems.append('histogram %s missing +Inf bucket' % family)
+        elif max_finite is not None and max_finite > inf_v:
+            # a finite bucket above +Inf is the signature of a
+            # per-bucket-count rendering whose +Inf kept only the
+            # overflow count — cumulative buckets can never exceed it
+            problems.append('histogram %s has a finite bucket above '
+                            'the +Inf bucket (%g > %g): buckets are '
+                            'not cumulative' % (family, max_finite,
+                                                inf_v))
         if h['count'] is None:
             problems.append('histogram %s missing _count' % family)
         elif inf_v is not None and inf_v != h['count']:
@@ -441,7 +497,7 @@ def render_merged(states, prefix='paddle_tpu'):
     count would be nonsense).  `states` is a list of (worker_label,
     raw_state) pairs."""
     from .monitor import (_prom_name, _prom_num, _prom_block,
-                          prom_sample)
+                          prom_histogram_lines, prom_sample)
     lines = []
     seen = set()
     counters = {}
@@ -488,13 +544,8 @@ def render_merged(states, prefix='paddle_tpu'):
         m = _prom_name(n, prefix)
         _prom_block(lines, m, 'histogram',
                     'job-summed histogram %s' % n, seen)
-        cum = 0
-        for edge, c in zip(h['edges'], h['counts']):
-            cum += c
-            lines.append('%s_bucket{le="%g"} %d' % (m, edge, cum))
-        lines.append('%s_bucket{le="+Inf"} %d' % (m, h['count']))
-        lines.append('%s_sum %s' % (m, _prom_num(h['sum'])))
-        lines.append('%s_count %d' % (m, h['count']))
+        prom_histogram_lines(lines, m, h['edges'], h['counts'],
+                             h['sum'], h['count'])
     return '\n'.join(lines) + '\n'
 
 
@@ -536,7 +587,6 @@ class _Aggregator(object):
                            'rollup': None, 'ts': 0.0}
                        for r, ep in self.workers}
         self._last_skew = None
-        self._last_straggler_dump = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name='pt_health_agg')
@@ -546,7 +596,23 @@ class _Aggregator(object):
         while not self._stop.is_set():
             self.probe_once()
             self.check_skew()
+            self._history_tick()
             self._stop.wait(self.interval)
+
+    def _history_tick(self):
+        """Heartbeat leg of the fluid.timeseries sampling cadence:
+        retain this process's OWN registry in the job history (the
+        prober only scrapes peers) and take a local sample — which is
+        also what evaluates SLOs on an aggregator that is not
+        stepping.  Never raises."""
+        try:
+            from . import timeseries
+            if not timeseries.enabled():
+                return
+            timeseries.job_sample(self.self_rank, monitor.raw_state())
+            timeseries.maybe_sample(source='heartbeat')
+        except Exception:
+            monitor.add('health/history_errors')
 
     def _probe_one(self, rank, ep):
         monitor.add('health/scrapes')
@@ -595,6 +661,20 @@ class _Aggregator(object):
             up_now = self._peers[rank]['up']
         monitor.set_gauge('health/worker_up/%s' % rank,
                           1.0 if up_now else 0.0)
+        # job-level history (fluid.timeseries): every heartbeat's
+        # scrape lands in the per-worker ring; a failed scrape leaves
+        # an explicit gap marker so a window over a dead worker shows
+        # the hole instead of bridging its last level
+        try:
+            from . import timeseries
+            if timeseries.enabled():
+                if rec.get('state'):
+                    timeseries.job_sample(rank, rec['state'],
+                                          now=rec['ts'])
+                else:
+                    timeseries.job_gap(rank, now=rec['ts'])
+        except Exception:
+            monitor.add('health/history_errors')
 
     # ------------------------------------------- straggler / skew
     def skew(self):
@@ -630,13 +710,12 @@ class _Aggregator(object):
         factor = float(get_flag('FLAGS_straggler_factor', 0.0) or 0.0)
         if factor > 0 and ratio >= factor:
             monitor.add('comms/straggler_trips')
-            now = time.time()
-            if now - self._last_straggler_dump >= 10 * self.interval:
-                self._last_straggler_dump = now
-                path = trace.dump_on_error('straggler', extra={
-                    'detector': 'straggler', 'skew': rep})
-                if path:
-                    monitor.add('health/detector_dumps')
+            path = trace.rate_limited_dump(
+                'health/straggler', 10 * self.interval,
+                tag='straggler',
+                extra={'detector': 'straggler', 'skew': rep})
+            if path:
+                monitor.add('health/detector_dumps')
         return rep
 
     @staticmethod
@@ -815,7 +894,8 @@ def _make_handler(aggregator):
 
         def do_GET(self):
             monitor.add('health/http_requests')
-            path = self.path.split('?', 1)[0].rstrip('/') or '/'
+            parts = self.path.split('?', 1)
+            path = parts[0].rstrip('/') or '/'
             try:
                 if path == '/metrics':
                     if aggregator is not None:
@@ -857,12 +937,23 @@ def _make_handler(aggregator):
                                      'scrape rank 0'})
                     else:
                         self._send_json(200, aggregator.collect_job())
+                elif path == '/timeseries':
+                    from urllib.parse import parse_qs
+                    from . import timeseries
+                    qs = parse_qs(parts[1]) if len(parts) > 1 else {}
+                    params = {k: v[-1] for k, v in qs.items()}
+                    code, doc = timeseries.http_query(params)
+                    self._send_json(code, doc)
+                elif path == '/alertz':
+                    from . import slo
+                    self._send_json(200, slo.alertz())
                 else:
                     self._send_json(404, {
                         'error': 'unknown path %s' % path,
                         'paths': ['/metrics', '/metrics.json',
                                   '/metrics/local', '/healthz',
                                   '/healthz/local', '/statusz',
+                                  '/timeseries', '/alertz',
                                   '/trace/dump', '/trace/collect']})
             except Exception as e:  # a broken handler must not kill
                 monitor.add('health/http_errors')

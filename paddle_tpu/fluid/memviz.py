@@ -93,8 +93,7 @@ _PARAM_NAMES_CAP = 65536
 # serving plane registers its tenant table here
 _SCOPE_PROVIDERS = []
 # detector / incident state
-_state = {'ema': None, 'hwm': 0.0, 'last_dump_ts': 0.0,
-          'last_oom_ts': 0.0, 'last_census': None,
+_state = {'ema': None, 'hwm': 0.0, 'last_census': None,
           'budget_detected': None}
 
 TOP_K = 8
@@ -107,9 +106,13 @@ def reset():
     with _lock:
         _SEGMENTS.clear()
         _PARAM_NAMES.clear()
-        _state.update({'ema': None, 'hwm': 0.0, 'last_dump_ts': 0.0,
-                       'last_oom_ts': 0.0, 'last_census': None,
+        _state.update({'ema': None, 'hwm': 0.0, 'last_census': None,
                        'budget_detected': None})
+    # the dump limiter moved into the shared trace-side helper; a
+    # reset must still re-open the interval or back-to-back tests
+    # (and bench entries) silently stop dumping
+    from . import trace
+    trace.reset_rate_limits('memviz/')
 
 
 # ------------------------------------------------------- program labels
@@ -666,16 +669,12 @@ def _auto_dump(tag, extra):
     FLAGS_memviz_dump_interval_s) so a persistently-pressured job
     cannot spam /tmp."""
     from . import trace
-    now = time.time()
     interval = float(get_flag('FLAGS_memviz_dump_interval_s', 60.0)
                      or 60.0)
-    with _lock:
-        # check-and-claim atomically: two concurrent detector trips
-        # must produce ONE dump, not race past the limiter together
-        if now - _state['last_dump_ts'] < interval:
-            return None
-        _state['last_dump_ts'] = now
-    path = trace.dump_on_error(tag, extra=extra)
+    # the shared limiter claims atomically: two concurrent detector
+    # trips must produce ONE dump, not race past the limiter together
+    path = trace.rate_limited_dump('memviz/detector', interval,
+                                   tag=tag, extra=extra)
     if path:
         monitor.add('memviz/detector_dumps')
     return path
@@ -729,19 +728,14 @@ def oom_incident(e, step=None, scope=None):
         snap = snapshot(scope)
         snap.update({'kind': 'oom', 'step': step, 'program': program,
                      'error': str(e)[:500]})
-        path = None
-        now = time.time()
+        from . import trace
         interval = float(get_flag('FLAGS_memviz_oom_interval_s', 30.0)
                          or 30.0)
-        with _lock:
-            allowed = now - _state['last_oom_ts'] >= interval
-            if allowed:
-                _state['last_oom_ts'] = now
-        if allowed:
-            from . import trace
-            path = trace.dump_on_error('oom_step%s' % step, extra=snap)
-            if path:
-                monitor.add('memviz/oom_dumps')
+        path = trace.rate_limited_dump('memviz/oom', interval,
+                                       tag='oom_step%s' % step,
+                                       extra=snap)
+        if path:
+            monitor.add('memviz/oom_dumps')
         return format_incident(snap, path)
     except Exception:
         return None

@@ -41,6 +41,7 @@ __all__ = [
     'histogram_value', 'reset', 'set_enabled', 'snapshot', 'flat',
     'dump_jsonl', 'prometheus_text', 'raw_state', 'serve',
     'prom_escape_help', 'prom_escape_label', 'prom_sample',
+    'prom_histogram_lines',
     'TIME_BUCKETS', 'SIZE_BUCKETS', 'NORM_BUCKETS',
 ]
 
@@ -246,6 +247,26 @@ def prom_sample(name, labels, value):
     return '%s %s' % (name, _prom_num(value))
 
 
+def prom_histogram_lines(lines, m, edges, counts, total, cnt):
+    """THE cumulative histogram rendering — exposition-format
+    conformant: running-total ``le`` buckets in ascending order, the
+    ``+Inf`` bucket equal to ``_count``, then ``_sum``/``_count``.
+    Both the local exposition (prometheus_text) and the job-merged
+    one (fluid.health.render_merged) build bucket series HERE, so
+    neither can drift back to raw per-bucket counts — that raw form
+    is /metrics.json's contract, never /metrics's, and
+    fluid.health.prom_lint rejects it.  `counts` are the registry's
+    raw per-bucket counts (len(edges)+1 with the overflow last);
+    `cnt` the total observation count."""
+    cum = 0
+    for edge, c in zip(edges, counts):
+        cum += c
+        lines.append('%s_bucket{le="%g"} %d' % (m, edge, cum))
+    lines.append('%s_bucket{le="+Inf"} %d' % (m, cnt))
+    lines.append('%s_sum %s' % (m, _prom_num(total)))
+    lines.append('%s_count %d' % (m, cnt))
+
+
 def _prom_block(lines, m, kind, help_text, seen):
     """Emit the # HELP / # TYPE preamble once per metric family.  Two
     registry names CAN sanitize to one exposition name ('a/b-c' and
@@ -281,13 +302,7 @@ def prometheus_text(prefix='paddle_tpu'):
         m = _prom_name(n, prefix)
         _prom_block(lines, m, 'histogram',
                     'paddle_tpu runtime histogram %s' % n, seen)
-        cum = 0
-        for edge, c in zip(edges, counts):
-            cum += c
-            lines.append('%s_bucket{le="%g"} %d' % (m, edge, cum))
-        lines.append('%s_bucket{le="+Inf"} %d' % (m, cnt))
-        lines.append('%s_sum %s' % (m, _prom_num(total)))
-        lines.append('%s_count %d' % (m, cnt))
+        prom_histogram_lines(lines, m, edges, counts, total, cnt)
     return '\n'.join(lines) + '\n'
 
 

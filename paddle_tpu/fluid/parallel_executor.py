@@ -23,6 +23,7 @@ from . import faultinject as _finject
 from . import memviz as _memviz
 from . import monitor
 from . import supervisor as _sup
+from . import timeseries as _tseries
 from . import trace as _trace
 from .executor import (_Segment, _SegmentBinder, FetchHandle,
                        _make_segment_fn, _add_note,
@@ -456,6 +457,7 @@ def run_parallel(executor, compiled, feed, fetch_list, scope, return_numpy):
     monitor.observe('executor/run_seconds',
                     _time_mod.perf_counter() - t_run0)
     monitor.set_gauge('executor/last_step_unix_ts', _time_mod.time())
+    _tseries.maybe_sample(executor._step)
     return results
 
 
@@ -698,6 +700,7 @@ def run_collective(executor, program, feed, fetch_list, scope,
     monitor.observe('executor/run_seconds',
                     _time_mod.perf_counter() - t_run0)
     monitor.set_gauge('executor/last_step_unix_ts', _time_mod.time())
+    _tseries.maybe_sample(executor._step)
     return results
 
 

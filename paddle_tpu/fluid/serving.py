@@ -64,6 +64,7 @@ from . import core
 from . import monitor
 from . import trace as _trace
 from .executor import Executor
+from .flags import get_flag
 from .reader import bucket_for, mask_name, pow2_bucket_ladder
 
 __all__ = [
@@ -287,6 +288,20 @@ class ServingExecutor(object):
         self._thread = None
         self._stopping = False
         self._closed = False
+        # standing latency objective (fluid.slo): a nonzero
+        # FLAGS_serving_slo_p99_s declares
+        # 'serving/admit_to_done_seconds p99 < X' the moment a
+        # serving plane exists — evaluated on the timeseries sampling
+        # cadence, surfaced at /alertz, cited in the supervisor
+        # decision log on breach
+        p99 = float(get_flag('FLAGS_serving_slo_p99_s', 0.0) or 0.0)
+        if p99 > 0:
+            try:
+                from . import slo
+                slo.declare('serving/admit_to_done_seconds p99 < %g'
+                            % p99, name='serving_latency_p99')
+            except Exception:
+                monitor.add('slo/bad_clauses')
         _live.add(self)
 
     # -- registration --------------------------------------------------

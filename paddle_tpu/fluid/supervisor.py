@@ -97,6 +97,7 @@ from .flags import get_flag
 __all__ = [
     'Supervisor', 'Recovered', 'StepTimeoutError', 'guard_dispatch',
     'attach', 'detach', 'current', 'active', 'report', 'reset',
+    'record_slo_breach',
 ]
 
 # decision log: module-level (like elastic._refusals) so /statusz keeps
@@ -414,10 +415,16 @@ class Supervisor(object):
                           float(STATES.index(new)))
         # every state transition leaves a flight-recorder dump: the
         # steps that led INTO a recovery are exactly what a post-mortem
-        # needs, and they evict within FLAGS_trace_buffer_steps
-        trace.dump_on_error('supervisor_%s' % new, extra={
-            'incident': 'supervisor_state', 'from': old, 'to': new,
-            'why': why})
+        # needs, and they evict within FLAGS_trace_buffer_steps.
+        # FLAGS_supervisor_dump_interval_s > 0 bounds a transition
+        # storm to one dump per interval (shared limiter)
+        trace.rate_limited_dump(
+            'supervisor/state',
+            float(get_flag('FLAGS_supervisor_dump_interval_s', 0.0)
+                  or 0.0),
+            tag='supervisor_%s' % new, extra={
+                'incident': 'supervisor_state', 'from': old, 'to': new,
+                'why': why})
         monitor.add('supervisor/state_transitions')
 
     # -- step hooks (training thread) ----------------------------------
@@ -828,6 +835,41 @@ def decisions():
     """A copy of the bounded decision log (newest last)."""
     with _lock:
         return [dict(d) for d in _decisions]
+
+
+def record_slo_breach(alert):
+    """fluid.slo's feed: a firing objective lands in THE decision log
+    (kind='slo_breach', the breaching series/window in info) so a
+    later recovery's post-mortem can cite the objective that was
+    already burning when the controller acted.  Works with or without
+    an attached controller — the trail is module-level state."""
+    info = {
+        'series': alert.get('series'),
+        'clause': alert.get('clause'),
+        'measured_fast': alert.get('measured_fast'),
+        'measured_slow': alert.get('measured_slow'),
+        'burn_fast': alert.get('burn_fast'),
+        'burn_slow': alert.get('burn_slow'),
+        'window': alert.get('window'),
+    }
+    sup = _active
+    if sup is not None:
+        return sup._decide('slo_breach', alert.get('name'),
+                           acted=False, **info)
+    rec = {
+        'seq': None, 'wall_unix': time.time(), 'step': None,
+        'kind': 'slo_breach', 'choice': alert.get('name'),
+        'acted': False, 'frozen': False, 'fault': None,
+        'state': None, 'info': info,
+    }
+    with _lock:
+        _seq[0] += 1
+        rec['seq'] = _seq[0]
+        _decisions.append(rec)
+        del _decisions[:-_DECISIONS_CAP]
+    monitor.add('supervisor/decisions')
+    monitor.add('supervisor/decision/slo_breach')
+    return rec
 
 
 def report():

@@ -60,7 +60,7 @@ __all__ = [
     'step_rollup', 'report_from_records', 'format_step_report',
     'counter', 'counters', 'chrome_events', 'merge_device_trace',
     'write_chrome', 'dump', 'dump_payload', 'dump_on_error',
-    'collect_job', 'job_skew_report', 'now_us',
+    'rate_limited_dump', 'collect_job', 'job_skew_report', 'now_us',
 ]
 
 # monotonic->epoch anchor: every span stores perf_counter floats; the
@@ -141,6 +141,7 @@ def reset():
             _steps = collections.deque(maxlen=n)
         else:
             _steps = None
+        _rate_limited.clear()
 
 
 def _depth():
@@ -811,6 +812,50 @@ def dump_on_error(tag, extra=None):
         return dump(path, extra=extra)
     except Exception:
         return None
+
+
+# one limiter for every periodic-incident dump site: per-key last-dump
+# wall times, mutated only under the check-and-claim below
+_rate_limited = {}
+
+
+def rate_limited_dump(key, interval_s, tag=None, extra=None):
+    """THE interval-checked incident-dump path.  The detectors that
+    dump periodically (health spike/straggler, memviz watermark/OOM,
+    SLO breaches, supervisor transitions) share this one limiter
+    instead of each reimplementing last-timestamp bookkeeping: at most
+    one dump per `key` per `interval_s` seconds (0 = no limit), the
+    claim taken atomically so two concurrent trips produce ONE dump.
+    Suppressed calls count trace/dumps_suppressed; the per-SITE
+    counters stay the caller's job (a suppressed trip is still a
+    trip).  Returns the dump path, or None (suppressed, tracer off,
+    or dump failure — never raises)."""
+    try:
+        now = time.time()
+        with _lock:
+            last = _rate_limited.get(key)
+            if interval_s > 0 and last is not None and \
+                    now - last < interval_s:
+                monitor.add('trace/dumps_suppressed')
+                return None
+            _rate_limited[key] = now
+        return dump_on_error(tag if tag is not None else key,
+                             extra=extra)
+    except Exception:
+        return None
+
+
+def reset_rate_limits(prefix=None):
+    """Forget limiter claims (a caller's reset path: memviz.reset
+    drops 'memviz/' so its tests can dump again without waiting out
+    the interval).  None drops everything."""
+    with _lock:
+        if prefix is None:
+            _rate_limited.clear()
+        else:
+            for k in [k for k in _rate_limited
+                      if k.startswith(prefix)]:
+                del _rate_limited[k]
 
 
 # ------------------------------------------------- device-capture attach

@@ -1,0 +1,487 @@
+"""fluid.timeseries + fluid.slo — windowed history, SLO burn-rate
+alerting, and the regression-gate comparer.
+
+The acceptance contract: window math survives the ugly inputs real
+jobs produce — counter resets from a restarted worker (the post-reset
+value IS the delta, prometheus rate() semantics), gauge gaps from a
+dead worker's missed heartbeats (reported as holes, never bridged),
+empty windows (None, not a crash, and no-data neither fires nor
+resolves an SLO); the alert state machine holds its hysteresis
+against a flapping series and scales its slow window honestly on
+short histories; the exposition linter rejects the per-bucket-count
+histogram rendering; rate_limited_dump claims atomically; and the
+run-to-run comparer passes honest reruns while failing seeded
+slowdowns by name."""
+
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import (health, monitor, slo, supervisor,
+                              timeseries, trace)
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), 'tools'))
+import check_regress  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fluid.set_flags({'FLAGS_timeseries': False,
+                     'FLAGS_timeseries_window': 512,
+                     'FLAGS_timeseries_sample_steps': 1,
+                     'FLAGS_slo': '',
+                     'FLAGS_slo_fast_points': 12,
+                     'FLAGS_slo_slow_points': 96,
+                     'FLAGS_slo_hysteresis': 3})
+    slo.reset()
+    timeseries.reset()
+    supervisor.reset()
+    trace.reset()
+    monitor.reset()
+
+
+# ------------------------------------------------------- window math
+class TestWindowMath:
+    def test_counter_reset_is_delta_not_negative(self):
+        # 10, 25, 40, restart -> 5, 20: the reset interval contributes
+        # the post-reset cumulative (5), never -35
+        pts = [(0.0, 0, 10.0), (1.0, 1, 25.0), (2.0, 2, 40.0),
+               (3.0, 3, 5.0), (4.0, 4, 20.0)]
+        deltas = [d for _t, _s, d in timeseries.counter_deltas(pts)]
+        assert deltas == [15.0, 15.0, 5.0, 15.0]
+        assert timeseries.counter_resets(pts) == 1
+        # rate spans the whole window with the reset-aware total
+        assert timeseries.rate_per_s(pts) == pytest.approx(50.0 / 4.0)
+
+    def test_rate_needs_two_points_and_elapsed_time(self):
+        assert timeseries.rate_per_s([]) is None
+        assert timeseries.rate_per_s([(1.0, 0, 5.0)]) is None
+        assert timeseries.rate_per_s([(1.0, 0, 5.0),
+                                      (1.0, 1, 9.0)]) is None
+
+    def test_gauge_gaps_counted_not_bridged(self):
+        pts = [(0.0, 0, 4.0), (1.0, None, None), (2.0, None, None),
+               (3.0, 3, 8.0)]
+        st = timeseries.gauge_stats(pts)
+        assert st['gaps'] == 2 and st['n'] == 2
+        assert st['min'] == 4.0 and st['max'] == 8.0 and st['last'] == 8.0
+
+    def test_gauge_stats_empty(self):
+        st = timeseries.gauge_stats([(0.0, None, None)])
+        assert st['last'] is None and st['n'] == 0 and st['gaps'] == 1
+
+    def test_percentile_interpolates_and_pins_overflow(self):
+        edges = (1.0, 2.0, 4.0)
+        # 4 obs in (1, 2]: p50 lands mid-bucket
+        assert timeseries.percentile_from_counts(
+            edges, [0, 4, 0, 0], 0.5) == pytest.approx(1.5)
+        # all overflow: the honest answer is the last finite edge
+        assert timeseries.percentile_from_counts(
+            edges, [0, 0, 0, 7], 0.99) == 4.0
+        assert timeseries.percentile_from_counts(edges, [0, 0, 0, 0],
+                                                 0.5) is None
+
+    def test_hist_window_subtracts_cumulative_state(self):
+        edges = (1.0, 2.0)
+        # cumulative (count, sum, buckets) at window start and end:
+        # the window saw 3 obs totalling 4.5, all in (1, 2]
+        pts = [(0.0, 0, 10, 8.0, (10, 0, 0)),
+               (5.0, 5, 13, 12.5, (10, 3, 0))]
+        hw = timeseries.hist_window(edges, pts)
+        assert hw['count'] == 3
+        assert hw['sum'] == pytest.approx(4.5)
+        assert hw['mean'] == pytest.approx(1.5)
+        assert 1.0 <= hw['percentiles']['p50'] <= 2.0
+
+    def test_hist_window_reset_falls_back_to_end_state(self):
+        edges = (1.0,)
+        pts = [(0.0, 0, 50, 50.0, (50, 0)),
+               (5.0, 5, 4, 2.0, (4, 0))]    # restarted mid-window
+        hw = timeseries.hist_window(edges, pts)
+        assert hw['count'] == 4 and hw['sum'] == pytest.approx(2.0)
+
+    def test_hist_window_empty(self):
+        hw = timeseries.hist_window((1.0,), [])
+        assert hw['count'] == 0 and hw['mean'] is None
+        assert hw['percentiles']['p99'] is None
+
+    def test_downsample_keeps_last_per_bucket(self):
+        pts = [(t * 0.1, t, float(t)) for t in range(40)]
+        ds = timeseries.downsample(pts, 1.0)
+        assert len(ds) == 4
+        assert [p[2] for p in ds] == [9.0, 19.0, 29.0, 39.0]
+        assert timeseries.downsample(pts, 0) == pts
+
+    def test_spark_normalizes(self):
+        s = timeseries.spark([1, 2, 3, 4, 5, 6, 7, 8])
+        assert s[0] == u'▁' and s[-1] == u'█' and len(s) == 8
+        assert timeseries.spark([None, None]) == ''
+        assert timeseries.spark([3.0, 3.0]) == u'▁▁'
+
+
+# ----------------------------------------------------- live sampling
+class TestSampling:
+    def test_maybe_sample_off_by_default(self):
+        monitor.add('demo/c', 5)
+        assert timeseries.maybe_sample(step=1) is False
+        assert timeseries.report()['samples'] == 0
+
+    def test_sample_appends_one_point_per_registry_entry(self):
+        fluid.set_flags({'FLAGS_timeseries': True})
+        monitor.add('demo/c', 5)
+        monitor.set_gauge('demo/g', 2.0)
+        monitor.observe('demo/h', 0.01)
+        assert timeseries.maybe_sample(step=1) is True
+        monitor.add('demo/c', 3)
+        assert timeseries.maybe_sample(step=2) is True
+        doc = timeseries.window('demo/c')
+        assert doc['kind'] == 'counter' and doc['n'] == 2
+        assert doc['derived']['total_delta'] == pytest.approx(3.0)
+        assert timeseries.window('demo/g')['kind'] == 'gauge'
+        hdoc = timeseries.window('demo/h')
+        assert hdoc['kind'] == 'hist' and hdoc['edges']
+        # points carry (ts, step, value)
+        assert doc['points'][0][1] == 1 and doc['points'][1][1] == 2
+
+    def test_sample_stride(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_timeseries_sample_steps': 4})
+        monitor.add('demo/c')
+        assert timeseries.maybe_sample(step=3) is False
+        assert timeseries.maybe_sample(step=4) is True
+        # heartbeat-source samples ignore the step stride
+        assert timeseries.maybe_sample(source='heartbeat') is True
+
+    def test_window_bounded_by_flag(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_timeseries_window': 8})
+        for i in range(30):
+            monitor.add('demo/c')
+            timeseries.sample(step=i)
+        assert timeseries.window('demo/c')['n'] == 8
+
+    def test_window_unknown_series_and_empty_window(self):
+        fluid.set_flags({'FLAGS_timeseries': True})
+        assert timeseries.window('no/such') is None
+        monitor.add('demo/c')
+        timeseries.sample(step=1, now=100.0)
+        doc = timeseries.window('demo/c', seconds=5, now=1000.0)
+        assert doc['n'] == 0 and doc['derived']['rate_per_s'] is None
+        assert doc['derived']['total_delta'] == 0
+
+    def test_job_history_and_gap_markers(self):
+        st = {'counters': {'w/c': 5.0}, 'gauges': {'w/g': 1.0},
+              'hists': {}}
+        timeseries.job_sample(1, st, now=10.0)
+        st2 = {'counters': {'w/c': 9.0}, 'gauges': {'w/g': 2.0},
+               'hists': {}}
+        timeseries.job_sample(1, st2, now=11.0)
+        # dead worker: two missed heartbeats leave explicit holes in
+        # its GAUGE series (counters stay cumulative)
+        assert timeseries.job_gap(1, now=12.0) == 1
+        assert timeseries.job_gap(1, now=13.0) == 1
+        assert timeseries.job_gap(7, now=12.0) == 0   # never seen
+        doc = timeseries.window('w/g', rank=1)
+        assert doc['derived']['gaps'] == 2
+        assert doc['derived']['last'] == 2.0
+        cdoc = timeseries.window('w/c', rank=1)
+        assert cdoc['n'] == 2 and cdoc['derived']['total_delta'] == 4.0
+        assert timeseries.job_ranks() == ['1']
+
+    def test_http_query_surfaces(self):
+        fluid.set_flags({'FLAGS_timeseries': True})
+        monitor.add('demo/c')
+        timeseries.sample(step=1)
+        code, doc = timeseries.http_query({})
+        assert code == 200 and 'demo/c' in doc['series']
+        code, doc = timeseries.http_query({'name': 'demo/c',
+                                           'point': '1'})
+        assert code == 200 and len(doc['point']) == 3
+        code, doc = timeseries.http_query({'name': 'no/such'})
+        assert code == 404 and doc['series']
+        code, doc = timeseries.http_query({'name': 'demo/c',
+                                           'points': 'nan-ish'})
+        assert code == 400
+
+    def test_statusz_rollup_renders_rows(self):
+        fluid.set_flags({'FLAGS_timeseries': True})
+        for i in range(6):
+            monitor.add('executor/run_calls')
+            monitor.set_gauge('demo/g', float(i))
+            timeseries.sample(step=i, now=100.0 + i)
+        roll = timeseries.statusz_rollup()
+        names = [r['name'] for r in roll['series']]
+        # preferred ordering puts executor series first
+        assert names[0] == 'executor/run_calls'
+        assert all(r['spark'] for r in roll['series'])
+
+
+# --------------------------------------------------------------- slo
+def _gauge_run(values, start=100.0):
+    """Feed a synthetic gauge level per sample tick and evaluate."""
+    for i, v in enumerate(values):
+        monitor.set_gauge('demo/level', float(v))
+        timeseries.sample(step=i, now=start + i)
+
+
+class TestSLO:
+    def test_parse_units_and_forms(self):
+        assert slo.parse('a/b p99 < 20ms') == ('a/b', 'p99', '<',
+                                               pytest.approx(0.02))
+        assert slo.parse('a/b rate == 0') == ('a/b', 'rate', '==', 0.0)
+        assert slo.parse('a/b < 90%') == ('a/b', 'value', '<',
+                                          pytest.approx(0.9))
+        assert slo.parse('a/b value <= 5us')[3] == pytest.approx(5e-6)
+        for bad in ('a/b', 'a/b frobnicate < 1', 'a/b ~ 1',
+                    'a/b < 1parsec', 'a/b p99 < 1 extra'):
+            with pytest.raises(ValueError):
+                slo.parse(bad)
+
+    def test_bad_flag_clause_counts_not_crashes(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo': 'broken clause here extra;'
+                                      'demo/level < 10'})
+        monitor.set_gauge('demo/level', 1.0)
+        timeseries.sample(step=0)
+        assert monitor.counter_value('slo/bad_clauses') == 1
+        assert len(slo.objectives()) == 1
+
+    def test_fires_after_hysteresis_and_cites_supervisor(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo_fast_points': 3,
+                         'FLAGS_slo_slow_points': 6,
+                         'FLAGS_slo_hysteresis': 2})
+        slo.declare('demo/level < 10', name='level_cap')
+        _gauge_run([1, 1, 1])                    # healthy
+        assert slo.objectives()[0]['state'] == 'ok'
+        _gauge_run([50], start=103.0)            # first breach
+        assert slo.objectives()[0]['state'] == 'pending'
+        assert monitor.counter_value('slo/alerts_fired') == 0
+        _gauge_run([50, 50], start=104.0)        # hold the breach
+        doc = slo.objectives()[0]
+        assert doc['state'] == 'firing'
+        assert doc['burn_fast'] == pytest.approx(5.0)
+        assert monitor.counter_value('slo/alerts_fired') == 1
+        recs = [d for d in supervisor.decisions()
+                if d.get('kind') == 'slo_breach']
+        assert recs and recs[-1]['info']['series'] == 'demo/level'
+        assert recs[-1]['info']['window']['fast_points'] == 3
+        az = slo.alertz()
+        assert [a['name'] for a in az['firing']] == ['level_cap']
+
+    def test_flapping_series_neither_fires_nor_resolves(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo_fast_points': 2,
+                         'FLAGS_slo_slow_points': 4,
+                         'FLAGS_slo_hysteresis': 3})
+        slo.declare('demo/level < 10', name='level_cap')
+        # oscillate across the threshold every sample: the bad streak
+        # never reaches 3 (both-window breaches), the good streak is
+        # zeroed by every breach -> pending forever, zero alerts
+        _gauge_run([50, 1] * 12)
+        assert monitor.counter_value('slo/alerts_fired') == 0
+        assert monitor.counter_value('slo/alerts_resolved') == 0
+        assert slo.objectives()[0]['state'] == 'pending'
+
+    def test_resolve_path_and_trail(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo_fast_points': 2,
+                         'FLAGS_slo_slow_points': 4,
+                         'FLAGS_slo_hysteresis': 2})
+        slo.declare('demo/level < 10', name='level_cap')
+        _gauge_run([50, 50, 50, 50])
+        assert slo.objectives()[0]['state'] == 'firing'
+        _gauge_run([1, 1], start=110.0)     # clean run >= hysteresis
+        doc = slo.objectives()[0]
+        assert doc['state'] == 'resolved'
+        assert monitor.counter_value('slo/alerts_resolved') == 1
+        az = slo.alertz()
+        assert az['resolved_trail']
+        _gauge_run([1, 1, 1, 1], start=115.0)   # 2h clean -> ok
+        assert slo.objectives()[0]['state'] == 'ok'
+
+    def test_short_history_scales_slow_window(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo_fast_points': 2,
+                         'FLAGS_slo_slow_points': 96,
+                         'FLAGS_slo_hysteresis': 1})
+        slo.declare('demo/level < 10', name='level_cap')
+        _gauge_run([50, 50, 50])
+        doc = slo.objectives()[0]
+        w = doc['window']
+        assert w['scaled'] is True
+        assert w['available_points'] == 3 < w['slow_points'] == 96
+        # the scaled slow window still measured (and breached): a
+        # short job is not blind for an hour of steps
+        assert doc['measured_slow'] == 50.0 and doc['state'] == 'firing'
+
+    def test_empty_window_neither_fires_nor_resolves(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo_hysteresis': 1})
+        slo.declare('demo/never_recorded < 1', name='ghost')
+        for _ in range(5):
+            slo.evaluate_all(now=100.0)
+        doc = slo.objectives()[0]
+        assert doc['state'] == 'ok' and doc.get('no_data') is True
+        assert monitor.counter_value('slo/alerts_fired') == 0
+
+    def test_zero_budget_burn_reports_raw_measure(self):
+        fluid.set_flags({'FLAGS_timeseries': True,
+                         'FLAGS_slo_fast_points': 2,
+                         'FLAGS_slo_slow_points': 4,
+                         'FLAGS_slo_hysteresis': 1})
+        slo.declare('demo/level == 0', name='zero_budget')
+        _gauge_run([3, 3, 3])
+        doc = slo.objectives()[0]
+        assert doc['state'] == 'firing'
+        assert doc['burn_fast'] == pytest.approx(3.0)
+
+
+# ----------------------------------------------------- exposition lint
+class TestPromLint:
+    def test_live_exposition_is_clean(self):
+        monitor.add('demo/c')
+        monitor.observe('demo/h', 0.01)
+        monitor.observe('demo/h', 99.0)    # overflow bucket populated
+        assert health.prom_lint(monitor.prometheus_text()) == []
+
+    def test_per_bucket_counts_rejected(self):
+        text = '\n'.join([
+            '# HELP m demo', '# TYPE m histogram',
+            'm_bucket{le="0.1"} 5',
+            'm_bucket{le="1"} 2',          # decrease: per-bucket form
+            'm_bucket{le="+Inf"} 1',
+            'm_sum 1.5', 'm_count 8', ''])
+        problems = health.prom_lint(text)
+        assert any('not cumulative' in p for p in problems)
+
+    def test_finite_bucket_above_inf_rejected(self):
+        text = '\n'.join([
+            '# HELP m demo', '# TYPE m histogram',
+            'm_bucket{le="0.1"} 0',
+            'm_bucket{le="1"} 7',
+            'm_bucket{le="+Inf"} 7',
+            'm_sum 1.5', 'm_count 9', ''])
+        problems = health.prom_lint(text)
+        assert any('+Inf bucket 7 != _count' in p for p in problems)
+        text = text.replace('m_count 9', 'm_count 7').replace(
+            'm_bucket{le="+Inf"} 7', 'm_bucket{le="+Inf"} 7\n'
+            'm_bucket{le="2"} 9')
+        problems = health.prom_lint(text)
+        assert any('out of order' in p for p in problems)
+
+    def test_job_merged_render_stays_cumulative(self):
+        st = {'counters': {}, 'gauges': {},
+              'hists': {'demo/h': {'edges': [0.1, 1.0],
+                                   'counts': [2, 3, 1],
+                                   'sum': 4.0, 'count': 6}}}
+        text = health.render_merged([('0', st), ('1', st)])
+        assert health.prom_lint(text) == []
+        assert 'le="+Inf"} 12' in text
+
+
+# ----------------------------------------------------- rate_limited_dump
+class TestRateLimitedDump:
+    def test_claims_once_per_interval(self, tmp_path):
+        fluid.set_flags({'FLAGS_trace_dir': str(tmp_path)})
+        trace.enable()
+        assert trace.rate_limited_dump('t/key', 3600.0,
+                                       tag='rld') is not None
+        before = monitor.counter_value('trace/dumps_suppressed')
+        assert trace.rate_limited_dump('t/key', 3600.0) is None
+        assert monitor.counter_value('trace/dumps_suppressed') == \
+            before + 1
+        # a different key has its own claim
+        assert trace.rate_limited_dump('t/other', 3600.0,
+                                       tag='rld2') is not None
+
+    def test_interval_zero_never_limits(self, tmp_path):
+        fluid.set_flags({'FLAGS_trace_dir': str(tmp_path)})
+        trace.enable()
+        assert trace.rate_limited_dump('t/key', 0.0,
+                                       tag='a') is not None
+        assert trace.rate_limited_dump('t/key', 0.0,
+                                       tag='b') is not None
+
+    def test_reset_rate_limits_reopens(self, tmp_path):
+        fluid.set_flags({'FLAGS_trace_dir': str(tmp_path)})
+        trace.enable()
+        assert trace.rate_limited_dump('m/key', 3600.0,
+                                       tag='x') is not None
+        assert trace.rate_limited_dump('m/key', 3600.0) is None
+        trace.reset_rate_limits('m/')
+        assert trace.rate_limited_dump('m/key', 3600.0,
+                                       tag='y') is not None
+
+
+# -------------------------------------------------------- check_regress
+def _hist_lines(entry, vals, metric='step_s'):
+    return [{'ts': float(i), 'entry': entry, 'run_id': None,
+             'metrics': {metric: v}} for i, v in enumerate(vals)]
+
+
+class TestCheckRegress:
+    def test_honest_run_passes(self):
+        lines = _hist_lines('bench', [0.10, 0.11, 0.09, 0.105])
+        v = [x for x in check_regress.compare(lines)
+             if x['metric'] == 'step_s'][0]
+        assert v['status'] == 'PASS'
+
+    def test_slowdown_regresses_by_name(self):
+        lines = _hist_lines('bench', [0.10, 0.11, 0.09, 0.50])
+        v = [x for x in check_regress.compare(lines)
+             if x['metric'] == 'step_s'][0]
+        assert v['status'] == 'REGRESS' and v['direction'] == 'lower'
+
+    def test_throughput_drop_regresses(self):
+        lines = _hist_lines('bench', [1000.0, 980.0, 1020.0, 300.0],
+                            metric='examples_per_sec')
+        v = [x for x in check_regress.compare(lines)
+             if x['metric'] == 'examples_per_sec'][0]
+        assert v['status'] == 'REGRESS' and v['direction'] == 'higher'
+        # a throughput INCREASE is not a regression
+        lines = _hist_lines('bench', [1000.0, 980.0, 1020.0, 2500.0],
+                            metric='examples_per_sec')
+        v = [x for x in check_regress.compare(lines)
+             if x['metric'] == 'examples_per_sec'][0]
+        assert v['status'] == 'PASS'
+
+    def test_median_of_n_absorbs_one_outlier(self):
+        lines = _hist_lines('bench', [0.10, 0.11, 0.09,
+                                      0.50, 0.10, 0.105])
+        v = [x for x in check_regress.compare(lines, current_n=3)
+             if x['metric'] == 'step_s'][0]
+        assert v['status'] == 'PASS'
+
+    def test_thin_baseline_and_unknown_direction_are_info(self):
+        lines = _hist_lines('bench', [0.10, 0.50])
+        v = [x for x in check_regress.compare(lines)
+             if x['metric'] == 'step_s'][0]
+        assert v['status'] == 'INFO'
+        lines = _hist_lines('bench', [1.0, 2.0, 3.0, 99.0],
+                            metric='monitor.executor.retraces')
+        assert all(x['status'] == 'INFO'
+                   for x in check_regress.compare(lines))
+
+    def test_bench_history_append_and_load(self, tmp_path):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+        path = str(tmp_path / 'h.jsonl')
+        rec = {'step_s': 0.1, 'note': 'text-skipped',
+               'nested': {'p99': 0.2, 'flag': True}}
+        bench.append_history('demo', rec, path=path)
+        lines = check_regress.load_history(path)
+        assert len(lines) == 1
+        m = lines[0]['metrics']
+        assert m['step_s'] == 0.1 and m['nested.p99'] == 0.2
+        assert 'note' not in m and 'nested.flag' not in m
+        # a torn tail line is skipped, not fatal
+        with open(path, 'a') as f:
+            f.write('{"entry": "demo", "metr')
+        assert len(check_regress.load_history(path)) == 1

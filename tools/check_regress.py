@@ -1,0 +1,292 @@
+"""Run-to-run perf regression gate: compare the current bench run
+against the recorded baseline envelope in BENCH_history.jsonl, with a
+NAMED per-series verdict — a perf regression fails CI the way a torn
+checkpoint already does.
+
+Noise-aware by construction: the baseline is the BAND (min..max) of
+the recorded runs widened by --tolerance around the baseline median,
+and the current value is the MEDIAN of the newest --current-n runs —
+median-of-N vs band, never single-sample vs single-sample.  Only
+metrics with a known direction are gated (time-like: lower is better;
+throughput-like: higher is better); everything else is reported INFO.
+
+Postures:
+
+  check_regress.py                      gate ./BENCH_history.jsonl
+  check_regress.py --history F --entry E --current-n 3
+  check_regress.py --selftest           hermetic proof (make check):
+      a real executor micro-bench records 3 baseline runs into a temp
+      history, an honest 4th run must PASS, and a 5th run under a
+      seeded FLAGS_faultinject executor.step delay clause must FAIL
+      with the slowed series named.
+
+Exit 1 on any REGRESS verdict (or a failed selftest).  Run from
+`make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# direction by series name: gate only what we can call honestly
+_HIGHER_RE = re.compile(
+    r'(per_sec|per_second|throughput|tflops|mfu|gbps|speedup)',
+    re.IGNORECASE)
+_LOWER_RE = re.compile(
+    r'(seconds|step_s$|_ms$|_us$|wall_|p50|p95|p99|latency)',
+    re.IGNORECASE)
+# steady-state gates only: cache-state-dependent series regress for
+# environmental reasons (a cold cache dir) and would cry wolf
+_SKIP_RE = re.compile(
+    r'(compile|cold|warmup|vs_baseline|cache|bytes|calls$|count$'
+    r'|hits$|lookups$|ts$)', re.IGNORECASE)
+
+
+def direction(metric):
+    """'higher' / 'lower' / None (INFO-only series)."""
+    if _SKIP_RE.search(metric):
+        return None
+    if _HIGHER_RE.search(metric):
+        return 'higher'
+    if _LOWER_RE.search(metric):
+        return 'lower'
+    return None
+
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    if not n:
+        return None
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def load_history(path):
+    """BENCH_history.jsonl -> [line dicts], oldest first (append
+    order IS time order; a torn tail line is skipped, not fatal)."""
+    lines = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get('entry') and \
+                    isinstance(rec.get('metrics'), dict):
+                lines.append(rec)
+    return lines
+
+
+def compare(lines, entry=None, current_n=1, tolerance=0.30,
+            min_baseline=2, rel_floor=1e-9):
+    """The comparer: split each entry's lines into baseline (all but
+    the newest `current_n`) and current (the newest `current_n`),
+    then verdict per metric.  Returns a list of
+    {entry, metric, status, current, band, baseline_n, direction}
+    with status REGRESS / PASS / INFO."""
+    by_entry = {}
+    for rec in lines:
+        if entry and rec['entry'] != entry:
+            continue
+        by_entry.setdefault(rec['entry'], []).append(rec)
+    verdicts = []
+    for ent in sorted(by_entry):
+        recs = by_entry[ent]
+        if len(recs) <= current_n:
+            verdicts.append({'entry': ent, 'metric': '*',
+                             'status': 'INFO', 'current': None,
+                             'band': None, 'baseline_n': len(recs),
+                             'direction': None,
+                             'note': 'only %d run(s) recorded: '
+                                     'nothing to gate against'
+                                     % len(recs)})
+            continue
+        base, cur = recs[:-current_n], recs[-current_n:]
+        metrics = sorted(set(
+            m for r in cur for m in r['metrics']))
+        for m in metrics:
+            base_vals = [r['metrics'][m] for r in base
+                         if m in r['metrics']]
+            cur_vals = [r['metrics'][m] for r in cur
+                        if m in r['metrics']]
+            cur_v = _median(cur_vals)
+            d = direction(m)
+            v = {'entry': ent, 'metric': m, 'current': cur_v,
+                 'direction': d, 'baseline_n': len(base_vals)}
+            if d is None:
+                v.update(status='INFO', band=None)
+            elif len(base_vals) < min_baseline:
+                v.update(status='INFO', band=None,
+                         note='baseline too thin (%d < %d runs)'
+                              % (len(base_vals), min_baseline))
+            else:
+                med = _median(base_vals)
+                pad = max(tolerance * abs(med), rel_floor)
+                lo = min(base_vals) - pad
+                hi = max(base_vals) + pad
+                v['band'] = [lo, hi]
+                bad = (cur_v > hi) if d == 'lower' else (cur_v < lo)
+                v['status'] = 'REGRESS' if bad else 'PASS'
+            verdicts.append(v)
+    return verdicts
+
+
+def render(verdicts, show_info=False):
+    worst = 0
+    for v in verdicts:
+        if v['status'] == 'INFO' and not show_info:
+            continue
+        if v['status'] == 'REGRESS':
+            worst = 1
+            arrow = 'above' if v['direction'] == 'lower' else 'below'
+            print('REGRESS  %s %s: current %.6g %s baseline band '
+                  '[%.6g, %.6g] over %d run(s)'
+                  % (v['entry'], v['metric'], v['current'], arrow,
+                     v['band'][0], v['band'][1], v['baseline_n']))
+        elif v['status'] == 'PASS':
+            print('PASS     %s %s: current %.6g within [%.6g, %.6g]'
+                  % (v['entry'], v['metric'], v['current'],
+                     v['band'][0], v['band'][1]))
+        else:
+            print('INFO     %s %s: %s'
+                  % (v['entry'], v['metric'],
+                     v.get('note', 'no gated direction')))
+    return worst
+
+
+# ------------------------------------------------------------ selftest
+def _measure_run(exe, prog, feed, loss, steps):
+    import time
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    return (time.perf_counter() - t0) / steps
+
+
+def selftest():
+    """The make-check proof: the comparer must pass an honest rerun
+    of a REAL micro-bench and fail, by name, a rerun slowed by a
+    seeded faultinject delay clause."""
+    import tempfile
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    sys.path.insert(0, ROOT)
+    hist = os.path.join(tempfile.mkdtemp(prefix='pt_regress_'),
+                        'BENCH_history.jsonl')
+    import bench as bench_mod
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import faultinject, layers
+    import numpy as np
+
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 7
+    with fluid.program_guard(prog, startup):
+        x = layers.data('x', shape=[32], dtype='float32')
+        h = layers.fc(x, 32, act='relu')
+        loss = layers.reduce_mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    feed = {'x': np.ones((8, 32), 'float32')}
+    steps, runs = 30, 3
+    failures = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        for _ in range(8):          # warm caches out of the window
+            exe.run(prog, feed=feed, fetch_list=[loss])
+        for _r in range(runs):      # the recorded baseline
+            step_s = _measure_run(exe, prog, feed, loss, steps)
+            bench_mod.append_history(
+                'regress_selftest', {'step_s': step_s}, path=hist)
+        # honest rerun: same posture, must sit inside the band
+        step_s = _measure_run(exe, prog, feed, loss, steps)
+        bench_mod.append_history('regress_selftest',
+                                 {'step_s': step_s}, path=hist)
+        honest = compare(load_history(hist))
+        if any(v['status'] == 'REGRESS' for v in honest):
+            failures.append('honest rerun flagged as regression: %r'
+                            % [v for v in honest
+                               if v['status'] == 'REGRESS'])
+        if not any(v['status'] == 'PASS' and v['metric'] == 'step_s'
+                   for v in honest):
+            failures.append('honest rerun produced no PASS verdict '
+                            'for step_s: %r' % honest)
+        # seeded slowdown: a per-step faultinject delay clause an
+        # order of magnitude above the honest step wall
+        delay = max(10 * step_s, 0.005)
+        fluid.set_flags({'FLAGS_faultinject':
+                         'executor.step:delay:%g@1+' % delay})
+        faultinject.configure()
+        try:
+            slow_s = _measure_run(exe, prog, feed, loss, steps)
+        finally:
+            fluid.set_flags({'FLAGS_faultinject': ''})
+            faultinject.configure()
+        bench_mod.append_history('regress_selftest',
+                                 {'step_s': slow_s}, path=hist)
+        seeded = compare(load_history(hist))
+        named = [v for v in seeded if v['status'] == 'REGRESS'
+                 and v['entry'] == 'regress_selftest'
+                 and v['metric'] == 'step_s']
+        if not named:
+            failures.append(
+                'seeded %.0fms/step delay not flagged: honest %.5fs '
+                'vs slowed %.5fs, verdicts %r'
+                % (1e3 * delay, step_s, slow_s, seeded))
+    print('regress selftest: honest %.5fs/step in band, seeded '
+          '+%.0fms delay -> %.5fs/step'
+          % (step_s, 1e3 * delay, slow_s))
+    if failures:
+        for f in failures:
+            print('REGRESS-GATE BROKEN  ' + f)
+        return 1
+    print('regress selftest: honest rerun PASSed, seeded slowdown '
+          'REGRESSed by name')
+    return 0
+
+
+def main(argv):
+    args = list(argv)
+    if '--selftest' in args:
+        return selftest()
+    history = os.path.join(ROOT, 'BENCH_history.jsonl')
+    entry, current_n, tolerance = None, 1, 0.30
+    show_info = '--verbose' in args
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == '--history':
+            i += 1
+            history = args[i]
+        elif a == '--entry':
+            i += 1
+            entry = args[i]
+        elif a == '--current-n':
+            i += 1
+            current_n = int(args[i])
+        elif a == '--tolerance':
+            i += 1
+            tolerance = float(args[i])
+        i += 1
+    if not os.path.exists(history):
+        print('check_regress: no history at %s (run bench.py first); '
+              'nothing to gate' % history)
+        return 0
+    verdicts = compare(load_history(history), entry=entry,
+                       current_n=current_n, tolerance=tolerance)
+    rc = render(verdicts, show_info=show_info)
+    gated = sum(1 for v in verdicts if v['status'] in ('PASS',
+                                                       'REGRESS'))
+    print('check_regress: %d series gated, %d regressed'
+          % (gated, sum(1 for v in verdicts
+                        if v['status'] == 'REGRESS')))
+    return rc
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

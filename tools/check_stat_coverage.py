@@ -331,6 +331,37 @@ REQUIRED = [
     ('paddle_tpu/fluid/comms_plan.py', 'verify_buckets'),
     ('paddle_tpu/parallel/plan.py', 'progcheck.check_sharding'),
     ('paddle_tpu/fluid/health.py', 'progcheck.report'),
+    # time-series telemetry plane (fluid/timeseries.py + fluid/slo.py):
+    # the windowed-history sampler's own accounting, the job-history
+    # retention at the aggregator, the SLO evaluator/alert counters,
+    # and the step-boundary/heartbeat wiring that feeds them —
+    # tools/check_timeseries.py exercises the plane against a live
+    # two-process job
+    ('paddle_tpu/fluid/timeseries.py', 'timeseries/samples'),
+    ('paddle_tpu/fluid/timeseries.py', 'timeseries/sample_errors'),
+    ('paddle_tpu/fluid/timeseries.py', 'timeseries/job_samples'),
+    ('paddle_tpu/fluid/timeseries.py', 'timeseries/gap_points'),
+    ('paddle_tpu/fluid/timeseries.py', 'timeseries/series'),
+    ('paddle_tpu/fluid/slo.py', 'slo/objectives'),
+    ('paddle_tpu/fluid/slo.py', 'slo/evals'),
+    ('paddle_tpu/fluid/slo.py', 'slo/eval_errors'),
+    ('paddle_tpu/fluid/slo.py', 'slo/alerts_fired'),
+    ('paddle_tpu/fluid/slo.py', 'slo/alerts_resolved'),
+    ('paddle_tpu/fluid/slo.py', 'slo/alerts_pending'),
+    ('paddle_tpu/fluid/slo.py', 'slo/bad_clauses'),
+    ('paddle_tpu/fluid/slo.py', 'slo/firing'),
+    ('paddle_tpu/fluid/slo.py', 'supervisor.record_slo_breach'),
+    ('paddle_tpu/fluid/executor.py', '_tseries.maybe_sample'),
+    ('paddle_tpu/fluid/parallel_executor.py', '_tseries.maybe_sample'),
+    ('paddle_tpu/fluid/health.py', 'timeseries.job_sample'),
+    ('paddle_tpu/fluid/health.py', 'timeseries.job_gap'),
+    ('paddle_tpu/fluid/health.py', 'timeseries.http_query'),
+    ('paddle_tpu/fluid/health.py', 'slo.alertz'),
+    ('paddle_tpu/fluid/supervisor.py', 'supervisor/decision/slo_breach'),
+    ('paddle_tpu/fluid/trace.py', 'trace/dumps_suppressed'),
+    ('paddle_tpu/fluid/serving.py', 'FLAGS_serving_slo_p99_s'),
+    ('tools/stat_summary.py', 'ts.counter_deltas'),
+    ('bench.py', 'append_history'),
 ]
 
 

@@ -34,6 +34,15 @@ Usage:
                                   # class, seeded chaos mutations,
                                   # verify wall time
                                   # (fluid.progcheck)
+  python tools/stat_summary.py --watch 2 http://host:port/metrics.json
+  python tools/stat_summary.py --watch 2 run.jsonl [--iterations K]
+                                  # LIVE mode: re-poll the source
+                                  # every N seconds and render each
+                                  # series' trend — reset-aware rates
+                                  # for counters, levels for gauges,
+                                  # windowed mean for histograms,
+                                  # sparklines — via the
+                                  # fluid.timeseries window math
 
 One-file mode prints the last record as a sorted table (counters,
 gauges, histogram sum/count).  Two-file mode prints after-minus-before
@@ -313,8 +322,128 @@ def verify_report(rec, out=None):
     return 0
 
 
+def _poll_source(source):
+    """One sample of `source` -> (now, counters, gauges, hists) where
+    hists is {name: (count, sum, edges, counts)} (edges/counts None
+    when the source only records the count/sum rollup).  The source is
+    a /metrics.json URL (live scrape) or a dump_jsonl trajectory file
+    (newest line of a growing file)."""
+    import time
+    if source.startswith('http://') or source.startswith('https://'):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        state = doc.get('state', doc)
+        hists = {n: (h.get('count', 0), h.get('sum', 0.0),
+                     h.get('edges'), h.get('counts'))
+                 for n, h in (state.get('hists') or {}).items()}
+        return (time.time(), dict(state.get('counters') or {}),
+                dict(state.get('gauges') or {}), hists)
+    rec = load_last(source)
+    hists = {n: (h.get('count', 0), h.get('sum', 0.0), None, None)
+             for n, h in (rec.get('histograms') or {}).items()}
+    return (rec.get('ts', time.time()),
+            dict(rec.get('counters') or {}),
+            dict(rec.get('gauges') or {}), hists)
+
+
+def watch(interval, source, iterations=None, out=None):
+    """Live trend view: poll `source` every `interval` seconds,
+    accumulate (ts, step, value) points per series, and render rates /
+    levels / windowed means with sparklines — all derived through
+    fluid.timeseries' window math on plain point lists, the same code
+    the /timeseries endpoint runs on the in-process rings."""
+    out = out if out is not None else sys.stdout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import time
+    from paddle_tpu.fluid import timeseries as ts
+    keep = 256
+    series = {}   # name -> {'kind': ..., 'points': [...], 'edges': e}
+    tick = 0
+    while iterations is None or tick < iterations:
+        if tick:
+            time.sleep(interval)
+        tick += 1
+        try:
+            now, counters, gauges, hists = _poll_source(source)
+        except Exception as e:
+            out.write('watch: poll of %s failed: %s\n' % (source, e))
+            continue
+        for n, v in counters.items():
+            s = series.setdefault(n, {'kind': 'counter', 'points': []})
+            s['points'] = (s['points'] + [(now, None, float(v))])[-keep:]
+        for n, v in gauges.items():
+            s = series.setdefault(n, {'kind': 'gauge', 'points': []})
+            s['points'] = (s['points'] + [(now, None, float(v))])[-keep:]
+        for n, (cnt, total, edges, counts) in hists.items():
+            s = series.setdefault(n, {'kind': 'hist', 'points': [],
+                                      'edges': edges})
+            s['edges'] = edges or s.get('edges')
+            s['points'] = (s['points'] +
+                           [(now, None, int(cnt), float(total),
+                             tuple(counts or ()))])[-keep:]
+        out.write('\n-- watch tick %d  %s  (%d series, %gs interval)\n'
+                  % (tick, time.strftime('%H:%M:%S',
+                                         time.localtime(now)),
+                     len(series), interval))
+        out.write('%-46s %-8s %12s %12s  %s\n'
+                  % ('stat', 'kind', 'last', 'per_sec', 'trend'))
+        for n in sorted(series):
+            s = series[n]
+            pts = s['points']
+            if s['kind'] == 'counter':
+                deltas = [d for _t, _s, d in ts.counter_deltas(pts)]
+                rate = ts.rate_per_s(pts)
+                if not deltas or not any(deltas):
+                    continue    # idle counters only add noise live
+                out.write('%-46s %-8s %12s %12s  %s\n'
+                          % (n, 'counter', _fmt(pts[-1][2]),
+                             '-' if rate is None else '%.4g' % rate,
+                             ts.spark(deltas)))
+            elif s['kind'] == 'gauge':
+                st = ts.gauge_stats(pts)
+                vals = [p[2] for p in pts if p[2] is not None]
+                out.write('%-46s %-8s %12s %12s  %s\n'
+                          % (n, 'gauge', _fmt(st['last']), '-',
+                             ts.spark(vals)))
+            else:
+                hw = ts.hist_window(s.get('edges') or (), pts)
+                rate = hw.get('count', 0)
+                elapsed = pts[-1][0] - pts[0][0] if len(pts) > 1 else 0
+                per_s = (rate / elapsed) if elapsed > 0 else None
+                means = [(b[3] - a[3]) / (b[2] - a[2])
+                         for a, b in zip(pts, pts[1:])
+                         if b[2] > a[2]]
+                if not means:
+                    continue
+                mean_s = hw['mean']
+                out.write('%-46s %-8s %12s %12s  %s\n'
+                          % (n, 'hist',
+                             '-' if mean_s is None
+                             else '%.4g' % mean_s,
+                             '-' if per_s is None else '%.4g' % per_s,
+                             ts.spark(means)))
+        out.flush()
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == '--watch':
+        iters = None
+        if '--iterations' in argv:
+            i = argv.index('--iterations')
+            if i + 1 >= len(argv):
+                sys.stderr.write(__doc__)
+                return 2
+            iters = int(argv[i + 1])
+            del argv[i:i + 2]
+        if len(argv) != 3:
+            sys.stderr.write(__doc__)
+            return 2
+        return watch(float(argv[1]), argv[2], iterations=iters)
     if argv and argv[0] == '--verify':
         if len(argv) != 2:
             sys.stderr.write(__doc__)

@@ -46,6 +46,8 @@ LOCK_MODULES = [
     'paddle_tpu/fluid/faultinject.py',
     'paddle_tpu/fluid/supervisor.py',
     'paddle_tpu/parallel/plan.py',
+    'paddle_tpu/fluid/timeseries.py',
+    'paddle_tpu/fluid/slo.py',
 ]
 # documented GIL-discipline exemption: registries with NO lock at all
 # (the lint fails if a lock ever appears there half-wired)
