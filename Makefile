@@ -85,7 +85,10 @@ bench:
 # at /alertz with the breaching series cited in the supervisor
 # decision log, hold the hot-path budgets with sampling off, and the
 # run-to-run regression gate must pass an honest rerun while failing
-# a seeded faultinject slowdown by name
+# a seeded faultinject slowdown by name, and the pallas kernel library
+# must hold the auto-dispatch + dense-fallback contract (documented
+# fallback per kernel, forced-fused-vs-dense parity on CPU, dispatch
+# counters + /statusz reasons, FLAGS_pallas_* knobs wired)
 check:
 	python tools/check_stat_coverage.py
 	python tools/staticcheck.py
@@ -102,6 +105,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_supervisor.py
 	JAX_PLATFORMS=cpu python tools/check_chaos.py
 	JAX_PLATFORMS=cpu python tools/check_timeseries.py
+	JAX_PLATFORMS=cpu python tools/check_kernels.py
 	JAX_PLATFORMS=cpu python tools/check_regress.py --selftest
 
 wheel: all

@@ -93,7 +93,33 @@ _plan_seq = [0]
 # quantized-arm temporaries: int8 copy + fp32 dequant buffers alongside
 # the payload — the factor the HBM-headroom gate prices
 _QUANT_MEM_FACTOR = 2.25
+# with the Pallas fused quantize / dequant-reduce-requant kernels
+# (ops/pallas/quant_collective.py) the fp32 temporaries stay in VMEM
+# tiles; only the int8 shards + scales transit HBM (~payload/4 each
+# side of the wire, plus scale rows)
+_QUANT_MEM_FACTOR_FUSED = 0.75
 _MIN_BUCKET_FLOOR = 64 << 10
+
+
+def _fused_quant_available():
+    """Whether the quantized arm would run the fused Pallas element
+    phases — the same predicate collective_ops dispatches on, so the
+    priced HBM term always matches the path that executes."""
+    try:
+        from ..ops.pallas import quant_collective
+        return bool(quant_collective.fused_available())
+    except Exception:
+        return False
+
+
+def quant_hbm_temp(payload_bytes, fused=None):
+    """HBM bytes of quantized-arm temporaries the headroom gate must
+    cover for one payload: ~2.25x with the dense element phases, ~0.75x
+    when the fused kernels keep the fp32 dequant buffers in VMEM."""
+    if fused is None:
+        fused = _fused_quant_available()
+    factor = _QUANT_MEM_FACTOR_FUSED if fused else _QUANT_MEM_FACTOR
+    return factor * float(payload_bytes)
 
 
 def reset():
@@ -201,6 +227,9 @@ def digest():
              'qmin=%d' % int(get_flag('FLAGS_comms_quantize_min_bytes',
                                       65536)),
              'qblk=%d' % int(get_flag('FLAGS_comms_quant_block', 256)),
+             # fused-kernel availability moves the quant arm's HBM
+             # gate factor (and the executed path), so it must retrace
+             'qfuse=%d' % int(_fused_quant_available()),
              'bkt=%d' % int(get_flag('FLAGS_comms_bucket_bytes',
                                      4 << 20)),
              'fuse=%d' % int(get_flag('FLAGS_comms_fuse_grad_max_bytes',
@@ -315,7 +344,7 @@ def decide(payload_bytes, itemsize, participants, forced_arm=None,
     if want_quant and itemsize > 1:
         headroom = hbm_headroom_bytes()
         if forced_arm == 'quant' or headroom is None or \
-                headroom >= _QUANT_MEM_FACTOR * payload:
+                headroom >= quant_hbm_temp(payload):
             q_wire = quant_wire_bytes(payload, itemsize, n, block)
             pred = predict_seconds('allreduce_quant', q_wire, model)
             if pred is None:

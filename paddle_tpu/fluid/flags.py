@@ -320,6 +320,40 @@ _DEFAULTS = {
     # keeps the one-dump-per-transition behavior, a positive value
     # bounds a transition storm to one dump per interval
     'FLAGS_supervisor_dump_interval_s': 0.0,
+    # Pallas kernel library (ops/pallas/): every fused kernel sits
+    # behind the auto-dispatch + dense-fallback contract (see
+    # ops/pallas/common.py) — off-TPU or when a gate fails, the dense
+    # XLA reference runs instead, and the decision + reason land in
+    # pallas/<kernel>/dispatch_* counters surfaced at /statusz.
+    # FLAGS_pallas_force promotes the fused path even off-TPU
+    # (interpret mode) — the knob parity tests and bench A/Bs use to
+    # exercise the kernels on the CPU mesh; never set it in
+    # production.
+    'FLAGS_pallas_force': False,
+    # fused multi-tensor optimizer updates: consecutive same-hyper
+    # adam/adamw/lamb ops in a segment collapse into one fused_<type>
+    # launch over flattened parameter slabs (lamb's per-param
+    # trust-ratio reduction included).  Off restores the per-param
+    # elementwise chains bit for bit.
+    'FLAGS_pallas_opt_fuse': True,
+    # minimum run length before the optimizer grouping pays for
+    # itself (packing/unpacking a single tensor buys nothing)
+    'FLAGS_pallas_opt_min_tensors': 2,
+    # fused sparse embedding path: lookup_table(_v2) gathers through
+    # the Pallas row-gather kernel (scatter-add custom-vjp backward),
+    # and AdagradOptimizer rewrites eligible embedding updates into
+    # one fused_emb_update over only the touched rows, replacing the
+    # dense scatter + full-table update lowering.
+    'FLAGS_pallas_embedding': True,
+    # vocab-rows floor for the embedding kernel: small tables stay on
+    # the dense gather (bit-exact) where XLA already wins
+    'FLAGS_pallas_embedding_min_rows': 512,
+    # fused block-scaled quantize->reduce-scatter for the quantized
+    # collective arm: the int8 copy + fp32 dequant temporaries of the
+    # dense arm never materialize in HBM, and comms_plan prices the
+    # quant arm with the reduced quant_hbm_temp term when this is
+    # available (see _QUANT_MEM_FACTOR_FUSED)
+    'FLAGS_pallas_quant_collective': True,
 }
 
 # v1.6 scripts set these; the TPU runtime ACCEPTS them for script

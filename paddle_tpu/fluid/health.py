@@ -319,6 +319,19 @@ def statusz():
             slo_section = rep
     except Exception:
         pass
+    # Pallas kernel library (ops/pallas/common.py): per-kernel fused
+    # vs dense dispatch tallies, the LAST decision with its reason
+    # (flag_off / off_tpu / below_floor / ...) and the documented
+    # dense fallback — 'did the fused kernel actually run, and if not
+    # why' in one scrape; rendered once anything has dispatched
+    pallas_section = None
+    try:
+        from ..ops.pallas import common as pallas_common
+        rep = pallas_common.report()
+        if rep.get('kernels'):
+            pallas_section = rep
+    except Exception:
+        pass
     # aggregator rank: per-rank liveness + last-heartbeat skew, so one
     # /statusz answers 'is the job healthy and who is the straggler'
     job_section = None
@@ -341,6 +354,7 @@ def statusz():
         'supervisor': supervisor_section,
         'timeseries': timeseries_section,
         'slo': slo_section,
+        'pallas': pallas_section,
         'job': job_section,
         'flags': _all_flags(),
         'versions': versions,

@@ -97,7 +97,16 @@ def _quant_allreduce(x, axis, n, block):
     chunks) with per-block fp32 scales -> dequantized fp32 reduce ->
     requantize the reduced chunk -> int8 allgather -> dequantize.
     Both wire phases move int8 + 4/block scale overhead, ~4x fewer
-    bytes than dense fp32; accumulation stays fp32."""
+    bytes than dense fp32; accumulation stays fp32.
+
+    On TPU (or under FLAGS_pallas_force) the element phases run as the
+    Pallas kernels in ops/pallas/quant_collective.py — identical math
+    and wire layout, but the fp32 dequant temporaries stay in VMEM
+    tiles instead of costing ~2.25x payload of HBM residency."""
+    from .pallas import quant_collective as _qc
+    use_fused, interpret = _qc.dispatch()
+    if use_fused:
+        return _qc.quant_allreduce_fused(x, axis, n, block, interpret)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     size = flat.size

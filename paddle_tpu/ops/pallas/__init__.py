@@ -6,5 +6,9 @@ fused_attention, layer_norm_op.cu, fusion_group NVRTC JIT codegen
 XLA's automatic fusion isn't enough.
 """
 
+from . import common
 from . import flash_attention
+from . import fused_optimizer
+from . import embedding
+from . import quant_collective
 from .flash_attention import flash_attention as flash_attention_fn

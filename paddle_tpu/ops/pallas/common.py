@@ -1,0 +1,198 @@
+"""Shared plumbing for the Pallas kernel library: the platform probe,
+VMEM residency model and block-size clamp factored out of
+flash_attention.py, plus the auto-dispatch decision layer every fused
+kernel routes through.
+
+The dispatch contract ("never loses"): a fused kernel runs only when
+its enabling flag is on AND every gate it declares holds (on a TPU
+device, shapes at/above the kernel's floor, VMEM estimate under
+budget, supported dtypes/layout).  Any failed gate falls back to the
+kernel's dense JAX reference — bit-identical semantics off-TPU, so
+tier-1 runs on the CPU mesh untouched.  ``FLAGS_pallas_force``
+promotes the fused path in interpret mode off-TPU; parity tests and
+the bench A/B arms use it to exercise the kernel bodies on CPU.
+
+Every decision is observable (the "silent dense fallback" bugfix):
+``dispatch()`` bumps ``pallas/<kernel>/dispatch_{fused,dense}`` and,
+for dense, ``pallas/<kernel>/fallback/<reason>`` in fluid.monitor and
+records the last decision per kernel for /statusz — an A/B arm whose
+"fused" side silently ran dense can't masquerade as a fused win.
+
+Decisions happen at TRACE time (lowerings run once per compiled
+segment), so none of this is hot-path.
+"""
+
+import jax
+
+# VMEM budget for the block-size clamp.  v5e cores have 16 MB less
+# scratch/compiler overhead; 10 MB keeps every swept config compiling
+# with headroom.
+VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
+# kernel-library registry: name -> descriptor.  Populated by each
+# kernel module at import via register_kernel(); tools/check_kernels.py
+# walks it to assert every kernel declares a dense fallback.
+# GIL-disciplined like fluid.monitor (import-time + trace-time writes
+# of scalar values only — no torn composite reads possible).
+KERNELS = {}
+
+# last dispatch decision per kernel (bounded by kernel count):
+# name -> {'path', 'reason', 'interpret'}
+_LAST = {}
+
+_FALLBACK_REASONS = ('flag_off', 'off_tpu', 'below_floor',
+                     'vmem_over_budget', 'dtype', 'layout')
+
+
+def register_kernel(name, dense_fallback, has_vjp=False, doc=''):
+    """Declare a kernel in the library.  ``dense_fallback`` names the
+    dense JAX reference the dispatch layer falls back to (a function
+    path string — documentation + check_kernels assertion, not a
+    callable, so registration never imports lowering code)."""
+    if not dense_fallback:
+        raise ValueError('pallas kernel %r must declare its dense '
+                         'fallback' % (name,))
+    KERNELS[name] = {'dense_fallback': dense_fallback,
+                     'has_vjp': bool(has_vjp), 'doc': doc}
+    return name
+
+
+def kernels():
+    return dict(KERNELS)
+
+
+def on_tpu():
+    try:
+        return jax.devices()[0].platform.startswith('tpu') or \
+            'TPU' in str(jax.devices()[0])
+    except Exception:
+        return False
+
+
+def force_fused():
+    from ...fluid.flags import get_flag
+    return bool(get_flag('FLAGS_pallas_force', False))
+
+
+def vmem_estimate(t, d, block_q, block_k, itemsize):
+    """Bytes a kernel instance keeps resident in VMEM.  Dominant terms
+    across the three kernels: the full K and V rows (streamed via
+    dslice but block-spec'd whole), the q/o/do row blocks, and the f32
+    p/s score blocks (plus their exp/corr temporaries -> x3)."""
+    kv = 2 * t * d * itemsize
+    rows = 3 * block_q * d * itemsize
+    scores = 3 * block_q * block_k * 4
+    return kv + rows + scores + (1 << 18)  # fixed slack
+
+
+def block_sizes(t, block_q, block_k, d=64, itemsize=2):
+    """Clamp requested blocks to divide t AND fit the VMEM budget —
+    an oversized config degrades to the largest fitting one instead of
+    failing to compile (round-3's 2048-wide failure mode)."""
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    while t % block_q:
+        block_q //= 2
+    while t % block_k:
+        block_k //= 2
+    while vmem_estimate(t, d, block_q, block_k, itemsize) > \
+            VMEM_BUDGET_BYTES and max(block_q, block_k) > 128:
+        if block_k >= block_q and block_k > 128:
+            block_k //= 2
+        else:
+            block_q //= 2
+    if vmem_estimate(t, d, block_q, block_k, itemsize) > \
+            VMEM_BUDGET_BYTES:
+        # the resident K/V rows alone exceed the budget (huge t*d):
+        # block shrinking cannot help — surface it so a compile
+        # failure is attributable; sequences this long belong on the
+        # ring-attention path (T sharded over 'sp'), not one kernel
+        import logging
+        logging.getLogger(__name__).warning(
+            'pallas kernel t=%d d=%d: K/V residency exceeds the '
+            'VMEM budget at the smallest blocks (%d/%d); compile may '
+            'fail — use ring attention / sequence parallelism for '
+            'this length', t, d, block_q, block_k)
+    return block_q, block_k
+
+
+def record_dispatch(kernel, fused, reason, interpret=False):
+    """Account one dispatch decision: counters + last-decision entry.
+    Used directly by kernels with a bespoke gate (flash attention's
+    historical always-pallas-even-off-TPU contract); everything else
+    goes through dispatch()."""
+    try:
+        from ...fluid import monitor
+        monitor.add('pallas/%s/dispatch_%s'
+                    % (kernel, 'fused' if fused else 'dense'), 1)
+        if not fused:
+            monitor.add('pallas/%s/fallback/%s' % (kernel, reason), 1)
+    except Exception:
+        pass
+    _LAST[kernel] = {'path': 'fused' if fused else 'dense',
+                     'reason': reason, 'interpret': bool(interpret)}
+
+
+def dispatch(kernel, enabled, checks=(), force=None):
+    """The auto-dispatch gate.  ``checks`` is a sequence of
+    ``(reason, ok)`` pairs evaluated in order (reasons from
+    _FALLBACK_REASONS: 'below_floor', 'vmem_over_budget', 'dtype',
+    'layout'); the first failing gate names the fallback.  Returns
+    ``(use_fused, interpret)`` — interpret=True means the fused body
+    runs under the Pallas interpreter (off-TPU force mode).
+
+    Gate order: flag first (an off flag falls back even on TPU), then
+    the kernel's own checks, then the platform.  ``force`` (default
+    FLAGS_pallas_force) only overrides the PLATFORM gate — a kernel
+    whose shape/dtype gates fail stays dense even under force, so
+    forced parity runs still exercise the real gates."""
+    if not enabled:
+        record_dispatch(kernel, False, 'flag_off')
+        return False, False
+    for reason, ok in checks:
+        if reason not in _FALLBACK_REASONS:
+            raise ValueError('unknown fallback reason %r' % (reason,))
+        if not ok:
+            record_dispatch(kernel, False, reason)
+            return False, False
+    if on_tpu():
+        record_dispatch(kernel, True, 'tpu')
+        return True, False
+    if force if force is not None else force_fused():
+        record_dispatch(kernel, True, 'forced_interpret', interpret=True)
+        return True, True
+    record_dispatch(kernel, False, 'off_tpu')
+    return False, False
+
+
+def report():
+    """/statusz section: per-kernel registration + last decision +
+    dispatch/fallback counter values.  Empty dict when no kernel has
+    dispatched yet (health.py hides the section)."""
+    try:
+        from ...fluid import monitor
+        counter = monitor.counter_value
+    except Exception:
+        def counter(name):
+            return 0
+    out = {}
+    for name, info in sorted(KERNELS.items()):
+        fused = counter('pallas/%s/dispatch_fused' % name) or 0
+        dense = counter('pallas/%s/dispatch_dense' % name) or 0
+        last = _LAST.get(name)
+        if not fused and not dense and last is None:
+            continue
+        ent = {'dense_fallback': info['dense_fallback'],
+               'has_vjp': info['has_vjp'],
+               'dispatch_fused': fused, 'dispatch_dense': dense}
+        if last:
+            ent['last'] = dict(last)
+        fb = {}
+        for reason in _FALLBACK_REASONS:
+            n = counter('pallas/%s/fallback/%s' % (name, reason)) or 0
+            if n:
+                fb[reason] = n
+        if fb:
+            ent['fallbacks'] = fb
+        out[name] = ent
+    return {'kernels': out} if out else {}

@@ -41,10 +41,21 @@ DEFAULT_BLOCK_K = 1024
 # the dense path — the public entry never ships the regression pocket.
 FLASH_MIN_SEQ = 512
 
-# VMEM budget for the block-size clamp.  v5e cores have 16 MB less
-# scratch/compiler overhead; 10 MB keeps every swept config compiling
-# with headroom.
-VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+# platform probe / VMEM model / block clamp live in common.py now
+# (shared by the whole kernel library); the module-level aliases keep
+# the original private names importable.
+from . import common as _common  # noqa: E402
+
+VMEM_BUDGET_BYTES = _common.VMEM_BUDGET_BYTES
+_on_tpu = _common.on_tpu
+_vmem_estimate = _common.vmem_estimate
+_block_sizes = _common.block_sizes
+
+_common.register_kernel(
+    'flash_attention',
+    dense_fallback='ops.pallas.flash_attention._dense_path',
+    has_vjp=True,
+    doc='streamed softmax(QK)V; dispatches dense below min_seq')
 
 
 def _dropout_keep(seed, g, qpos, kpos, keep_threshold):
@@ -543,56 +554,6 @@ def _fused_bwd_vmem(t, d, block_q, block_k, itemsize):
     return rows + dq_acc + 2 * blocks + (1 << 19)
 
 
-def _on_tpu():
-    try:
-        return jax.devices()[0].platform.startswith('tpu') or \
-            'TPU' in str(jax.devices()[0])
-    except Exception:
-        return False
-
-
-def _vmem_estimate(t, d, block_q, block_k, itemsize):
-    """Bytes a kernel instance keeps resident in VMEM.  Dominant terms
-    across the three kernels: the full K and V rows (streamed via
-    dslice but block-spec'd whole), the q/o/do row blocks, and the f32
-    p/s score blocks (plus their exp/corr temporaries -> x3)."""
-    kv = 2 * t * d * itemsize
-    rows = 3 * block_q * d * itemsize
-    scores = 3 * block_q * block_k * 4
-    return kv + rows + scores + (1 << 18)  # fixed slack
-
-
-def _block_sizes(t, block_q, block_k, d=64, itemsize=2):
-    """Clamp requested blocks to divide t AND fit the VMEM budget —
-    an oversized config degrades to the largest fitting one instead of
-    failing to compile (round-3's 2048-wide failure mode)."""
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    while t % block_q:
-        block_q //= 2
-    while t % block_k:
-        block_k //= 2
-    while _vmem_estimate(t, d, block_q, block_k, itemsize) > \
-            VMEM_BUDGET_BYTES and max(block_q, block_k) > 128:
-        if block_k >= block_q and block_k > 128:
-            block_k //= 2
-        else:
-            block_q //= 2
-    if _vmem_estimate(t, d, block_q, block_k, itemsize) > \
-            VMEM_BUDGET_BYTES:
-        # the resident K/V rows alone exceed the budget (huge t*d):
-        # block shrinking cannot help — surface it so a compile
-        # failure is attributable; sequences this long belong on the
-        # ring-attention path (T sharded over 'sp'), not one kernel
-        import logging
-        logging.getLogger(__name__).warning(
-            'flash attention t=%d d=%d: K/V residency exceeds the '
-            'VMEM budget at the smallest blocks (%d/%d); compile may '
-            'fail — use ring attention / sequence parallelism for '
-            'this length', t, d, block_q, block_k)
-    return block_q, block_k
-
-
 def _flash_fwd(q, k, v, bias, seed, h, causal, block_q, block_k,
                interpret, rate=0.0):
     """q,k,v: [BH, T, D], bias: [B, T] or None, seed: packed (1,4)
@@ -891,9 +852,16 @@ def flash_attention(q, k, v, causal=False, key_bias=None,
     if rate and dropout_seed is None:
         raise ValueError('dropout_rate > 0 needs a dropout_seed')
     if t < min_seq:
+        _common.record_dispatch('flash_attention', False, 'below_floor')
         return _dense_path(q, k, v, causal, key_bias, rate,
                            dropout_seed, dropout_offsets,
                            dropout_g_offset)
+    # historical contract: off-TPU the kernels run under the
+    # interpreter rather than falling back dense, so tests cover the
+    # kernel bodies everywhere — record which mode actually ran
+    _common.record_dispatch('flash_attention', True,
+                            'tpu' if _on_tpu() else 'forced_interpret',
+                            interpret=not _on_tpu())
 
     def to_bh(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)

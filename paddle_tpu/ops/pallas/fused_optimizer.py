@@ -1,0 +1,252 @@
+"""Fused multi-tensor optimizer updates as one Pallas launch.
+
+The optimizer phase of ``step_report()`` attributes real wall time to
+the ~100s of per-parameter elementwise chains ``ops/optimizer_ops.py``
+lowers (one adam/adamw/lamb op per tensor — each a handful of tiny
+HBM-bound VPU ops).  Here the executor's run-grouping
+(``fluid/executor.py:_fused_opt_run``) hands the whole run to ONE
+kernel: every tensor is flattened, padded to a (32, 128) f32 block
+multiple, and concatenated into parameter/grad/moment slabs; a
+per-block scalar table carries each tensor's learning rate and beta
+powers, so tensors with different lr schedules still fuse.  The grid
+walks blocks; hyperparameters shared by the run (beta1/beta2/epsilon/
+weight-decay — the grouping key) are compile-time constants.
+
+lamb needs a per-TENSOR trust ratio ``||p|| / ||r||``, a reduction the
+elementwise pass can't see whole: pass 1 updates moments and emits
+per-block partial sums of ``p**2`` and ``r**2`` (one (1, 8) row per
+block), a segment-sum over the block->tensor map builds the trust
+ratios, and pass 2 applies them — the [T, nblk] one-hot matmul a dense
+multi-tensor lamb would need never materializes.
+
+Dense fallback: the per-tensor registered lowerings looped in run
+order — bit-for-bit the ungrouped program.  The fused path evaluates
+the same elementwise expressions in the same order, but the compiled
+kernel body is free to contract mul+add into FMAs the op-by-op dense
+chain rounds individually, so adam/adamw parity is 1-2 ulp (not
+bitwise); lamb additionally sums its trust-ratio norms from per-block
+partials.  The parity suite pins both bounds.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import common
+
+BLOCK_ROWS = 32
+BLOCK_LANES = 128
+BLOCK = BLOCK_ROWS * BLOCK_LANES
+
+# per-block scalar row: [lr, beta1_pow, beta2_pow, trust, 0...]
+SCAL_COLS = 8
+
+common.register_kernel(
+    'fused_optimizer',
+    dense_fallback='ops.optimizer_ops.{adam,adamw,lamb} per-tensor loop',
+    has_vjp=False,
+    doc='one launch updating a whole run of same-hyper optimizer ops '
+        'over flattened parameter slabs (lamb trust ratio in-kernel)')
+
+
+def _pack(tensors):
+    """Flatten+pad each tensor to a BLOCK multiple and concatenate ->
+    (slab [nblk, BLOCK_ROWS, BLOCK_LANES] f32,
+     tid  [nblk] numpy int32 block->tensor map,
+     spans [(flat_offset, numel, shape)]).
+
+    Per-tensor padding (not one tail pad) keeps every block owned by
+    exactly one tensor — the lamb partial-norm rows need that."""
+    flats, tids, spans = [], [], []
+    off = 0
+    for i, t in enumerate(tensors):
+        n = int(np.prod(t.shape)) if t.shape else 1
+        nb = -(-n // BLOCK)
+        f = t.reshape(-1).astype(jnp.float32)
+        if nb * BLOCK - n:
+            f = jnp.concatenate(
+                [f, jnp.zeros((nb * BLOCK - n,), jnp.float32)])
+        flats.append(f)
+        tids.append(np.full((nb,), i, np.int32))
+        spans.append((off, n, t.shape))
+        off += nb * BLOCK
+    slab = jnp.concatenate(flats).reshape(-1, BLOCK_ROWS, BLOCK_LANES)
+    return slab, np.concatenate(tids), spans
+
+
+def _unpack(slab, spans):
+    flat = slab.reshape(-1)
+    return [flat[off:off + n].reshape(shape)
+            for off, n, shape in spans]
+
+
+def _slab_spec():
+    return pl.BlockSpec((1, BLOCK_ROWS, BLOCK_LANES),
+                        lambda i: (i, 0, 0))
+
+
+def _scal_spec():
+    return pl.BlockSpec((1, SCAL_COLS), lambda i: (i, 0))
+
+
+def _adam_kernel(scal_ref, p_ref, g_ref, m1_ref, m2_ref,
+                 po_ref, m1o_ref, m2o_ref, *, beta1, beta2, epsilon,
+                 coeff):
+    # same expression order as ops.optimizer_ops.adam/adamw — the
+    # interpret-mode fused path is bitwise the dense reference
+    lr = scal_ref[0, 0]
+    b1p = scal_ref[0, 1]
+    b2p = scal_ref[0, 2]
+    p = p_ref[...]
+    g = g_ref[...]
+    m1n = beta1 * m1_ref[...] + (1 - beta1) * g
+    m2n = beta2 * m2_ref[...] + (1 - beta2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p * beta2) / (1 - b1p * beta1)
+    pn = p - lr_t * m1n / (jnp.sqrt(m2n) + epsilon)
+    if coeff:
+        pn = pn - lr * coeff * p
+    po_ref[...] = pn
+    m1o_ref[...] = m1n
+    m2o_ref[...] = m2n
+
+
+def _lamb1_kernel(scal_ref, p_ref, g_ref, m1_ref, m2_ref,
+                  m1o_ref, m2o_ref, part_ref, *, beta1, beta2,
+                  epsilon, wd):
+    b1p = scal_ref[0, 1]
+    b2p = scal_ref[0, 2]
+    p = p_ref[...]
+    g = g_ref[...]
+    m1n = beta1 * m1_ref[...] + (1 - beta1) * g
+    m2n = beta2 * m2_ref[...] + (1 - beta2) * g * g
+    mhat = m1n / (1 - b1p * beta1)
+    vhat = m2n / (1 - b2p * beta2)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + wd * p
+    m1o_ref[...] = m1n
+    m2o_ref[...] = m2n
+    # per-block partial norms; padded blocks contribute exact zeros
+    # (p and every moment term are zero there)
+    part_ref[...] = (jnp.zeros((1, SCAL_COLS), jnp.float32)
+                     .at[0, 0].set(jnp.sum(p * p))
+                     .at[0, 1].set(jnp.sum(r * r)))
+
+
+def _lamb2_kernel(scal_ref, p_ref, m1o_ref, m2o_ref, po_ref, *,
+                  beta1, beta2, epsilon, wd):
+    lr = scal_ref[0, 0]
+    b1p = scal_ref[0, 1]
+    b2p = scal_ref[0, 2]
+    trust = scal_ref[0, 3]
+    p = p_ref[...]
+    mhat = m1o_ref[...] / (1 - b1p * beta1)
+    vhat = m2o_ref[...] / (1 - b2p * beta2)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + wd * p
+    po_ref[...] = p - lr * trust * r
+
+
+def _dense(kind, ctx, ins, attrs):
+    """The fallback: per-tensor registered lowerings in run order —
+    exactly what the ungrouped program would have executed."""
+    from .. import optimizer_ops
+    fn = {'adam': optimizer_ops.adam, 'adamw': optimizer_ops.adamw,
+          'lamb': optimizer_ops.lamb}[kind]
+    outs = {}
+    for i in range(len(ins['Param'])):
+        one = {slot: [vals[i]] for slot, vals in ins.items() if vals}
+        for slot, vals in fn(ctx, one, attrs).items():
+            outs.setdefault(slot, []).append(vals[0])
+    return outs
+
+
+def apply(kind, ctx, ins, attrs):
+    """Multi-tensor ``kind`` in {'adam', 'adamw', 'lamb'}: every slot
+    of ``ins`` holds N aligned entries (the executor's run grouping);
+    returns the standard per-op output slots, each with N entries."""
+    from ...fluid.flags import get_flag
+    params = ins['Param']
+    n = len(params)
+    dtype_ok = all(
+        t.dtype == jnp.float32
+        for t in list(params) + list(ins['Moment1']) +
+        list(ins['Moment2'])) and all(
+        jnp.issubdtype(g.dtype, jnp.floating) for g in ins['Grad'])
+    min_n = int(get_flag('FLAGS_pallas_opt_min_tensors', 2))
+    fused, interpret = common.dispatch(
+        'fused_optimizer',
+        bool(get_flag('FLAGS_pallas_opt_fuse', True)),
+        checks=(('below_floor', n >= min_n), ('dtype', dtype_ok)))
+    if not fused:
+        return _dense(kind, ctx, ins, attrs)
+
+    beta1 = attrs.get('beta1', 0.9)
+    beta2 = attrs.get('beta2', 0.999)
+    epsilon = attrs.get('epsilon', 1e-6 if kind == 'lamb' else 1e-8)
+    slab_p, tid, spans = _pack(params)
+    slab_g = _pack(ins['Grad'])[0]
+    slab_m1 = _pack(ins['Moment1'])[0]
+    slab_m2 = _pack(ins['Moment2'])[0]
+    nblk = slab_p.shape[0]
+    b1ps = [ins['Beta1Pow'][i].reshape(()) for i in range(n)]
+    b2ps = [ins['Beta2Pow'][i].reshape(()) for i in range(n)]
+    scal_t = jnp.stack(
+        [jnp.stack([ins['LearningRate'][i].reshape(())
+                    for i in range(n)]).astype(jnp.float32),
+         jnp.stack(b1ps).astype(jnp.float32),
+         jnp.stack(b2ps).astype(jnp.float32)] +
+        [jnp.zeros((n,), jnp.float32)] * (SCAL_COLS - 3),
+        axis=1)                                  # [n, SCAL_COLS]
+    tid_j = jnp.asarray(tid)
+    slab_shape = jax.ShapeDtypeStruct(slab_p.shape, jnp.float32)
+
+    if kind in ('adam', 'adamw'):
+        coeff = attrs.get('coeff', 0.01) if kind == 'adamw' else 0.0
+        po, m1o, m2o = pl.pallas_call(
+            functools.partial(_adam_kernel, beta1=beta1, beta2=beta2,
+                              epsilon=epsilon, coeff=coeff),
+            grid=(nblk,),
+            in_specs=[_scal_spec()] + [_slab_spec()] * 4,
+            out_specs=[_slab_spec()] * 3,
+            out_shape=[slab_shape] * 3,
+            interpret=interpret,
+        )(scal_t[tid_j], slab_p, slab_g, slab_m1, slab_m2)
+    else:
+        wd = attrs.get('weight_decay', 0.01)
+        m1o, m2o, part = pl.pallas_call(
+            functools.partial(_lamb1_kernel, beta1=beta1, beta2=beta2,
+                              epsilon=epsilon, wd=wd),
+            grid=(nblk,),
+            in_specs=[_scal_spec()] + [_slab_spec()] * 4,
+            out_specs=[_slab_spec()] * 2 + [_scal_spec()],
+            out_shape=[slab_shape] * 2 +
+            [jax.ShapeDtypeStruct((nblk, SCAL_COLS), jnp.float32)],
+            interpret=interpret,
+        )(scal_t[tid_j], slab_p, slab_g, slab_m1, slab_m2)
+        pn = jnp.sqrt(jnp.zeros((n,), jnp.float32)
+                      .at[tid_j].add(part[:, 0]))
+        rn = jnp.sqrt(jnp.zeros((n,), jnp.float32)
+                      .at[tid_j].add(part[:, 1]))
+        trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+        po = pl.pallas_call(
+            functools.partial(_lamb2_kernel, beta1=beta1, beta2=beta2,
+                              epsilon=epsilon, wd=wd),
+            grid=(nblk,),
+            in_specs=[_scal_spec()] + [_slab_spec()] * 3,
+            out_specs=_slab_spec(),
+            out_shape=slab_shape,
+            interpret=interpret,
+        )(scal_t.at[:, 3].set(trust)[tid_j], slab_p, m1o, m2o)
+
+    return {
+        'ParamOut': _unpack(po, spans),
+        'Moment1Out': _unpack(m1o, spans),
+        'Moment2Out': _unpack(m2o, spans),
+        'Beta1PowOut': [
+            (b1ps[i] * beta1).reshape(ins['Beta1Pow'][i].shape)
+            for i in range(n)],
+        'Beta2PowOut': [
+            (b2ps[i] * beta2).reshape(ins['Beta2Pow'][i].shape)
+            for i in range(n)],
+    }

@@ -149,6 +149,17 @@ def check_flags(errors):
                 'but no code reads it (dead knob or renamed read '
                 'site; v1.6 compat-only knobs belong in '
                 'V16_COMPAT_ONLY)' % name)
+    # pallas kernel knobs must gate dispatch inside the package — a
+    # FLAGS_pallas_* read only by tests/bench would pass the generic
+    # dead-knob check above while the kernel library silently never
+    # consults it (a dense fallback masquerading as a fused win)
+    for name in sorted(declared):
+        if name.startswith('FLAGS_pallas_') and name not in pkg_reads:
+            errors.append(
+                'FLAG PALLAS UNWIRED  %s is declared but no '
+                'paddle_tpu/ code reads it — pallas dispatch knobs '
+                'must be consulted by the kernel library itself, not '
+                'only by tests or bench harnesses' % name)
     for name in sorted(compat):
         if name in pkg_reads:
             f, ln = pkg_reads[name][0]
