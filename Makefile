@@ -88,7 +88,11 @@ bench:
 # a seeded faultinject slowdown by name, and the pallas kernel library
 # must hold the auto-dispatch + dense-fallback contract (documented
 # fallback per kernel, forced-fused-vs-dense parity on CPU, dispatch
-# counters + /statusz reasons, FLAGS_pallas_* knobs wired)
+# counters + /statusz reasons, FLAGS_pallas_* knobs wired), and the
+# closed-loop autopilot must refit a deliberately-dishonest comms
+# model from live dispatch points with zero retrace churn (digest
+# moves only at adoption), freeze to bit-identical knobs under
+# FLAGS_autopilot=0 and restore the static plan in one revert
 check:
 	python tools/check_stat_coverage.py
 	python tools/staticcheck.py
@@ -106,6 +110,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_chaos.py
 	JAX_PLATFORMS=cpu python tools/check_timeseries.py
 	JAX_PLATFORMS=cpu python tools/check_kernels.py
+	JAX_PLATFORMS=cpu python tools/check_autopilot.py
 	JAX_PLATFORMS=cpu python tools/check_regress.py --selftest
 
 wheel: all

@@ -1684,6 +1684,221 @@ def bench_kernels(rounds=6, per_round=4, warmup=3):
         'unit': 'post_warmup_retraces', 'kernels': out}
 
 
+def bench_autopilot(adapt_steps=60, rounds=4, per_round=3, warmup=2):
+    """Closed-loop autopilot A/B (BENCH_autopilot.json): the SAME
+    GradAllReduce MLP under the SAME faultinjected fabric drift
+    (`collective.dispatch:delay` landing inside the measured dispatch
+    wall), three ways — a STALE static comms model calibrated
+    pre-drift, the autopilot arm starting from that same stale model
+    but allowed to refit online, and a hand-tuned reference
+    calibrated WITH the drift armed (the oracle the autopilot should
+    converge toward).  The adaptation phase runs first on the
+    autopilot arm alone (refits counted; the pending refit must move
+    no digest); the reported numbers come from interleaved bursts so
+    OS noise hits every arm equally, with the in-memory refit
+    installed ONLY during the autopilot arm's bursts — account-time
+    repricing is process-global, so leaving it installed would
+    silently heal the static arms' honesty too.  Honesty per arm is
+    delta(plan_predicted)/delta(plan_measured) over its own bursts."""
+    import tempfile
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import (autopilot, comms, comms_plan,
+                                  faultinject, layers, monitor)
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+    tmp = tempfile.mkdtemp(prefix='bench_autopilot_')
+    stale_path = os.path.join(tmp, 'stale_model.json')
+    tuned_path = os.path.join(tmp, 'tuned_model.json')
+    drift_spec = 'collective.dispatch:delay:0.05@1+'
+    keys = ['FLAGS_comms_plan', 'FLAGS_comms_model_path',
+            'FLAGS_comms_bucket_bytes', 'FLAGS_timeseries',
+            'FLAGS_autopilot', 'FLAGS_autopilot_interval_s']
+    prev = fluid.get_flags(keys)
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.rand(64, 64).astype('float32')}
+
+    def build():
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main_p, startup):
+            x = layers.data('x', shape=[64], dtype='float32')
+            # weight grads land in distinct wire size buckets so the
+            # two-parameter refit stays identifiable from live points
+            h = layers.fc(x, 1024, act='relu')
+            h = layers.fc(h, 32, act='relu')
+            loss = layers.reduce_mean(h)
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        GradAllReduce().transpile(startup, main_p, 0, ['127.0.0.1:0'],
+                                  '127.0.0.1:0')
+        return main_p, startup, loss
+
+    def _pm():
+        return (monitor.counter_value('comms/plan_predicted_seconds')
+                or 0.0,
+                monitor.counter_value('comms/plan_measured_seconds')
+                or 0.0)
+
+    def _honesty(p0m0, p1m1):
+        dp, dm = p1m1[0] - p0m0[0], p1m1[1] - p0m0[1]
+        return round(dp / dm, 4) if dm > 0 else None
+
+    def _lowered():
+        return ((monitor.counter_value('executor/segments_lowered')
+                 or 0.0)
+                + (monitor.counter_value('parallel/segment_cache_miss')
+                   or 0.0))
+
+    def calibrate(path, drift):
+        # fit a comms model from REAL dispatch points: clean fabric ->
+        # the stale pre-drift model; drift armed -> the tuned oracle
+        comms.clear_dispatch_points()
+        fluid.set_flags({'FLAGS_comms_model_path': os.devnull})
+        if drift:
+            faultinject.configure(drift_spec)
+        try:
+            main_p, startup, loss = build()
+            with fluid.scope_guard(fluid.Scope()):
+                exe = fluid.Executor(fluid.XLAPlace(0))
+                exe.run(startup)
+                for _ in range(6):
+                    exe.run(main_p, feed=feed, fetch_list=[loss])
+        finally:
+            faultinject.reset()
+        alpha, beta = comms.fit_linear(
+            comms.dispatch_points('allreduce'))
+        with open(path, 'w') as f:
+            json.dump({'collectives': {'allreduce': {
+                'latency_s': alpha, 'inv_bw_s_per_byte': beta}}}, f)
+        comms.clear_dispatch_points()
+        return {'latency_us': round(alpha * 1e6, 1),
+                'inv_bw_s_per_byte': beta}
+
+    arms = (('static_stale', stale_path, False),
+            ('autopilot', stale_path, True),
+            ('static_tuned', tuned_path, False))
+    out = {'arms': {}}
+    try:
+        fluid.set_flags({'FLAGS_comms_plan': True,
+                         'FLAGS_comms_bucket_bytes': 32 << 10,
+                         'FLAGS_timeseries': True,
+                         'FLAGS_autopilot': True,
+                         'FLAGS_autopilot_interval_s': 0.05})
+        out['stale_model'] = calibrate(stale_path, drift=False)
+        out['tuned_model'] = calibrate(tuned_path, drift=True)
+
+        setups = {}
+        for name, mpath, _is_ap in arms:
+            fluid.set_flags({'FLAGS_comms_model_path': mpath})
+            main_p, startup, loss = build()
+            scope = fluid.Scope()
+            # one Executor PER ARM: parameter init folds the step
+            # counter into its RNG (cross-arm loss parity)
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(warmup):
+                    exe.run(main_p, feed=feed, fetch_list=[loss])
+            setups[name] = {'mpath': mpath, 'program': main_p,
+                            'loss': loss, 'scope': scope, 'exe': exe,
+                            'walls': [], 'pred': 0.0, 'meas': 0.0,
+                            'steps': 0, 'final_loss': None}
+
+        # ---- adaptation: drift on, autopilot arm alone, refit online
+        faultinject.configure(drift_spec)
+        fluid.set_flags({'FLAGS_comms_model_path': stale_path})
+        autopilot.reset()
+        autopilot.engage()
+        refits0 = monitor.counter_value('autopilot/refits') or 0.0
+        lowered0 = _lowered()
+        s = setups['autopilot']
+        pm0 = _pm()
+        steps_to_refit = None
+        pm_refit = None
+        with fluid.scope_guard(s['scope']):
+            for i in range(adapt_steps):
+                s['exe'].run(s['program'], feed=feed,
+                             fetch_list=[s['loss']])
+                if steps_to_refit is None and \
+                        (monitor.counter_value('autopilot/refits')
+                         or 0.0) > refits0:
+                    steps_to_refit = i + 1
+                    pm_refit = _pm()
+                elif steps_to_refit is not None and \
+                        i + 1 >= steps_to_refit + 6:
+                    break   # enough repriced post-refit samples
+        out['adaptation'] = {
+            'refits': int((monitor.counter_value('autopilot/refits')
+                           or 0.0) - refits0),
+            'steps_to_refit': steps_to_refit,
+            'honesty_before_refit':
+                _honesty(pm0, pm_refit) if pm_refit else None,
+            'honesty_after_refit':
+                _honesty(pm_refit, _pm()) if pm_refit else None,
+            'retraces': int(_lowered() - lowered0),
+        }
+        autopilot.disengage()
+        # stash the refit so it prices ONLY the autopilot arm's bursts
+        with comms_plan._lock:
+            ap_model = comms_plan._refit['pending'] or \
+                comms_plan._refit['adopted']
+        comms_plan.clear_refit()
+
+        # ---- measurement: interleaved bursts under the same drift
+        lowered_meas = _lowered()
+        for _ in range(rounds):
+            for name, mpath, is_ap in arms:
+                s = setups[name]
+                fluid.set_flags({'FLAGS_comms_model_path': mpath})
+                if is_ap and ap_model:
+                    comms_plan.install_refit(ap_model)
+                pm_a = _pm()
+                with fluid.scope_guard(s['scope']):
+                    t0 = time.perf_counter()
+                    for _ in range(per_round):
+                        lv, = s['exe'].run(s['program'], feed=feed,
+                                           fetch_list=[s['loss']])
+                    s['walls'].append(time.perf_counter() - t0)
+                pm_b = _pm()
+                s['pred'] += pm_b[0] - pm_a[0]
+                s['meas'] += pm_b[1] - pm_a[1]
+                s['steps'] += per_round
+                s['final_loss'] = float(np.asarray(lv))
+                if is_ap:
+                    comms_plan.clear_refit()
+        out['post_warmup_retraces'] = int(_lowered() - lowered_meas)
+
+        for name, _mpath, _is_ap in arms:
+            s = setups[name]
+            out['arms'][name] = {
+                'steps_per_sec':
+                    round(per_round / min(s['walls']), 2),
+                'best_step_ms':
+                    round(min(s['walls']) / per_round * 1e3, 3),
+                'honesty':
+                    _honesty((0.0, 0.0), (s['pred'], s['meas'])),
+                'final_loss': s['final_loss'],
+            }
+        ap_h = out['arms']['autopilot']['honesty']
+        tn_h = out['arms']['static_tuned']['honesty']
+        tn_ms = out['arms']['static_tuned']['best_step_ms']
+        ap_ms = out['arms']['autopilot']['best_step_ms']
+        if ap_h is not None and tn_h is not None:
+            out['autopilot_vs_tuned'] = {
+                'honesty_gap': round(abs(ap_h - tn_h), 4),
+                'step_delta_pct':
+                    round(100.0 * (ap_ms - tn_ms) / tn_ms, 1),
+            }
+    finally:
+        faultinject.reset()
+        autopilot.disengage()
+        comms_plan.clear_refit()
+        fluid.set_flags(prev)
+    return dict({'metric': 'autopilot_ab',
+                 'value': out['arms'].get('autopilot', {}).get(
+                     'honesty') or 0.0,
+                 'unit': 'pred_over_measured'}, **out)
+
+
 def bench_autoshard(batch=8, rounds=5, per_round=4, warmup=3):
     """Auto-sharding A/B (BENCH_autoshard.json): the SAME transformer
     block (qkv fc -> context-parallel attention -> proj -> MoE FFN,
@@ -2008,7 +2223,8 @@ def _run_entry(name, kwargs, timeout=900):
 
 def main():
     if len(sys.argv) > 1 and sys.argv[1] in ('--parallel',
-                                             '--auto-shard'):
+                                             '--auto-shard',
+                                             '--autopilot'):
         # multi-device posture BEFORE the first jax import: the comms
         # and placement numbers need a real mesh (8 virtual CPU
         # devices when the host has no accelerator platform
@@ -2120,6 +2336,22 @@ def main():
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--auto-shard',
+                       'entries': [rec]}, f, indent=1, sort_keys=True)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--autopilot':
+        # closed-loop autopilot A/B: stale static comms model vs
+        # online-refitting autopilot vs drift-calibrated hand-tuned
+        # reference, all under the same injected fabric drift.
+        # Baseline recorded in BENCH_autopilot.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'BENCH_autopilot.json')
+        rec = bench_autopilot()
+        print(json.dumps(rec))
+        append_history('autopilot', rec)
+        with open(out, 'w') as f:
+            json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
+                              '--autopilot',
                        'entries': [rec]}, f, indent=1, sort_keys=True)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--parallel':

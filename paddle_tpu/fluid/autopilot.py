@@ -1,0 +1,622 @@
+"""fluid.autopilot — closed-loop recalibration and knob tuning over
+the telemetry the runtime already records (ROADMAP item 2, the TUNING
+leg; the CONTROLLER leg is fluid.supervisor).
+
+Three adaptation loops ride the fluid.timeseries sampling cadence
+(``maybe_tick`` is called from ``timeseries.sample`` — NO thread of
+its own, and one dict read when not engaged):
+
+**Comms-model refit.**  The planner prices collectives from a one-shot
+calibration sweep (comms_model.json); when the fabric drifts, the
+windowed ``comms/plan_pred_over_measured`` honesty ratio leaves the
+``FLAGS_autopilot_honesty_band`` and the autopilot refits: the
+measured per-(collective, size-bucket) dispatch points
+(``comms.dispatch_points``) feed ``comms.fit_linear`` (prior =
+current coefficients, so degenerate windows return the prior with an
+``autopilot/refit_degenerate`` count), and the result is installed
+via ``comms_plan.install_refit`` — telemetry repricing picks it up
+IMMEDIATELY (the honesty ratio re-converges with no retrace) while
+planning adopts it only at explicit re-plan points
+(``Executor.warmup`` / ``engage`` call ``comms_plan.adopt_refit``),
+so there is ZERO retrace churn post-warmup.  The refit is atomically
+persisted to a sidecar (``FLAGS_autopilot_refit_path``, default
+``<model>.refit.json`` — never comms_model.json itself, whose file
+identity keys segment fingerprints) and re-installed at engage, so
+restarts keep it and, the digest being coefficient-content-addressed,
+never retrace onto it twice.
+
+**Skew-aware bucketing.**  ``comms/skew_ratio`` above
+``FLAGS_autopilot_skew_high`` means stragglers dominate dispatch
+(latency-bound): halve ``FLAGS_comms_bucket_bytes`` (bounded by
+``FLAGS_autopilot_bucket_min_bytes``) so late ranks block smaller
+fusions.  Skew near 1 is bandwidth-bound: double toward
+``FLAGS_autopilot_bucket_max_bytes`` to amortize launch latency.
+Each move is priced against the current model and logged.
+
+**Serving adaptation.**  Per tenant, once
+``FLAGS_autopilot_ladder_min_batches`` batches of history exist:
+ladder rungs with zero dispatch hits drop (never the largest — it
+bounds admissibility), natural pow2 shapes with
+``FLAGS_autopilot_ladder_hits`` misses join the ladder pre-warmed
+through the persistent compile cache BEFORE becoming admissible (the
+serving path stays zero-retrace); batch occupancy below
+``FLAGS_autopilot_occupancy_low`` raises the tenant's batch-close
+deadline (bounded by ``FLAGS_autopilot_close_wait_max_s``), recovered
+occupancy restores close-immediately.
+
+Every adaptation follows the supervisor's observable/revertible
+contract: a bounded decision log (signal -> decision -> expected gain
+-> acted/frozen) surfaced at ``/statusz`` (section ``autopilot``),
+``autopilot/*`` counters, a freeze mode (``FLAGS_autopilot=0`` logs
+intents with acted=False and touches nothing), an SLO interlock (no
+adaptation while any objective is firing — ``autopilot/slo_frozen``),
+and one-call ``revert()`` back to the static configuration (flags,
+ladders, deadlines, refit — including the persisted sidecar).
+
+Same discipline as monitor/timeseries/slo: no jax imports, module
+registries mutated only under the module ``_lock``.
+"""
+
+import json
+import os
+import threading
+import time
+
+from . import monitor
+from .flags import get_flag, set_flags
+
+__all__ = [
+    'enabled', 'engaged', 'engage', 'disengage', 'maybe_tick', 'tick',
+    'decisions', 'report', 'revert', 'reset',
+]
+
+_lock = threading.Lock()
+
+_DECISIONS_CAP = 256
+_decisions = []
+_seq = [0]
+_state = {
+    'engaged': False,
+    'last_tick': 0.0,
+    'ticks': 0,
+    'last_refit_unix': None,
+    'refit_gen': None,
+    'static_bucket_bytes': None,
+    'last_bucket_change': 0.0,
+}
+
+_HONESTY_SERIES = 'comms/plan_pred_over_measured'
+
+
+def enabled():
+    """False = FLAGS_autopilot=0: the freeze switch.  The loops keep
+    watching and log every intent (acted=False, counted
+    ``autopilot/frozen_intents``) but change nothing — knobs stay
+    bit-identical to the static configuration."""
+    return bool(get_flag('FLAGS_autopilot', True))
+
+
+def engaged():
+    return _state['engaged']
+
+
+# ------------------------------------------------------- decision log
+def _decide(kind, choice, acted=True, frozen=False, now=None, **info):
+    """One bounded decision-log record (the supervisor's contract):
+    what signal was read, what was decided, whether it was acted on or
+    frozen.  Counted ``autopilot/decisions`` and
+    ``autopilot/decision/<kind>``."""
+    if frozen:
+        acted = False
+        monitor.add('autopilot/frozen_intents')
+    rec = {
+        'seq': None,
+        'wall_unix': time.time() if now is None else float(now),
+        'kind': kind, 'choice': choice,
+        'acted': bool(acted), 'frozen': bool(frozen),
+    }
+    if info:
+        rec['info'] = info
+    with _lock:
+        _seq[0] += 1
+        rec['seq'] = _seq[0]
+        _decisions.append(rec)
+        del _decisions[:-_DECISIONS_CAP]
+    monitor.add('autopilot/decisions')
+    monitor.add('autopilot/decision/%s' % kind)
+    return rec
+
+
+def decisions(last=None):
+    """The bounded decision trail, oldest first (optionally just the
+    newest `last`)."""
+    with _lock:
+        out = list(_decisions)
+    return out[-int(last):] if last else out
+
+
+# ------------------------------------------------------- refit sidecar
+def _refit_path():
+    """Where the refit model persists: FLAGS_autopilot_refit_path, or
+    ``<comms model path>.refit.json``.  Deliberately NOT
+    comms_model.json itself — segment fingerprints key on that file's
+    (path, mtime, size) identity, and rewriting it would retrace every
+    plan; the refit enters fingerprints only through its coefficient
+    digest at adoption."""
+    p = str(get_flag('FLAGS_autopilot_refit_path', '') or '')
+    if p:
+        return p
+    from . import comms_plan
+    base = comms_plan._model_path()
+    return (base + '.refit.json') if base else ''
+
+
+def _load_persisted_refit():
+    path = _refit_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            model = json.load(f)
+    except Exception:
+        return None
+    if not isinstance(model, dict) or \
+            not isinstance(model.get('collectives'), dict):
+        return None
+    return model
+
+
+def _persist_refit(model):
+    path = _refit_path()
+    if not path:
+        return False
+    try:
+        from . import io as _io
+        _io._atomic_json_dump(path, model)
+        return True
+    except Exception:
+        monitor.add('autopilot/persist_errors')
+        return False
+
+
+# ------------------------------------------------------------ lifecycle
+def engage(now=None):
+    """Arm the adaptation plane: snapshot the static knobs (the revert
+    target), re-install any persisted refit (install + adopt — engage
+    precedes warmup, so this IS an explicit re-plan point and the
+    rebuild traces exactly once onto the persisted coefficients), and
+    start ticking on the timeseries sampling cadence.  Idempotent;
+    returns True on the arming transition."""
+    now = time.time() if now is None else float(now)
+    cur_bb = int(get_flag('FLAGS_comms_bucket_bytes', 4 << 20)
+                 or (4 << 20))
+    persisted = _load_persisted_refit()
+    gen = None
+    if persisted is not None and enabled():
+        from . import comms_plan
+        gen = comms_plan.install_refit(persisted)
+        comms_plan.adopt_refit()
+    with _lock:
+        already = _state['engaged']
+        _state['engaged'] = True
+        if _state['static_bucket_bytes'] is None:
+            _state['static_bucket_bytes'] = cur_bb
+        if gen is not None:
+            _state['refit_gen'] = gen
+            _state['last_refit_unix'] = now
+    monitor.set_gauge('autopilot/engaged', 1.0)
+    if already:
+        return False
+    if persisted is not None and not enabled():
+        _decide('refit', 'persisted_not_installed', acted=False,
+                frozen=True, now=now, path=_refit_path())
+    _decide('engage', {'persisted_refit': gen is not None},
+            acted=True, now=now,
+            static={'comms_bucket_bytes': cur_bb})
+    return True
+
+
+def disengage():
+    """Stop ticking (knobs keep their adapted values — ``revert()`` is
+    the restore call).  Returns whether the plane was engaged."""
+    with _lock:
+        was = _state['engaged']
+        _state['engaged'] = False
+    monitor.set_gauge('autopilot/engaged', 0.0)
+    return was
+
+
+def reset():
+    """Test isolation hook (mirrors monitor.reset)."""
+    with _lock:
+        del _decisions[:]
+        _seq[0] = 0
+        _state.update(engaged=False, last_tick=0.0, ticks=0,
+                      last_refit_unix=None, refit_gen=None,
+                      static_bucket_bytes=None, last_bucket_change=0.0)
+
+
+# ------------------------------------------------------------- ticking
+def maybe_tick(now=None):
+    """The sampling-cadence hook (timeseries.sample): one dict read
+    when not engaged, interval-throttled by
+    ``FLAGS_autopilot_interval_s`` when engaged.  Never raises."""
+    if not _state['engaged']:
+        return False
+    now = time.time() if now is None else float(now)
+    interval = float(get_flag('FLAGS_autopilot_interval_s', 2.0)
+                     or 2.0)
+    if now - _state['last_tick'] < interval:
+        return False
+    try:
+        tick(now=now)
+        return True
+    except Exception:
+        monitor.add('autopilot/tick_errors')
+        return False
+
+
+def _slo_firing():
+    try:
+        from . import slo
+        return slo.firing_count()
+    except Exception:
+        return 0
+
+
+def tick(now=None):
+    """One pass of all three loops (unconditional — maybe_tick is the
+    gated form)."""
+    now = time.time() if now is None else float(now)
+    with _lock:
+        _state['last_tick'] = now
+        _state['ticks'] += 1
+    monitor.add('autopilot/ticks')
+    frozen = not enabled()
+    slo_firing = _slo_firing()
+    if slo_firing and not frozen:
+        monitor.add('autopilot/slo_frozen')
+    # act only when neither frozen (operator said hands-off) nor
+    # mid-incident (an SLO is firing: adaptation during a fire is how
+    # controllers make outages worse) — intents still log either way
+    act = not frozen and not slo_firing
+    _comms_loop(now, act, frozen, slo_firing)
+    _bucket_loop(now, act, frozen, slo_firing)
+    _serving_loop(now, act, frozen, slo_firing)
+    return now
+
+
+# ------------------------------------------------- loop a: comms refit
+def _honesty(now):
+    """The windowed plan_pred_over_measured ratio (median over samples
+    SINCE the last refit — older points were priced by the model the
+    refit replaced and must not re-trigger it), falling back to the
+    monitor histogram's lifetime mean when no timeseries history
+    exists.  (value, source) or (None, None)."""
+    with _lock:
+        since = _state['last_refit_unix']
+    try:
+        from . import timeseries
+        doc = timeseries.window(
+            _HONESTY_SERIES,
+            seconds=(now - since) if since else None, now=now)
+        if doc and doc['derived'].get('count'):
+            med = (doc['derived'].get('percentiles') or {}).get('p50')
+            if med is not None and med > 0:
+                return float(med), 'timeseries_p50'
+    except Exception:
+        pass
+    h = monitor.histogram_value(_HONESTY_SERIES)
+    if h and h['count']:
+        return h['sum'] / h['count'], 'monitor_mean'
+    return None, None
+
+
+def _comms_loop(now, act, frozen, slo_firing):
+    from . import comms
+    from . import comms_plan
+    band = float(get_flag('FLAGS_autopilot_honesty_band', 1.5) or 1.5)
+    band = max(band, 1.0 + 1e-6)
+    ratio, source = _honesty(now)
+    if ratio is None or ratio <= 0:
+        return
+    if (1.0 / band) <= ratio <= band:
+        return                      # model honest: nothing to decide
+    min_pts = max(2, int(get_flag('FLAGS_autopilot_min_points', 4)
+                         or 4))
+    per_kind = {}
+    for (kind, _bucket), pts in comms.dispatch_points().items():
+        per_kind.setdefault(kind, []).extend(pts)
+    base = comms_plan.current_model() or {}
+    colls = {k: dict(v)
+             for k, v in (base.get('collectives') or {}).items()
+             if isinstance(v, dict)}
+    refitted = {}
+    for kind in sorted(per_kind):
+        pts = per_kind[kind]
+        if len(pts) < min_pts:
+            continue
+        ent = colls.get(kind)
+        prior = None
+        if ent is not None:
+            try:
+                prior = (float(ent['latency_s']),
+                         float(ent['inv_bw_s_per_byte']))
+            except (KeyError, TypeError, ValueError):
+                prior = None
+        alpha, beta = comms.fit_linear(pts, prior=prior)
+        e = colls.setdefault(kind, {})
+        e['latency_s'] = alpha
+        e['inv_bw_s_per_byte'] = beta
+        e['refit_points'] = len(pts)
+        refitted[kind] = {'latency_s': alpha,
+                          'inv_bw_s_per_byte': beta,
+                          'points': len(pts)}
+    if not refitted:
+        _decide('refit', 'insufficient_points', acted=False,
+                frozen=frozen, now=now, honesty=round(ratio, 4),
+                source=source, min_points=min_pts,
+                slo_firing=slo_firing)
+        return
+    if not act:
+        _decide('refit', 'intent', acted=False, frozen=frozen,
+                now=now, honesty=round(ratio, 4), source=source,
+                kinds=sorted(refitted), slo_firing=slo_firing)
+        return
+    model = {'collectives': colls, 'refit_unix': now,
+             'refit_of': comms_plan._model_path() or None}
+    gen = comms_plan.install_refit(model)
+    persisted = _persist_refit(model)
+    comms.clear_dispatch_points()   # next refit fits POST-drift points
+    with _lock:
+        _state['last_refit_unix'] = now
+        _state['refit_gen'] = gen
+    monitor.add('autopilot/refits')
+    _decide('refit', 'installed', acted=True, now=now,
+            honesty=round(ratio, 4), source=source, gen=gen,
+            persisted=persisted, kinds=refitted,
+            expected_gain='honesty ratio -> 1.0; adopted at next '
+                          're-plan point with one retrace')
+
+
+# -------------------------------------------- loop b: skew / bucketing
+def _bucket_loop(now, act, frozen, slo_firing):
+    skew = None
+    try:
+        from . import timeseries
+        doc = timeseries.window('comms/skew_ratio', points=16, now=now)
+        if doc and doc['derived'].get('mean') is not None:
+            skew = float(doc['derived']['mean'])
+    except Exception:
+        pass
+    if skew is None:
+        skew = monitor.gauge_value('comms/skew_ratio', 0.0)
+    if not skew or skew <= 0:
+        return
+    high = float(get_flag('FLAGS_autopilot_skew_high', 1.5) or 1.5)
+    high = max(high, 1.0 + 1e-6)
+    low = 1.0 + (high - 1.0) * 0.25
+    lo_b = int(get_flag('FLAGS_autopilot_bucket_min_bytes',
+                        256 << 10) or (256 << 10))
+    hi_b = int(get_flag('FLAGS_autopilot_bucket_max_bytes',
+                        32 << 20) or (32 << 20))
+    cur = int(get_flag('FLAGS_comms_bucket_bytes', 4 << 20)
+              or (4 << 20))
+    if skew >= high:
+        new, why = max(lo_b, cur // 2), 'latency_dominated_skew'
+    elif skew <= low:
+        new, why = min(hi_b, cur * 2), 'bandwidth_bound'
+    else:
+        return
+    if new == cur:
+        return
+    interval = float(get_flag('FLAGS_autopilot_interval_s', 2.0)
+                     or 2.0)
+    with _lock:
+        # one move per settle window: halving every tick would slam
+        # the knob to the bound before the new size produces a single
+        # skew sample
+        if now - _state['last_bucket_change'] < 4 * interval:
+            return
+    from . import comms_plan
+    info = {'skew': round(skew, 4), 'why': why,
+            'from_bytes': cur, 'to_bytes': new,
+            'slo_firing': slo_firing}
+    t_cur = comms_plan.predict_seconds('allreduce', cur)
+    t_new = comms_plan.predict_seconds('allreduce', new)
+    if t_cur is not None and t_new is not None:
+        info['priced'] = {'per_bucket_s_from': t_cur,
+                          'per_bucket_s_to': t_new}
+    if not act:
+        _decide('bucket_bytes', {'from': cur, 'to': new},
+                acted=False, frozen=frozen, now=now, **info)
+        return
+    set_flags({'FLAGS_comms_bucket_bytes': new})
+    with _lock:
+        _state['last_bucket_change'] = now
+    _decide('bucket_bytes', {'from': cur, 'to': new}, acted=True,
+            now=now,
+            expected_gain=('smaller fusions bound straggler stalls'
+                           if why == 'latency_dominated_skew' else
+                           'larger fusions amortize launch latency'),
+            **info)
+
+
+# ------------------------------------------------ loop c: serving side
+def _serving_loop(now, act, frozen, slo_firing):
+    try:
+        from . import serving
+        execs = serving.live_executors()
+    except Exception:
+        return
+    if not execs:
+        return
+    min_batches = max(1, int(get_flag(
+        'FLAGS_autopilot_ladder_min_batches', 16) or 16))
+    hits_needed = max(1, int(get_flag(
+        'FLAGS_autopilot_ladder_hits', 8) or 8))
+    close_max = float(get_flag(
+        'FLAGS_autopilot_close_wait_max_s', 0.02) or 0.0)
+    occ_low = float(get_flag(
+        'FLAGS_autopilot_occupancy_low', 0.5) or 0.5)
+    for srv in execs:
+        try:
+            tenants = srv.resident_report()['tenants']
+        except Exception:
+            continue
+        for t in tenants:
+            name = t['tenant']
+            if int(t.get('batches') or 0) < min_batches:
+                continue
+            _adapt_tenant_ladder(srv, t, name, hits_needed, act,
+                                 frozen, slo_firing, now)
+            _adapt_tenant_close_wait(srv, t, name, close_max, occ_low,
+                                     act, frozen, slo_firing, now)
+
+
+def _adapt_tenant_ladder(srv, t, name, hits_needed, act, frozen,
+                         slo_firing, now):
+    ladder = [int(b) for b in (t.get('bucket_ladder') or ())]
+    if not ladder:
+        return
+    hits = {int(k): int(v)
+            for k, v in (t.get('bucket_hits') or {}).items()}
+    misses = {int(k): int(v)
+              for k, v in (t.get('natural_miss_hits') or {}).items()}
+    drop = [b for b in ladder[:-1] if hits.get(b, 0) == 0]
+    add = [b for b in sorted(misses)
+           if misses[b] >= hits_needed and b not in ladder]
+    if not drop and not add:
+        return
+    info = {'tenant': name, 'drop': drop, 'add': add,
+            'bucket_hits': hits, 'natural_miss_hits': misses,
+            'slo_firing': slo_firing,
+            'expected_gain': 'fewer resident shapes; hot shapes stop '
+                             'padding to the next rung'}
+    if not act:
+        _decide('ladder', {'tenant': name, 'drop': drop, 'add': add},
+                acted=False, frozen=frozen, now=now, **info)
+        return
+    new_ladder = srv.adapt_ladder(name, drop=drop, add=add, warm=True)
+    _decide('ladder', {'tenant': name, 'drop': drop, 'add': add},
+            acted=True, now=now, ladder=list(new_ladder), **info)
+
+
+def _adapt_tenant_close_wait(srv, t, name, close_max, occ_low, act,
+                             frozen, slo_firing, now):
+    if close_max <= 0:
+        return
+    rows = float(t.get('rows') or 0)
+    pad = float(t.get('pad_rows') or 0)
+    if rows + pad <= 0:
+        return
+    occ = rows / (rows + pad)
+    cw = t.get('close_wait_s') or 0.0
+    new_cw = None
+    if occ < occ_low:
+        # mostly padding: hold sub-capacity batches open a little
+        # longer (start at a quarter of the cap, double toward it)
+        new_cw = (close_max / 4.0) if not cw \
+            else min(close_max, cw * 2.0)
+        why = 'low_occupancy'
+    elif cw and occ >= min(1.0, occ_low + 0.25):
+        new_cw = 0.0                # recovered: close immediately again
+        why = 'occupancy_recovered'
+    if new_cw is None or abs(new_cw - cw) <= 1e-9:
+        return
+    info = {'tenant': name, 'occupancy': round(occ, 4),
+            'why': why, 'from_s': cw or None, 'to_s': new_cw or None,
+            'slo_firing': slo_firing,
+            'expected_gain': ('fuller batches, less pad waste'
+                              if why == 'low_occupancy' else
+                              'static close-immediately latency')}
+    if not act:
+        _decide('close_wait', {'tenant': name, 'to_s': new_cw or None},
+                acted=False, frozen=frozen, now=now, **info)
+        return
+    srv.set_close_wait(name, new_cw or None)
+    _decide('close_wait', {'tenant': name, 'to_s': new_cw or None},
+            acted=True, now=now, **info)
+
+
+# -------------------------------------------------------------- revert
+def revert(now=None):
+    """One call back to the static configuration: restore
+    FLAGS_comms_bucket_bytes, every tenant's registered ladder (adds
+    pre-warm, so the restored rungs are compiled before admissible)
+    and close-immediately deadline, drop both refit generations
+    (planning re-prices from the on-disk model; one retrace at the
+    next rebuild, exactly as any reverted plan input) and remove the
+    persisted sidecar so a restart cannot resurrect the refit.  Works
+    even when frozen — revert IS the escape hatch."""
+    now = time.time() if now is None else float(now)
+    restored = {}
+    with _lock:
+        static_bb = _state['static_bucket_bytes']
+    if static_bb is not None:
+        set_flags({'FLAGS_comms_bucket_bytes': int(static_bb)})
+        restored['comms_bucket_bytes'] = int(static_bb)
+    from . import comms_plan
+    restored['refit_cleared'] = comms_plan.clear_refit()
+    path = _refit_path()
+    if path and os.path.exists(path):
+        try:
+            os.remove(path)
+            restored['refit_file_removed'] = path
+        except OSError:
+            monitor.add('autopilot/persist_errors')
+    try:
+        from . import serving
+        execs = serving.live_executors()
+    except Exception:
+        execs = []
+    ladders = 0
+    for srv in execs:
+        try:
+            tenants = srv.resident_report()['tenants']
+        except Exception:
+            continue
+        for t in tenants:
+            name = t['tenant']
+            base = [int(b) for b in (t.get('base_ladder') or ())]
+            cur = [int(b) for b in (t.get('bucket_ladder') or ())]
+            if base and set(cur) != set(base):
+                srv.adapt_ladder(
+                    name,
+                    drop=[b for b in cur if b not in base],
+                    add=[b for b in base if b not in cur], warm=True)
+                ladders += 1
+            if t.get('close_wait_s'):
+                srv.set_close_wait(name, None)
+    if ladders:
+        restored['ladders_restored'] = ladders
+    monitor.add('autopilot/reverts')
+    _decide('revert', restored, acted=True, now=now)
+    return restored
+
+
+# ------------------------------------------------------------- surface
+def report():
+    """The /statusz 'autopilot' section: engagement, freeze state, the
+    refit slot, static-vs-current knobs and the newest decisions —
+    everything JSON-able."""
+    with _lock:
+        st = dict(_state)
+        decs = list(_decisions)[-50:]
+        total = _seq[0]
+    from . import comms_plan
+    return {
+        'enabled': enabled(),
+        'engaged': st['engaged'],
+        'ticks': st['ticks'],
+        'last_tick_unix': st['last_tick'] or None,
+        'slo_firing': _slo_firing(),
+        'refit': comms_plan.refit_state(),
+        'refit_path': _refit_path() or None,
+        'last_refit_unix': st['last_refit_unix'],
+        'static': {'comms_bucket_bytes': st['static_bucket_bytes']},
+        'current': {'comms_bucket_bytes':
+                    get_flag('FLAGS_comms_bucket_bytes', 4 << 20)},
+        'decisions_total': total,
+        'decisions': decs,
+    }

@@ -55,9 +55,11 @@ from . import monitor
 
 __all__ = [
     'collecting', 'record_trace', 'records_for', 'wire_bytes',
-    'size_bucket', 'account_dispatch', 'bw_samples', 'record_memory',
-    'memory_report', 'fit_linear', 'model_predict', 'reset',
-    'BW_BUCKETS', 'MEM_BUCKETS', 'RATIO_BUCKETS',
+    'size_bucket', 'account_dispatch', 'bw_samples',
+    'dispatch_points', 'clear_dispatch_points',
+    'record_memory', 'memory_report', 'fit_linear',
+    'model_predict', 'reset', 'BW_BUCKETS', 'MEM_BUCKETS',
+    'RATIO_BUCKETS',
 ]
 
 # achieved algorithmic bandwidth, GB/s: CPU-mesh psums sit well under
@@ -90,6 +92,14 @@ _BY_KEY_CAP = 512
 # medians from here); bounded per series
 _BW_SAMPLES = {}
 _BW_SAMPLES_CAP = 256
+# rolling (wire_bytes, wall_s) measured dispatch points per (kind,
+# bucket) — the autopilot's refit input: a bandwidth alone cannot
+# recover the latency term alpha, so the raw fit points are retained
+# alongside the GB/s samples.  For segments where several series
+# share one wall, each point's wall is ATTRIBUTED by wire share so a
+# refit over them reprices the segment total honestly.
+_DISPATCH_POINTS = {}
+_DISPATCH_POINTS_CAP = 256
 # label -> memory row; bounded like _BY_KEY
 _MEMORY = {}
 _MEMORY_CAP = 256
@@ -104,6 +114,7 @@ def reset():
     with _lock:
         _BY_KEY.clear()
         _BW_SAMPLES.clear()
+        _DISPATCH_POINTS.clear()
         _MEMORY.clear()
         _SUMMARY.clear()
 
@@ -279,6 +290,7 @@ def account_dispatch(records, wall_s, compile_run=False):
     plan_arms = {}
     plan_wire = plan_dense = plan_pred = 0.0
     plan_fused = plan_unpriced = 0
+    repricer = None
     for r in records:
         total_wire += r['wire_bytes']
         payload += r['payload_bytes']
@@ -291,6 +303,19 @@ def account_dispatch(records, wall_s, compile_run=False):
             plan_wire += r['wire_bytes']
             plan_dense += r.get('dense_wire_bytes', r['wire_bytes'])
             pred = r.get('predicted_s')
+            if repricer is None:
+                # the record froze predicted_s at TRACE time; when the
+                # autopilot installed an in-memory refit, reprice it
+                # live so the honesty ratio tracks the CURRENT model
+                # without retracing.  One module check per segment;
+                # False short-circuits the remaining records.
+                from . import comms_plan
+                repricer = comms_plan.reprice_record \
+                    if comms_plan.refit_active() else False
+            if repricer:
+                live = repricer(r)
+                if live is not None:
+                    pred = live
             if pred is None:
                 plan_unpriced += 1
             else:
@@ -333,11 +358,19 @@ def account_dispatch(records, wall_s, compile_run=False):
         bw_gbps = wire / wall_s / 1e9
         monitor.observe('comms/bw_gbps/%s/%s' % (kind, bucket),
                         bw_gbps, BW_BUCKETS)
+        # refit point: this series' wire over its wire-share of the
+        # wall, so summing repriced predictions over a multi-series
+        # segment reproduces the segment wall instead of K times it
+        attributed_wall = wall_s * (wire / total_wire)
         with _lock:
             samples = _BW_SAMPLES.setdefault((kind, bucket), [])
             if len(samples) >= _BW_SAMPLES_CAP:
                 del samples[:_BW_SAMPLES_CAP // 2]
             samples.append(bw_gbps)
+            pts = _DISPATCH_POINTS.setdefault((kind, bucket), [])
+            if len(pts) >= _DISPATCH_POINTS_CAP:
+                del pts[:_DISPATCH_POINTS_CAP // 2]
+            pts.append((wire, attributed_wall))
 
 
 def bw_samples():
@@ -345,6 +378,31 @@ def bw_samples():
     bench/calibrate (the monitor histograms keep the scrape form)."""
     with _lock:
         return {k: list(v) for k, v in _BW_SAMPLES.items()}
+
+
+def dispatch_points(kind=None):
+    """{(kind, bucket): [(wire_bytes, wall_s), ...]} measured dispatch
+    fit points — the autopilot refit's input (fit_linear needs the
+    raw (bytes, seconds) pairs, not the bandwidths).  Walls are the
+    wire-share-attributed segment walls account_dispatch recorded;
+    `kind` filters to one collective's points as a flat list."""
+    with _lock:
+        if kind is not None:
+            out = []
+            for (k, _bucket), pts in _DISPATCH_POINTS.items():
+                if k == kind:
+                    out.extend(pts)
+            return out
+        return {k: list(v) for k, v in _DISPATCH_POINTS.items()}
+
+
+def clear_dispatch_points():
+    """Consume the refit fit-point pool (the autopilot calls this
+    after installing a refit, so the NEXT refit fits only points
+    measured after this one — mixing pre- and post-drift walls would
+    fit an in-between model)."""
+    with _lock:
+        _DISPATCH_POINTS.clear()
 
 
 # ------------------------------------------------------ memory accounting
@@ -394,7 +452,7 @@ def memory_report():
 
 
 # ------------------------------------------------------------ cost model
-def fit_linear(points):
+def fit_linear(points, prior=None):
     """Weighted least-squares fit of T(b) = alpha + beta*b over
     (bytes, seconds) points — the latency + inverse-bandwidth
     collective cost model.  Weights are 1/t^2, i.e. the fit minimizes
@@ -403,8 +461,20 @@ def fit_linear(points):
     more than the 2x envelope the planner needs.  alpha is clamped
     non-negative (a negative launch latency is noise), beta to a tiny
     positive floor so predicted bandwidth stays finite.  Returns
-    (alpha_s, beta_s_per_byte)."""
+    (alpha_s, beta_s_per_byte).
+
+    `prior` is the autopilot-refit contract: a (alpha, beta) pair
+    returned VERBATIM when the points cannot support a two-parameter
+    fit — empty, a single size bucket (every wire size identical: the
+    intercept/slope split is unidentifiable), or a zero/negative
+    normal-equation determinant — counted ``autopilot/refit_degenerate``
+    instead of extrapolating a singular system into the planner.
+    Without a prior (the calibrator's sweeps) the legacy single-point
+    / degenerate fallbacks apply unchanged."""
     pts = [(float(b), float(t)) for b, t in points if t > 0]
+    if prior is not None and len({b for b, _t in pts}) < 2:
+        monitor.add('autopilot/refit_degenerate')
+        return float(prior[0]), float(prior[1])
     if not pts:
         return 0.0, 1e-12
     if len(pts) == 1:
@@ -420,6 +490,9 @@ def fit_linear(points):
         swbt += w * b * t
     denom = sw * swbb - swb * swb
     if denom <= 0:
+        if prior is not None:
+            monitor.add('autopilot/refit_degenerate')
+            return float(prior[0]), float(prior[1])
         return 0.0, max(swt / max(swb, 1e-30), 1e-15)
     beta = (sw * swbt - swb * swt) / denom
     alpha = (swt - beta * swb) / sw

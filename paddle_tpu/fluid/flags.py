@@ -320,6 +320,51 @@ _DEFAULTS = {
     # keeps the one-dump-per-transition behavior, a positive value
     # bounds a transition storm to one dump per interval
     'FLAGS_supervisor_dump_interval_s': 0.0,
+    # closed-loop autopilot (fluid/autopilot.py): the act/freeze
+    # switch for an ENGAGED adaptation plane — 0 keeps every loop
+    # watching and LOGGING intents (autopilot/frozen_intents,
+    # acted=False in the decision log) while executing nothing: no
+    # refit installs/persists, no flag or ladder changes — every knob
+    # stays bit-identical to static behavior.  The plane only exists
+    # once autopilot.engage() ran; it rides the FLAGS_timeseries
+    # sampling cadence (no thread of its own).
+    'FLAGS_autopilot': True,
+    # minimum seconds between adaptation passes (each pass reads the
+    # windowed series once); 0 = every timeseries sample
+    'FLAGS_autopilot_interval_s': 2.0,
+    # comms-refit honesty guard: only recalibrate when the windowed
+    # comms/plan_pred_over_measured median drifts outside
+    # [1/band, band] — an honest model is left alone
+    'FLAGS_autopilot_honesty_band': 1.5,
+    # minimum measured (wire, wall) dispatch points per collective
+    # before a refit is attempted (fewer cannot support the 2-param
+    # fit; see comms.fit_linear's prior contract)
+    'FLAGS_autopilot_min_points': 4,
+    # where the refit model persists (atomic tmp+rename) so a restart
+    # re-engages onto the recalibrated coefficients; empty = the
+    # comms model path + '.refit.json'.  Deliberately NOT
+    # comms_model.json itself: comms_plan.digest() keys on that
+    # file's identity, and rewriting it in place would move segment
+    # fingerprints outside the adopt_refit() re-plan points.
+    'FLAGS_autopilot_refit_path': '',
+    # skew-aware bucket adaptation: windowed comms/skew_ratio mean
+    # above this is latency-dominated straggling — shrink the fused
+    # buckets; below half of it with honest pricing, widen back
+    'FLAGS_autopilot_skew_high': 1.5,
+    # bounds the bucket loop may move FLAGS_comms_bucket_bytes within
+    'FLAGS_autopilot_bucket_min_bytes': 256 << 10,
+    'FLAGS_autopilot_bucket_max_bytes': 32 << 20,
+    # serving ladder adaptation: drop a never-hit bucket only after
+    # the tenant served this many batches; pre-warm a natural (pow2)
+    # row bucket missing from the ladder once it padded up this often
+    'FLAGS_autopilot_ladder_min_batches': 16,
+    'FLAGS_autopilot_ladder_hits': 8,
+    # serving batch-close deadline bounds (seconds): windowed
+    # occupancy below the low-water mark widens a tenant's close wait
+    # toward the max (fuller batches), admit-to-done p99 pressure
+    # against the declared SLO target shrinks it back toward zero
+    'FLAGS_autopilot_close_wait_max_s': 0.02,
+    'FLAGS_autopilot_occupancy_low': 0.5,
     # Pallas kernel library (ops/pallas/): every fused kernel sits
     # behind the auto-dispatch + dense-fallback contract (see
     # ops/pallas/common.py) — off-TPU or when a gate fails, the dense

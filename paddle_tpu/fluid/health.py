@@ -319,6 +319,17 @@ def statusz():
             slo_section = rep
     except Exception:
         pass
+    # autopilot (fluid.autopilot): engagement, refit slot and the
+    # decision trail — rendered once the plane has engaged or decided
+    # anything (a plain static trainer pays nothing)
+    autopilot_section = None
+    try:
+        from . import autopilot
+        rep = autopilot.report()
+        if rep.get('engaged') or rep.get('decisions_total'):
+            autopilot_section = rep
+    except Exception:
+        pass
     # Pallas kernel library (ops/pallas/common.py): per-kernel fused
     # vs dense dispatch tallies, the LAST decision with its reason
     # (flag_off / off_tpu / below_floor / ...) and the documented
@@ -354,6 +365,7 @@ def statusz():
         'supervisor': supervisor_section,
         'timeseries': timeseries_section,
         'slo': slo_section,
+        'autopilot': autopilot_section,
         'pallas': pallas_section,
         'job': job_section,
         'flags': _all_flags(),

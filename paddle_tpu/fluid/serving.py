@@ -216,7 +216,9 @@ class _Tenant(object):
     __slots__ = ('name', 'program', 'scope', 'feed_names', 'fetch_names',
                  'feed_specs', 'mask_specs', 'ladder', 'fingerprint',
                  'pending', 'warmed', 'requests', 'batches', 'rows',
-                 'retraces', 'cache_hit_batches', 'pad_rows', 'errors')
+                 'retraces', 'cache_hit_batches', 'pad_rows', 'errors',
+                 'base_ladder', 'bucket_hits', 'natural_miss_hits',
+                 'close_wait_s')
 
     def __init__(self, name, program, scope, feed_names, fetch_names,
                  feed_specs, mask_specs, ladder, fingerprint):
@@ -238,12 +240,23 @@ class _Tenant(object):
         self.cache_hit_batches = 0
         self.pad_rows = 0
         self.errors = 0
+        # ladder-adaptation inputs/state (fluid.autopilot): the ladder
+        # as registered (the one-call revert target), per-ladder-bucket
+        # dispatch hits, hits on the NATURAL pow2 bucket of a batch's
+        # rows when the ladder lacked it (the pre-warm signal), and
+        # the adapted batch-close deadline (None = close immediately,
+        # the static behavior)
+        self.base_ladder = tuple(ladder)
+        self.bucket_hits = {}
+        self.natural_miss_hits = {}
+        self.close_wait_s = None
 
     def report(self):
         return {
             'tenant': self.name,
             'fingerprint': self.fingerprint,
             'bucket_ladder': list(self.ladder),
+            'base_ladder': list(self.base_ladder),
             'warmed': self.warmed,
             'requests_served': self.requests,
             'batches': self.batches,
@@ -253,6 +266,12 @@ class _Tenant(object):
             'pad_rows': self.pad_rows,
             'errors': self.errors,
             'queue_depth': len(self.pending),
+            'bucket_hits': {str(k): v
+                            for k, v in sorted(self.bucket_hits.items())},
+            'natural_miss_hits': {
+                str(k): v
+                for k, v in sorted(self.natural_miss_hits.items())},
+            'close_wait_s': self.close_wait_s,
         }
 
 
@@ -517,6 +536,22 @@ class ServingExecutor(object):
                 target=self._loop, daemon=True, name='pt_serving')
             self._thread.start()
 
+    def _close_hold_s(self, t):
+        """Seconds tenant `t`'s batch-close deadline still holds its
+        admission window open (caller holds ``_cond``): with an
+        adapted ``close_wait_s`` a sub-capacity batch keeps queueing
+        while its oldest request is younger than the wait.  0 closes
+        the window now — the static (no deadline) behavior, a batch
+        already at bucket capacity, or an aged-out oldest request."""
+        wait = t.close_wait_s
+        if not wait or not t.pending:
+            return 0.0
+        rows = sum(req.rows for req in t.pending)
+        if rows >= t.ladder[-1]:
+            return 0.0
+        remaining = wait - (_time.perf_counter() - t.pending[0].t_admit)
+        return remaining if remaining > 0 else 0.0
+
     def _take_batch(self, wait_s):
         """Coalesce the next batch: pick the next tenant (round-robin)
         with pending work and drain its queue up to the largest
@@ -526,10 +561,20 @@ class ServingExecutor(object):
                 if wait_s:
                     self._cond.wait(wait_s)
             n = len(self._rr)
+            defer_wait = None
             for i in range(n):
                 name = self._rr[(self._rr_next + i) % n]
                 t = self._tenants[name]
                 if not t.pending:
+                    continue
+                hold = self._close_hold_s(t)
+                if hold > 0 and not self._stopping:
+                    # adapted batch-close deadline: the window stays
+                    # open for more rows while the oldest request is
+                    # younger than the tenant's close wait — bounded
+                    # latency traded for occupancy
+                    defer_wait = hold if defer_wait is None \
+                        else min(defer_wait, hold)
                     continue
                 self._rr_next = (self._rr_next + i + 1) % n
                 reqs = []
@@ -564,6 +609,13 @@ class ServingExecutor(object):
                 if not reqs:
                     continue   # whole window was cancelled
                 return _Batch(t, reqs, rows)
+            if defer_wait is not None:
+                # every pending tenant is inside its close window:
+                # sleep out the shortest remaining hold (bounded, and
+                # a submit() notify wakes the wait early) instead of
+                # spinning on the lock
+                monitor.add('serving/close_wait_holds')
+                self._cond.wait(min(defer_wait, 0.005))
         return None
 
     def _dispatch(self, batch):
@@ -591,6 +643,26 @@ class ServingExecutor(object):
             if waste:
                 monitor.add('serving/bucket_pad_waste_bytes', waste)
             t.pad_rows += bucket - batch.rows
+            # ladder-adaptation signals: which rung served, and — when
+            # the rows' NATURAL pow2 bucket is missing from the ladder
+            # — the rung traffic keeps padding up past (the autopilot's
+            # pre-warm candidate)
+            t.bucket_hits[bucket] = t.bucket_hits.get(bucket, 0) + 1
+            nat = 1 << max(0, int(batch.rows - 1).bit_length())
+            if nat < bucket:
+                t.natural_miss_hits[nat] = \
+                    t.natural_miss_hits.get(nat, 0) + 1
+            # server-wide pad-waste ratio, derived from the same
+            # per-tenant pad/row tallies the occupancy counters feed
+            # (t.rows lands below, so this batch's live rows count in)
+            pad_total = rows_total = 0
+            for tt in list(self._tenants.values()):
+                pad_total += tt.pad_rows
+                rows_total += tt.rows
+            denom = pad_total + rows_total + batch.rows
+            if denom > 0:
+                monitor.set_gauge('serving/pad_waste_ratio',
+                                  pad_total / float(denom))
             # ONE async H2D for the whole padded batch: the DMA (and
             # everything above: concat, pad) overlaps the in-flight
             # batch's compute
@@ -677,6 +749,57 @@ class ServingExecutor(object):
                             _deliver(req.future, exc=e)
                 inflight = None
 
+    # -- ladder / deadline adaptation (fluid.autopilot) ----------------
+    def adapt_ladder(self, tenant, drop=(), add=(), warm=True):
+        """Apply one bucket-ladder adaptation to a resident tenant:
+        `drop` rungs leave the ladder (traffic that would have landed
+        there pads up to the next rung; the LARGEST rung can never
+        drop — it bounds admissible request sizes), `add` rungs join
+        it, pre-compiled through ``Executor.warmup`` + the persistent
+        compile cache BEFORE they become admissible so an adapted
+        ladder keeps the zero-serving-path-retrace contract.  Counted
+        ``serving/bucket_dropped`` / ``serving/bucket_prewarmed``.
+        Returns the new ladder."""
+        t = self._tenants[tenant]
+        drop = {int(b) for b in drop}
+        add = sorted({int(b) for b in add})
+        ladder = [b for b in t.ladder
+                  if b not in drop or b == t.ladder[-1]]
+        dropped = len(t.ladder) - len(ladder)
+        prewarmed = 0
+        for b in add:
+            if b in ladder or b <= 0 or b > t.ladder[-1]:
+                continue
+            if warm:
+                self._exe.warmup(
+                    t.program,
+                    feed_shapes=self._bucket_feed_shapes(t, b),
+                    fetch_list=t.fetch_names, scope=t.scope).wait()
+            ladder.append(b)
+            prewarmed += 1
+        ladder.sort()
+        with self._cond:
+            t.ladder = tuple(ladder)
+            t.bucket_hits = {b: n for b, n in t.bucket_hits.items()
+                             if b in t.ladder}
+            t.natural_miss_hits = {
+                b: n for b, n in t.natural_miss_hits.items()
+                if b not in t.ladder}
+        if dropped:
+            monitor.add('serving/bucket_dropped', float(dropped))
+        if prewarmed:
+            monitor.add('serving/bucket_prewarmed', float(prewarmed))
+        return t.ladder
+
+    def set_close_wait(self, tenant, wait_s):
+        """Set (or clear, with None/0) a tenant's batch-close
+        deadline: how long a sub-capacity batch may wait for more
+        rows before dispatching.  None/0 restores the static
+        close-immediately behavior."""
+        t = self._tenants[tenant]
+        t.close_wait_s = float(wait_s) if wait_s else None
+        return t.close_wait_s
+
     # -- lifecycle / status --------------------------------------------
     def stop(self, drain=True):
         """Stop the dispatcher.  `drain=True` serves queued requests
@@ -747,6 +870,12 @@ def resident_report():
     /statusz section body)."""
     return [s.resident_report() for s in list(_live)
             if not s._closed]
+
+
+def live_executors():
+    """Live (non-closed) ServingExecutors — the autopilot's serving
+    adaptation walks these the way memviz walks tenant_scopes()."""
+    return [s for s in list(_live) if not s._closed]
 
 
 def tenant_scopes():

@@ -55,7 +55,8 @@ from .flags import get_flag
 
 __all__ = [
     'declare', 'parse', 'clear', 'reset', 'objectives',
-    'maybe_evaluate', 'evaluate_all', 'alertz', 'report',
+    'firing_count', 'maybe_evaluate', 'evaluate_all', 'alertz',
+    'report',
 ]
 
 _lock = threading.Lock()
@@ -164,6 +165,15 @@ def reset():
 def objectives():
     with _lock:
         return [o.doc() for o in _objectives.values()]
+
+
+def firing_count():
+    """Objectives currently firing — state only, no evaluation.  The
+    autopilot's interlock: it freezes adaptations mid-incident rather
+    than tune knobs while an SLO burns."""
+    with _lock:
+        return sum(1 for o in _objectives.values()
+                   if o.state == 'firing')
 
 
 def _configure_from_flag():

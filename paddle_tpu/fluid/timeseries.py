@@ -125,6 +125,13 @@ def sample(step=None, now=None):
         slo.maybe_evaluate(now=now)
     except Exception:
         monitor.add('slo/eval_errors')
+    # the autopilot's adaptation loops ride the same cadence (one dict
+    # read when not engaged, interval-throttled when engaged)
+    try:
+        from . import autopilot
+        autopilot.maybe_tick(now=now)
+    except Exception:
+        monitor.add('autopilot/tick_errors')
 
 
 def job_sample(rank, state, now=None):

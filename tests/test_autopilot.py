@@ -1,0 +1,370 @@
+"""fluid.autopilot — closed-loop recalibration and knob tuning.
+
+The acceptance contract: a drifted fabric (measured dispatch walls
+far from the one-shot model's predictions) triggers exactly one refit
+whose repriced predictions converge back onto the measured walls — an
+honest model triggers nothing (the honesty-band guard); the refit is
+pending-vs-adopted generation-split so the planner digest moves only
+at explicit re-plan points (zero retrace churn post-warmup) and is
+coefficient-content-addressed (a restart onto the same refit never
+retraces); degenerate fit inputs return the prior with a count, never
+a singular-matrix extrapolation; the serving loop drops never-hit
+ladder rungs and pre-warms hot natural shapes BEFORE they are
+admissible (the serving path stays zero-retrace) and adapts
+batch-close deadlines from occupancy; freeze mode
+(``FLAGS_autopilot=0``) logs intents acted=False and leaves every
+knob bit-identical; the decision log is bounded and the whole
+/statusz section JSON-serializable; and ``revert()`` is one call back
+to the static configuration."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import (autopilot, comms, comms_plan, layers,
+                              monitor, serving, slo, timeseries)
+
+# the synthetic "true fabric": T(b) = ALPHA + BETA * b
+ALPHA, BETA = 2e-4, 2e-9
+SIZES = (1 << 20, 4 << 20, 16 << 20)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fluid.set_flags({'FLAGS_autopilot': True,
+                     'FLAGS_autopilot_interval_s': 2.0,
+                     'FLAGS_autopilot_honesty_band': 1.5,
+                     'FLAGS_autopilot_min_points': 4,
+                     'FLAGS_autopilot_refit_path': '',
+                     'FLAGS_autopilot_skew_high': 1.5,
+                     'FLAGS_autopilot_ladder_min_batches': 16,
+                     'FLAGS_autopilot_ladder_hits': 8,
+                     'FLAGS_autopilot_close_wait_max_s': 0.02,
+                     'FLAGS_autopilot_occupancy_low': 0.5,
+                     'FLAGS_comms_model_path': '',
+                     'FLAGS_comms_bucket_bytes': 4 << 20,
+                     'FLAGS_timeseries': False})
+    autopilot.reset()
+    comms_plan.reset()
+    comms.reset()
+    timeseries.reset()
+    slo.reset()
+    monitor.reset()
+
+
+def _write_model(path, alpha, beta):
+    with open(str(path), 'w') as f:
+        json.dump({'collectives': {
+            'allreduce': {'latency_s': alpha,
+                          'inv_bw_s_per_byte': beta}}}, f)
+    fluid.set_flags({'FLAGS_comms_model_path': str(path)})
+
+
+def _drive_dispatch(rounds=2, honest=False):
+    """Synthetic planned-allreduce traffic: predicted_s frozen from
+    the CURRENT model (what a trace would freeze), measured wall from
+    the true fabric (or from the prediction itself when honest)."""
+    for _ in range(rounds):
+        for size in SIZES:
+            wall = ALPHA + BETA * size
+            pred = comms_plan.predict_seconds('allreduce', size)
+            rec = {'kind': 'allreduce', 'payload_bytes': float(size),
+                   'wire_bytes': float(size), 'dtype': 'float32',
+                   'axis': 'dp', 'participants': 8,
+                   'bucket': comms.size_bucket(size), 'arm': 'dense',
+                   'dense_wire_bytes': float(size),
+                   'predicted_s': float(pred)}
+            comms.account_dispatch([rec], pred if honest else wall)
+
+
+class TestRefitLoop:
+    def test_drift_triggers_refit_that_reconverges(self, tmp_path):
+        # stale one-shot model predicts a fabric ~100x faster than
+        # the walls actually measured
+        _write_model(tmp_path / 'm.json', ALPHA / 100, BETA / 100)
+        _drive_dispatch()
+        assert autopilot.engage()
+        autopilot.tick(now=1000.0)
+
+        st = comms_plan.refit_state()
+        assert st['pending'] and not st['adopted']
+        assert monitor.counter_value('autopilot/refits') == 1
+        recs = [d for d in autopilot.decisions() if d['kind'] == 'refit']
+        assert recs and recs[-1]['choice'] == 'installed'
+        assert recs[-1]['acted'] and not recs[-1]['frozen']
+        assert 'allreduce' in recs[-1]['info']['kinds']
+        # atomically persisted to the sidecar, NEVER the model itself
+        sidecar = str(tmp_path / 'm.json.refit.json')
+        assert os.path.exists(sidecar)
+        with open(str(tmp_path / 'm.json')) as f:
+            stale = json.load(f)['collectives']['allreduce']
+        assert stale['latency_s'] == ALPHA / 100
+
+        # repriced predictions reproduce the measured walls: honesty
+        # ratio back inside a few % of 1.0, with no retrace
+        for size in SIZES:
+            rec = {'kind': 'allreduce', 'wire_bytes': float(size),
+                   'payload_bytes': float(size), 'participants': 8,
+                   'arm': 'dense'}
+            live = comms_plan.reprice_record(rec)
+            wall = ALPHA + BETA * size
+            assert live == pytest.approx(wall, rel=0.05)
+
+    def test_honest_model_never_refits(self, tmp_path):
+        _write_model(tmp_path / 'm.json', ALPHA, BETA)
+        _drive_dispatch(honest=True)
+        autopilot.engage()
+        autopilot.tick(now=1000.0)
+        assert not comms_plan.refit_state()['pending']
+        assert monitor.counter_value('autopilot/refits') == 0
+        assert not [d for d in autopilot.decisions()
+                    if d['kind'] == 'refit']
+
+    def test_persisted_refit_survives_restart(self, tmp_path):
+        _write_model(tmp_path / 'm.json', ALPHA / 100, BETA / 100)
+        _drive_dispatch()
+        autopilot.engage()
+        autopilot.tick(now=1000.0)
+        adopted_digest_before = None
+        comms_plan.adopt_refit()
+        adopted_digest_before = comms_plan.refit_state()['adopted_digest']
+        # "restart": drop the in-memory plane, re-engage from disk
+        autopilot.reset()
+        comms_plan.reset()
+        autopilot.engage()
+        st = comms_plan.refit_state()
+        assert st['adopted']
+        # coefficient-content-addressed: the same persisted refit
+        # yields the same digest — a restart never retraces onto it
+        assert st['adopted_digest'] == adopted_digest_before
+
+    def test_frozen_mode_logs_intent_and_touches_nothing(self, tmp_path):
+        _write_model(tmp_path / 'm.json', ALPHA / 100, BETA / 100)
+        _drive_dispatch()
+        fluid.set_flags({'FLAGS_autopilot': False})
+        monitor.set_gauge('comms/skew_ratio', 4.0)
+        autopilot.engage()
+        before = fluid.get_flags(['FLAGS_comms_bucket_bytes'])
+        autopilot.tick(now=1e9)
+        recs = [d for d in autopilot.decisions()
+                if d['kind'] in ('refit', 'bucket_bytes')]
+        assert recs
+        assert all(not d['acted'] and d['frozen'] for d in recs)
+        assert not comms_plan.refit_state()['pending']
+        assert not os.path.exists(str(tmp_path / 'm.json.refit.json'))
+        assert fluid.get_flags(['FLAGS_comms_bucket_bytes']) == before
+        assert monitor.counter_value('autopilot/frozen_intents') >= 2
+
+    def test_slo_firing_freezes_adaptation(self, tmp_path):
+        _write_model(tmp_path / 'm.json', ALPHA / 100, BETA / 100)
+        _drive_dispatch()
+        autopilot.engage()
+        name = slo.declare('comms/bytes_on_wire > 1e30')
+        obj = [o for o in slo._objectives.values()
+               if o.name == name][0]
+        obj.state = 'firing'
+        autopilot.tick(now=1000.0)
+        assert not comms_plan.refit_state()['pending']
+        assert monitor.counter_value('autopilot/slo_frozen') == 1
+        recs = [d for d in autopilot.decisions() if d['kind'] == 'refit']
+        assert recs and not recs[-1]['acted']
+
+
+class TestDigestChurn:
+    def test_refit_moves_digest_only_at_adoption(self, tmp_path):
+        _write_model(tmp_path / 'm.json', ALPHA, BETA)
+        d0 = comms_plan.digest()
+        model = {'collectives': {'allreduce': {
+            'latency_s': ALPHA * 2, 'inv_bw_s_per_byte': BETA * 2}}}
+        comms_plan.install_refit(model)
+        # pending refit reprices telemetry but NEVER the digest: an
+        # installed-but-unadopted refit cannot retrace anything
+        assert comms_plan.digest() == d0
+        assert comms_plan.adopt_refit() is not None
+        d1 = comms_plan.digest()
+        assert d1 != d0
+        # adopting again is a no-op; re-adopting identical
+        # coefficients is digest-stable (content-addressed)
+        assert comms_plan.adopt_refit() is None
+        assert comms_plan.digest() == d1
+        comms_plan.install_refit(json.loads(json.dumps(model)))
+        comms_plan.adopt_refit()
+        assert comms_plan.digest() == d1
+        # one-call revert: back to the static digest
+        assert comms_plan.clear_refit()
+        assert comms_plan.digest() == d0
+
+    def test_adopted_refit_prices_planning_without_disk(self, tmp_path):
+        _write_model(tmp_path / 'm.json', ALPHA, BETA)
+        comms_plan.install_refit({'collectives': {'allreduce': {
+            'latency_s': 0.5, 'inv_bw_s_per_byte': 0.0}}})
+        # pending: planning still prices from the on-disk model
+        assert comms_plan.predict_seconds('allreduce', 1 << 20) == \
+            pytest.approx(ALPHA + BETA * (1 << 20))
+        comms_plan.adopt_refit()
+        os.remove(str(tmp_path / 'm.json'))   # no disk read per call
+        assert comms_plan.predict_seconds('allreduce', 1 << 20) == 0.5
+
+
+class TestFitLinear:
+    def test_degenerate_single_bucket_returns_prior(self):
+        prior = (1e-4, 3e-9)
+        n0 = monitor.counter_value('autopilot/refit_degenerate')
+        pts = [(1024.0, 5e-4)] * 6     # one wire size: unidentifiable
+        assert comms.fit_linear(pts, prior=prior) == prior
+        assert comms.fit_linear([], prior=prior) == prior
+        assert monitor.counter_value('autopilot/refit_degenerate') \
+            == n0 + 2
+
+    def test_legacy_no_prior_paths_unchanged(self):
+        assert comms.fit_linear([]) == (0.0, 1e-12)
+        a, b = comms.fit_linear([(1e6, 1e-3)])
+        assert a == 0.0 and b == pytest.approx(1e-9)
+        a, b = comms.fit_linear(
+            [(s, ALPHA + BETA * s) for s in SIZES])
+        assert a == pytest.approx(ALPHA, rel=1e-6)
+        assert b == pytest.approx(BETA, rel=1e-6)
+
+
+class TestBucketLoop:
+    def test_high_skew_shrinks_low_skew_widens(self):
+        autopilot.engage()
+        monitor.set_gauge('comms/skew_ratio', 3.0)
+        autopilot.tick(now=1e9)
+        assert fluid.get_flags(['FLAGS_comms_bucket_bytes']) == \
+            {'FLAGS_comms_bucket_bytes': 2 << 20}
+        rec = [d for d in autopilot.decisions()
+               if d['kind'] == 'bucket_bytes'][-1]
+        assert rec['acted'] and \
+            rec['info']['why'] == 'latency_dominated_skew'
+        # settle window: an immediate second tick must NOT move again
+        monitor.set_gauge('comms/skew_ratio', 3.0)
+        autopilot.tick(now=1e9 + 2.0)
+        assert fluid.get_flags(['FLAGS_comms_bucket_bytes']) == \
+            {'FLAGS_comms_bucket_bytes': 2 << 20}
+        # bandwidth-bound skew widens again after the settle window
+        monitor.set_gauge('comms/skew_ratio', 1.0)
+        autopilot.tick(now=1e9 + 100.0)
+        assert fluid.get_flags(['FLAGS_comms_bucket_bytes']) == \
+            {'FLAGS_comms_bucket_bytes': 4 << 20}
+        # revert restores the engage-time static value
+        autopilot.revert()
+        assert fluid.get_flags(['FLAGS_comms_bucket_bytes']) == \
+            {'FLAGS_comms_bucket_bytes': 4 << 20}
+
+
+def _build_mlp(width=16, seed=5, in_w=8):
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main_p, startup):
+        x = layers.data('x', shape=[in_w], dtype='float32')
+        h = layers.fc(x, width, act='relu')
+        y = layers.fc(h, 6, act='softmax')
+    return main_p, startup, y
+
+
+class TestServingLoop:
+    def test_ladder_drop_prewarm_close_wait_and_revert(self):
+        fluid.set_flags({'FLAGS_autopilot_ladder_min_batches': 3,
+                         'FLAGS_autopilot_ladder_hits': 3})
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        main_p, startup, y = _build_mlp()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        srv = serving.ServingExecutor(max_batch=8, executor=exe)
+        # rung 1 will never be hit; rows=2 pads to 8 (natural bucket 2)
+        srv.add_program('t', main_p, ['x'], [y], scope=scope,
+                        bucket_ladder=[1, 8])
+        try:
+            srv.warmup(wait=True)
+            rng = np.random.RandomState(0)
+            xs = [rng.randn(2, 8).astype('float32') for _ in range(4)]
+            outs = [np.asarray(srv.submit('t', {'x': xv}).result(120)[0])
+                    for xv in xs]
+            rep = srv.resident_report()['tenants'][0]
+            assert rep['bucket_hits'] == {'8': 4}
+            assert rep['natural_miss_hits'] == {'2': 4}
+
+            autopilot.engage()
+            retraces0 = rep['retraces']
+            autopilot.tick(now=1e9)
+            rep = srv.resident_report()['tenants'][0]
+            # never-hit rung 1 dropped, hot natural shape 2 joined
+            # pre-warmed (largest rung 8 is not droppable)
+            assert rep['bucket_ladder'] == [2, 8]
+            assert monitor.counter_value('serving/bucket_dropped') == 1
+            assert monitor.counter_value('serving/bucket_prewarmed') == 1
+            # occupancy 2/8 < 0.5 -> a batch-close deadline appears
+            assert rep['close_wait_s'] == pytest.approx(0.02 / 4)
+            kinds = {d['kind'] for d in autopilot.decisions()}
+            assert {'ladder', 'close_wait'} <= kinds
+            assert monitor.gauge_value('serving/pad_waste_ratio') > 0
+
+            # the adapted rung serves bitwise-identically with ZERO
+            # retraces (it was compiled before becoming admissible)
+            out2 = np.asarray(srv.submit('t', {'x': xs[0]}).result(120)[0])
+            assert np.array_equal(out2, outs[0])
+            rep = srv.resident_report()['tenants'][0]
+            assert rep['retraces'] == retraces0
+            assert rep['bucket_hits'].get('2') == 1
+
+            # one-call revert: registered ladder and deadline restored
+            autopilot.revert()
+            rep = srv.resident_report()['tenants'][0]
+            assert rep['bucket_ladder'] == [1, 8]
+            assert rep['close_wait_s'] is None
+        finally:
+            srv.stop()
+
+    def test_adapt_ladder_never_drops_largest(self):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        main_p, startup, y = _build_mlp()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        srv = serving.ServingExecutor(max_batch=4, executor=exe)
+        srv.add_program('t', main_p, ['x'], [y], scope=scope,
+                        bucket_ladder=[2, 4])
+        try:
+            srv.warmup(wait=True)
+            assert srv.adapt_ladder('t', drop=[2, 4]) == (4,)
+        finally:
+            srv.stop()
+
+
+class TestSurface:
+    def test_decision_log_bounded_and_statusz_jsonable(self, tmp_path):
+        autopilot.engage()
+        for i in range(300):
+            autopilot._decide('probe', {'i': i}, acted=False)
+        assert len(autopilot.decisions()) == 256
+        assert autopilot.decisions(last=5)[-1]['choice']['i'] == 299
+        rep = autopilot.report()
+        assert rep['engaged'] and rep['decisions_total'] == 301
+        json.dumps(rep)             # the /statusz contract
+        from paddle_tpu.fluid import health
+        json.dumps(health.statusz())
+
+    def test_maybe_tick_interval_and_disengage(self):
+        assert not autopilot.maybe_tick(now=10.0)   # not engaged
+        autopilot.engage()
+        assert autopilot.maybe_tick(now=10.0)
+        assert not autopilot.maybe_tick(now=10.5)   # inside interval
+        assert autopilot.maybe_tick(now=13.0)
+        assert autopilot.disengage()
+        assert not autopilot.maybe_tick(now=20.0)
+
+    def test_tick_rides_timeseries_sampling(self):
+        fluid.set_flags({'FLAGS_timeseries': True})
+        autopilot.engage()
+        timeseries.sample(now=100.0)
+        assert monitor.counter_value('autopilot/ticks') == 1
+        timeseries.sample(now=100.5)    # throttled by the interval
+        assert monitor.counter_value('autopilot/ticks') == 1
+        timeseries.sample(now=103.0)
+        assert monitor.counter_value('autopilot/ticks') == 2

@@ -362,6 +362,27 @@ REQUIRED = [
     ('paddle_tpu/fluid/serving.py', 'FLAGS_serving_slo_p99_s'),
     ('tools/stat_summary.py', 'ts.counter_deltas'),
     ('bench.py', 'append_history'),
+    # closed-loop autopilot (fluid/autopilot.py): the bounded decision
+    # log, the online comms refits and their freeze/interlock/revert
+    # accounting, the degenerate-refit guard in the fitter, and the
+    # serving-side ladder adaptation counters —
+    # tools/check_autopilot.py closes the loop against a live
+    # faultinjected drift
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/decisions'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/decision/'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/refits'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/frozen_intents'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/slo_frozen'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/reverts'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/engaged'),
+    ('paddle_tpu/fluid/autopilot.py', 'autopilot/persist_errors'),
+    ('paddle_tpu/fluid/timeseries.py', 'autopilot/tick_errors'),
+    ('paddle_tpu/fluid/comms.py', 'autopilot/refit_degenerate'),
+    ('paddle_tpu/fluid/comms.py', 'comms/plan_pred_over_measured'),
+    ('paddle_tpu/fluid/serving.py', 'serving/bucket_dropped'),
+    ('paddle_tpu/fluid/serving.py', 'serving/bucket_prewarmed'),
+    ('paddle_tpu/fluid/serving.py', 'serving/pad_waste_ratio'),
+    ('paddle_tpu/fluid/serving.py', 'serving/close_wait_holds'),
 ]
 
 

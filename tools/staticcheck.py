@@ -48,6 +48,7 @@ LOCK_MODULES = [
     'paddle_tpu/parallel/plan.py',
     'paddle_tpu/fluid/timeseries.py',
     'paddle_tpu/fluid/slo.py',
+    'paddle_tpu/fluid/autopilot.py',
 ]
 # documented GIL-discipline exemption: registries with NO lock at all
 # (the lint fails if a lock ever appears there half-wired)
