@@ -92,7 +92,11 @@ bench:
 # closed-loop autopilot must refit a deliberately-dishonest comms
 # model from live dispatch points with zero retrace churn (digest
 # moves only at adoption), freeze to bit-identical knobs under
-# FLAGS_autopilot=0 and restore the static plan in one revert
+# FLAGS_autopilot=0 and restore the static plan in one revert; the
+# serving fleet must route a skewed-tenant soak across two live
+# replicas sticky and retrace-free, land a priced migration bitwise-
+# equal, surface its decisions over HTTP, and cost one weak-set read
+# when no fleet exists
 check:
 	python tools/check_stat_coverage.py
 	python tools/staticcheck.py
@@ -111,6 +115,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_timeseries.py
 	JAX_PLATFORMS=cpu python tools/check_kernels.py
 	JAX_PLATFORMS=cpu python tools/check_autopilot.py
+	JAX_PLATFORMS=cpu python tools/check_fleet.py
 	JAX_PLATFORMS=cpu python tools/check_regress.py --selftest
 
 wheel: all

@@ -1208,6 +1208,206 @@ def _pct_of(sorted_vals, q):
                            int(round(q * (len(sorted_vals) - 1))))]
 
 
+def bench_serving_fleet(feeders=3, requests_per_feeder=80,
+                        max_batch=8):
+    """Skewed-tenant churn soak, fleet vs single replica: the SAME
+    workload (three tenants, ~70% of traffic on one hot tenant, mixed
+    row counts) and the SAME churn events (the hot tenant is
+    relocated twice mid-soak) through two arms —
+
+    - single replica: churn is evict -> re-register -> re-warm ON the
+      serving path; requests to the hot tenant stall (retried at
+      admission) until the re-warm finishes, so tail latency eats the
+      whole warmup wall;
+    - two-replica fleet: churn is ``fleet.migrate`` — the target is
+      pre-warmed through the persistent compile cache while the
+      SOURCE keeps serving, then the route flips; no request ever
+      waits on a warmup.
+
+    Reports per-request p50/p99 for both arms (the acceptance claim:
+    fleet p99 held under churn while the single replica degrades),
+    zero post-warmup retraces, and every migration matched to a
+    priced decision in the fleet log."""
+    import threading
+    import jax  # noqa: F401 — device init before the timed regions
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import fleet, memviz, monitor, serving
+
+    def build(hid_w, seed):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[16], dtype='float32')
+            h = fluid.layers.fc(x, hid_w, act='relu')
+            y = fluid.layers.fc(h, 10, act='softmax')
+        return main, startup, y
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    tenants = {}
+    for name, (hid_w, seed) in (('hot', (64, 31)), ('warm', (96, 32)),
+                                ('cold', (48, 33))):
+        mp, sp, y = build(hid_w, seed)
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(sp)
+        tenants[name] = (mp, sc, y)
+    # ~70% of traffic on the hot tenant — the skew churn then hits
+    skew = ('hot', 'hot', 'hot', 'warm', 'hot',
+            'cold', 'hot', 'hot', 'warm', 'hot')
+    rows_cycle = (1, 1, 2, 1, 4, 1)
+    total = feeders * requests_per_feeder
+
+    def run_arm(submit_fn, churn_fn):
+        """One soak: N feeders over the skewed stream, churn fired at
+        1/3 and 2/3 progress.  A submit that lands mid-churn (tenant
+        momentarily unregistered on the single arm) retries at
+        admission — the wait counts against its latency, which is the
+        point."""
+        latencies = []
+        lock = threading.Lock()
+        served = [0]
+        errors = []
+        churn_walls = []
+
+        def feeder(fid):
+            rng = np.random.RandomState(200 + fid)
+            for i in range(requests_per_feeder):
+                name = skew[(fid + i) % len(skew)]
+                rows = rows_cycle[i % len(rows_cycle)]
+                xv = rng.randn(rows, 16).astype('float32')
+                t0 = time.perf_counter()
+                try:
+                    while True:
+                        try:
+                            fut = submit_fn(name, {'x': xv})
+                            break
+                        except KeyError:
+                            time.sleep(0.002)   # tenant mid-churn
+                    fut.result(300)
+                except Exception as e:  # noqa: BLE001
+                    errors.append('%s req %d: %s' % (name, i, e))
+                    continue
+                lat = time.perf_counter() - t0
+                with lock:
+                    latencies.append(lat)
+                    served[0] += 1
+
+        def churner():
+            for frac in (1 / 3, 2 / 3):
+                while served[0] < frac * total:
+                    time.sleep(0.005)
+                t0 = time.perf_counter()
+                churn_fn()
+                churn_walls.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=feeder, args=(fid,))
+                   for fid in range(feeders)]
+        ct = threading.Thread(target=churner)
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        ct.start()
+        for t in threads:
+            t.join(600)
+        ct.join(60)
+        dt = time.time() - t0
+        lat = sorted(latencies)
+        return {'requests': len(latencies), 'wall_s': dt,
+                'rps': len(latencies) / dt,
+                'p50_ms': 1e3 * _pct_of(lat, 0.50),
+                'p99_ms': 1e3 * _pct_of(lat, 0.99),
+                'churn_walls_s': [round(w, 3) for w in churn_walls],
+                'errors': errors[:3]}
+
+    # -- arm 1: single replica, churn on the serving path ------------
+    srv = serving.ServingExecutor(max_batch=max_batch, executor=exe)
+    for name, (mp, sc, y) in tenants.items():
+        srv.add_program(name, mp, ['x'], [y], scope=sc)
+    srv.warmup(wait=True)
+    lowered0 = monitor.counter_value('executor/segments_lowered')
+
+    def churn_single():
+        # relocation without a second replica: the tenant leaves the
+        # ladder and re-warms IN the serving path — its traffic waits
+        mp, sc, y = tenants['hot']
+        srv.remove_program('hot', drain=True)
+        srv.add_program('hot', mp, ['x'], [y], scope=sc)
+        srv.warmup_tenant('hot', wait=True)
+
+    def submit_single(name, feed):
+        # the readiness contract (serving.readiness): an unwarmed
+        # tenant makes the replica unready — a load balancer holds
+        # traffic until the re-warm finishes, so the wait lands on
+        # the requests' latency
+        t = srv._tenants.get(name)
+        if t is None or not t.warmed:
+            raise KeyError(name)
+        return srv.submit(name, feed)
+
+    single = run_arm(submit_single, churn_single)
+    single_retraces = monitor.counter_value(
+        'executor/segments_lowered') - lowered0
+    srv.close()
+
+    # -- arm 2: two-replica fleet, churn is a priced migration -------
+    fl = fleet.Fleet()
+    for i in range(2):
+        fl.add_replica('r%d' % i,
+                       serving.ServingExecutor(max_batch=max_batch,
+                                               executor=exe))
+    for name, (mp, sc, y) in tenants.items():
+        fl.register_tenant(name, mp, ['x'], [y], scope=sc)
+    fl.warmup(wait=True)
+    memviz.live_census()       # the migration pricing input
+    lowered0 = monitor.counter_value('executor/segments_lowered')
+
+    fleet_arm = run_arm(fl.submit,
+                        lambda: fl.migrate('hot', why='churn'))
+    fleet_retraces = monitor.counter_value(
+        'executor/segments_lowered') - lowered0
+    moves = [d for d in fleet.decisions()
+             if d['kind'] in ('migrate', 'evict') and d['acted']]
+    unpriced = [d for d in moves if 'priced' not in d.get('info', {})]
+    for s in fl.replicas().values():
+        s.close()
+    fl.close()
+
+    return dict({
+        'metric': 'serving_fleet_p99_ms',
+        'value': round(fleet_arm['p99_ms'], 2),
+        'unit': 'ms',
+        'feeders': feeders,
+        'replicas': 2,
+        'programs': len(tenants),
+        'requests': fleet_arm['requests'],
+        'fleet_p50_ms': round(fleet_arm['p50_ms'], 2),
+        'fleet_rps': round(fleet_arm['rps'], 1),
+        'fleet_churn_walls_s': fleet_arm['churn_walls_s'],
+        'fleet_errors': fleet_arm['errors'],
+        # the degrading arm: same workload, same churn, one replica.
+        # Deliberately NOT regression-gated (vs_baseline): its p99 IS
+        # the churn warmup wall, an environmental quantity
+        'single_replica_churn_p99_ms_vs_baseline':
+            round(single['p99_ms'], 2),
+        'single_replica_churn_p50_ms_vs_baseline':
+            round(single['p50_ms'], 2),
+        'single_replica_rps_vs_baseline': round(single['rps'], 1),
+        'single_churn_walls_s_vs_baseline':
+            single['churn_walls_s'],
+        'single_errors_vs_baseline': single['errors'],
+        'p99_held_under_churn':
+            bool(fleet_arm['p99_ms'] <= single['p99_ms']),
+        'retraces_post_warmup': fleet_retraces,
+        'single_retraces_post_warmup_vs_baseline': single_retraces,
+        'migrations': monitor.counter_value('fleet/migrations'),
+        'priced_moves': len(moves),
+        'unpriced_moves': len(unpriced),
+        'routed_requests': monitor.counter_value(
+            'fleet/routed_requests'),
+        'fleet_decisions': len(fleet.decisions()),
+    }, **_monitor_fields())
+
+
 def bench_health_overhead(depth=4, width=64, batch=32, steps=60,
                           warmup=8):
     """FLAGS_health_summaries on/off A/B on one small MLP: the BENCH
@@ -2304,6 +2504,22 @@ def main():
         with open(out, 'w') as f:
             json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
                               '--serving',
+                       'entries': [rec]}, f, indent=1, sort_keys=True)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == '--serving-fleet':
+        # skewed-tenant churn soak: two-replica fleet (priced
+        # migrations, p99 held) vs one replica eating the re-warm
+        # wall on the serving path.  Baseline recorded in
+        # BENCH_fleet.json.
+        out = sys.argv[2] if len(sys.argv) > 2 else \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'BENCH_fleet.json')
+        rec = bench_serving_fleet()
+        print(json.dumps(rec))
+        append_history('serving_fleet', rec)
+        with open(out, 'w') as f:
+            json.dump({'cmd': 'JAX_PLATFORMS=cpu python bench.py '
+                              '--serving-fleet',
                        'entries': [rec]}, f, indent=1, sort_keys=True)
         return
     if len(sys.argv) > 1 and sys.argv[1] == '--kernels':

@@ -287,6 +287,7 @@ def account_dispatch(records, wall_s, compile_run=False):
     total_wire = payload = 0.0
     kinds = {}
     series_wire = {}
+    refit_wire = {}
     plan_arms = {}
     plan_wire = plan_dense = plan_pred = 0.0
     plan_fused = plan_unpriced = 0
@@ -297,6 +298,26 @@ def account_dispatch(records, wall_s, compile_run=False):
         kinds[r['kind']] = kinds.get(r['kind'], 0) + 1
         key = (r['kind'], r['bucket'])
         series_wire[key] = series_wire.get(key, 0.0) + r['wire_bytes']
+        # refit-pool keying: the model ENTRY a record's wall should
+        # recalibrate.  An rs_ag-armed record executes reducescatter +
+        # allgather, so its wall decomposes into those two phase
+        # points (the same split reprice_record prices with) — filing
+        # it under 'allreduce' would both starve the phase entries of
+        # refit points AND pollute the dense-allreduce fit with walls
+        # the dense path never produced.  The quant arm's records
+        # already carry their own kind ('allreduce_quant'), the entry
+        # that prices them, so they pass through keyed as-is.
+        if r.get('arm') == 'rs_ag':
+            n = max(1, int(r.get('participants') or 1))
+            pl = float(r['payload_bytes'])
+            rs_w = wire_bytes('reducescatter', pl, n)
+            ag_w = wire_bytes('allgather', pl / n, n)
+            rs_key = ('reducescatter', size_bucket(pl))
+            ag_key = ('allgather', size_bucket(pl / n))
+            refit_wire[rs_key] = refit_wire.get(rs_key, 0.0) + rs_w
+            refit_wire[ag_key] = refit_wire.get(ag_key, 0.0) + ag_w
+        else:
+            refit_wire[key] = refit_wire.get(key, 0.0) + r['wire_bytes']
         arm = r.get('arm')
         if arm is not None:
             plan_arms[arm] = plan_arms.get(arm, 0) + 1
@@ -358,15 +379,25 @@ def account_dispatch(records, wall_s, compile_run=False):
         bw_gbps = wire / wall_s / 1e9
         monitor.observe('comms/bw_gbps/%s/%s' % (kind, bucket),
                         bw_gbps, BW_BUCKETS)
-        # refit point: this series' wire over its wire-share of the
-        # wall, so summing repriced predictions over a multi-series
-        # segment reproduces the segment wall instead of K times it
-        attributed_wall = wall_s * (wire / total_wire)
         with _lock:
             samples = _BW_SAMPLES.setdefault((kind, bucket), [])
             if len(samples) >= _BW_SAMPLES_CAP:
                 del samples[:_BW_SAMPLES_CAP // 2]
             samples.append(bw_gbps)
+    # refit points: each MODEL-ENTRY series' wire over its wire-share
+    # of the wall, so summing repriced predictions over a multi-series
+    # segment reproduces the segment wall instead of K times it.  The
+    # refit keying decomposed rs_ag arms into their reducescatter /
+    # allgather phases above, so those entries — and the quant kind —
+    # recalibrate from live traffic the same way dense allreduce does.
+    refit_total = sum(refit_wire.values())
+    if refit_total <= 0:
+        return
+    for (kind, bucket), wire in refit_wire.items():
+        if wire <= 0:
+            continue
+        attributed_wall = wall_s * (wire / refit_total)
+        with _lock:
             pts = _DISPATCH_POINTS.setdefault((kind, bucket), [])
             if len(pts) >= _DISPATCH_POINTS_CAP:
                 del pts[:_DISPATCH_POINTS_CAP // 2]

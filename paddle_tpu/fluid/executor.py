@@ -1422,9 +1422,13 @@ class Executor(object):
         # fingerprint (donate=False: CompiledStep state is caller-owned)
         # so compiling the same program twice — or a program `run`
         # already planned — never pays a second trace, and the XLA
-        # compile dedupes across processes via the persistent cache
+        # compile dedupes across processes via the persistent cache.
+        # output_names is part of the executable interface: the same
+        # ops planned for a different fetch set returns different vars
         fp = compile_cache.fingerprint(
-            seg.ops, (), _lowering_flag_items(prefer_test, wpg),
+            seg.ops, (),
+            _lowering_flag_items(prefer_test, wpg) +
+            tuple(sorted(seg.output_names)),
             donate=False, purpose='jit')
         jitted = compile_cache.plane().shared_jit(
             fp, lambda: jax.jit(fn))
@@ -1583,10 +1587,13 @@ class Executor(object):
                     unknown.add(n)
                 continue
             specs = compile_cache.arg_specs(state_specs, data_specs)
+            # output_names folded in: must match the run-path key below
+            # exactly or warmup's pre-compiles never hit
             fp = compile_cache.fingerprint(
                 seg.ops, specs,
                 _lowering_flag_items(seg.prefer_test, wpg) +
-                (int(getattr(device, 'id', 0)),),
+                (int(getattr(device, 'id', 0)),) +
+                tuple(sorted(seg.output_names)),
                 donate=True)
             out_specs = plane.out_specs(fp)
             if plane.lookup(fp) is None and out_specs is None:
@@ -2352,11 +2359,17 @@ class Executor(object):
                 # the executor's device is part of the executable
                 # identity: a non-default place compiles (and caches)
                 # its own executable, matching the lazy path's
-                # jax.default_device(device) compile
+                # jax.default_device(device) compile.  So is the
+                # segment's OUTPUT selection: the same ops planned for
+                # a different fetch set is a different executable (it
+                # returns different vars) — without it, the first
+                # fetch set's executable would be served content-
+                # addressed to every later plan over the same ops
                 fp = compile_cache.fingerprint(
                     seg.ops, specs,
                     _lowering_flag_items(seg.prefer_test, wpg) +
-                    (int(getattr(device, 'id', 0)),),
+                    (int(getattr(device, 'id', 0)),) +
+                    tuple(sorted(seg.output_names)),
                     donate=True)
                 state_specs, data_specs = _specs_from_args(state, data)
                 compiled = plane.obtain(

@@ -399,6 +399,28 @@ _DEFAULTS = {
     # quant arm with the reduced quant_hbm_temp term when this is
     # available (see _QUANT_MEM_FACTOR_FUSED)
     'FLAGS_pallas_quant_collective': True,
+
+    # --- serving fleet (fleet.py): cross-replica router, SLO-class
+    # policy and priced tenant migration.  0 freezes the plane: the
+    # router falls back to static first-replica placement and every
+    # migration/eviction/class move is logged as an intent
+    # (fleet/frozen_intents) without acting; revert() still works.
+    'FLAGS_fleet': True,
+    # control-loop throttle on the timeseries.sample cadence; a
+    # migration must settle 4x this before the balance loop moves again
+    'FLAGS_fleet_interval_s': 1.0,
+    # queue-depth gap (deepest - shallowest replica) that triggers a
+    # balancing migration
+    'FLAGS_fleet_imbalance_depth': 8,
+    # class policy when a protecting objective fires: 'shed' fails the
+    # non-protected classes fast, 'defer' widens their batch-close
+    # waits instead (they still serve, late)
+    'FLAGS_fleet_shed_mode': 'shed',
+    # close-wait applied to deferred classes under 'defer' mode
+    'FLAGS_fleet_defer_close_wait_s': 0.02,
+    # eviction-pricing fallback for the re-warmup wall before any
+    # serving/warmup_seconds observation exists
+    'FLAGS_fleet_rewarmup_default_s': 1.0,
 }
 
 # v1.6 scripts set these; the TPU runtime ACCEPTS them for script

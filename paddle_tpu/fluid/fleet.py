@@ -1,0 +1,770 @@
+"""fluid.fleet — SLO-aware serving fleet: cross-replica routing,
+priority scheduling and priced tenant migration (ROADMAP item 3, the
+SCALE leg over the serving plane).
+
+One ``ServingExecutor`` already turns residency + continuous batching
+into 2.4x sequential throughput with zero post-warmup retraces;
+"millions of users" means MANY replicas, and this module is the layer
+that makes a set of replicas behave like one service:
+
+- **Router with sticky placement.**  A ``Fleet`` holds N replicas
+  (``ServingExecutor`` instances — in-process here; the signals it
+  scores are exactly the ones the rank-0 health aggregator already
+  scrapes from every worker's ``/metrics.json``, so the same scoring
+  runs fleet-wide).  A tenant is PLACED once, on the replica with the
+  lowest load score — queue depth, resident-tenant count, per-tenant
+  live-HBM residency from the memviz census, and the windowed
+  admit-to-done p99 from ``timeseries`` — and every subsequent
+  ``submit`` routes to that placement (sticky: the tenant's warmed
+  bucket ladder keeps paying off; re-scoring per request would
+  scatter traffic across cold replicas and retrace).
+
+- **Priority/SLO classes.**  Each tenant carries an ``slo_class``
+  (e.g. ``'interactive'`` vs ``'batch'``).  ``protect_class`` maps a
+  declared ``fluid.slo`` objective to the class it protects; when
+  that objective FIRES, the fleet sheds (``FLAGS_fleet_shed_mode =
+  'shed'``: submits of the other classes fail fast,
+  ``serving/shed_class``) or defers (``'defer'``: the other classes'
+  batch-close waits widen to ``FLAGS_fleet_defer_close_wait_s``) the
+  NON-protected classes — one class's incident stops costing the
+  other class its latency.  Resolution restores the static policy.
+  Batch closing itself is deadline-AWARE (``serving._close_hold_s``
+  caps any hold at the tightest queued submit deadline), so
+  coalescing never turns a meetable deadline into a shed.
+
+- **Priced eviction and migration.**  Tenant churn beyond the LRU
+  caps is handled the way the comms planner and elastic reshard
+  handle their moves: PRICED, never guessed.  Every candidate's
+  eviction cost is (estimated re-warmup wall through the persistent
+  compile cache) per (memviz residency byte freed); ``evict`` picks
+  the cheapest candidate and logs the whole priced table.
+  ``migrate`` is first-class: register + pre-warm the tenant's whole
+  ladder on the target (``warmup_tenant`` — the source keeps serving
+  during the warm), flip the route, drain and evict the source copy
+  — a migrated tenant's first request on the target hits the warmed
+  AOT ladder, zero retraces, and its outputs stay bitwise-equal (the
+  scope moves with the tenant; the per-bucket executables come from
+  the same persistent compile cache).
+
+Every decision follows the supervisor/autopilot observable-and-
+revertible contract: a bounded decision log (signal -> choice ->
+price -> acted/frozen) surfaced at ``/statusz`` (section ``fleet``),
+``fleet/*`` counters, a freeze switch (``FLAGS_fleet=0`` logs intents
+acted=False and changes nothing — placement falls back to the static
+first-replica choice), and one-call ``revert()`` back to the as-
+registered placements and class policy (works even frozen — revert
+IS the escape hatch).
+
+The control loop rides the ``timeseries.sample`` cadence
+(``maybe_tick`` — no thread of its own; one registry read when no
+fleet exists), exactly like the autopilot.  Same discipline as the
+rest of the plane: no jax imports at module level, module registries
+mutated only under the module ``_lock``.
+"""
+
+import collections
+import threading
+import time
+import weakref
+
+from . import monitor
+from .flags import get_flag
+
+__all__ = [
+    'Fleet', 'enabled', 'live_fleets', 'decisions', 'report',
+    'maybe_tick', 'revert', 'reset',
+]
+
+_lock = threading.Lock()
+
+_DECISIONS_CAP = 256
+_decisions = []
+_seq = [0]
+_state = {'last_tick': 0.0, 'ticks': 0}
+
+# live Fleets, for the health plane's /statusz view and the sampling-
+# cadence tick (mirrors serving._live)
+_live = weakref.WeakSet()
+
+# router score weights: queue depth is the freshest congestion signal,
+# resident-tenant count the warmed-ladder budget, HBM share the churn
+# headroom.  Fixed (documented) weights — the signals are already
+# normalized to comparable scales below.
+_W_QUEUE = 2.0
+_W_TENANTS = 1.0
+_W_HBM = 4.0
+
+
+def enabled():
+    """False = FLAGS_fleet=0: the freeze switch.  The router falls
+    back to the static first-replica placement and every
+    migration/eviction/class-policy move is logged as an intent
+    (acted=False, counted ``fleet/frozen_intents``) without touching
+    anything."""
+    return bool(get_flag('FLAGS_fleet', True))
+
+
+# ------------------------------------------------------- decision log
+def _decide(kind, choice, acted=True, frozen=False, now=None, **info):
+    """One bounded decision-log record (the supervisor/autopilot
+    contract): the signals read, the choice, its price, and whether it
+    was acted on or frozen.  Counted ``fleet/decisions`` and
+    ``fleet/decision/<kind>``."""
+    if frozen:
+        acted = False
+        monitor.add('fleet/frozen_intents')
+    rec = {
+        'seq': None,
+        'wall_unix': time.time() if now is None else float(now),
+        'kind': kind, 'choice': choice,
+        'acted': bool(acted), 'frozen': bool(frozen),
+    }
+    if info:
+        rec['info'] = info
+    with _lock:
+        _seq[0] += 1
+        rec['seq'] = _seq[0]
+        _decisions.append(rec)
+        del _decisions[:-_DECISIONS_CAP]
+    monitor.add('fleet/decisions')
+    monitor.add('fleet/decision/%s' % kind)
+    return rec
+
+
+def decisions(last=None):
+    """The bounded decision trail, oldest first (optionally just the
+    newest `last`)."""
+    with _lock:
+        out = list(_decisions)
+    return out[-int(last):] if last else out
+
+
+def live_fleets():
+    """Live (non-closed) Fleets."""
+    return [f for f in list(_live) if not f._closed]
+
+
+# ------------------------------------------------------------- signals
+def _tenant_residency():
+    """{tenant: live-HBM bytes} from the newest memviz census (the
+    per-tenant classes the registered scope provider feeds), or {}
+    before any census — routing must not pay an O(live arrays) walk
+    per placement."""
+    try:
+        from . import memviz
+        census = memviz.last_census()
+        if census:
+            return dict(census.get('tenants') or {})
+    except Exception:
+        pass
+    return {}
+
+
+def _admit_p99():
+    """(p99 seconds, source) of serving admit-to-done latency: the
+    windowed timeseries percentile when history exists, else the
+    monitor histogram's lifetime p99, else (None, None)."""
+    try:
+        from . import timeseries
+        doc = timeseries.window('serving/admit_to_done_seconds',
+                                points=64)
+        if doc and doc['derived'].get('count'):
+            p = (doc['derived'].get('percentiles') or {}).get('p99')
+            if p is not None:
+                return float(p), 'timeseries_p99'
+    except Exception:
+        pass
+    try:
+        from . import timeseries
+        h = monitor.histogram_value('serving/admit_to_done_seconds')
+        if h and h.get('count'):
+            # histogram_value gives cumulative prometheus buckets in
+            # edge order; de-cumulate for percentile_from_counts
+            items = list(h['buckets'].items())
+            edges = [float(k) for k, _v in items[:-1]]
+            cum = [v for _k, v in items]
+            counts = [cum[0]] + [b - a for a, b in zip(cum, cum[1:])]
+            p = timeseries.percentile_from_counts(edges, counts, 0.99)
+            if p is not None:
+                return float(p), 'monitor_hist_p99'
+    except Exception:
+        pass
+    return None, None
+
+
+def _rewarmup_estimate_s():
+    """Estimated wall of re-warming one tenant's ladder through the
+    persistent compile cache: the mean of the measured
+    ``serving/warmup_seconds`` observations when any exist (restart-
+    to-serving is already seconds; per-tenant warms land in the same
+    histogram), else ``FLAGS_fleet_rewarmup_default_s``."""
+    h = monitor.histogram_value('serving/warmup_seconds')
+    if h and h.get('count'):
+        return h['sum'] / h['count']
+    return float(get_flag('FLAGS_fleet_rewarmup_default_s', 1.0)
+                 or 1.0)
+
+
+# ---------------------------------------------------------------- Fleet
+class Fleet(object):
+    """N serving replicas behind one router.
+
+    Usage::
+
+        fl = fleet.Fleet()
+        fl.add_replica('r0', serving.ServingExecutor(executor=exe0))
+        fl.add_replica('r1', serving.ServingExecutor(executor=exe1))
+        fl.register_tenant('ranker', prog, ['x'], [y], scope=sc,
+                           slo_class='interactive')
+        fl.warmup()
+        out, = fl.submit('ranker', {'x': batch}).result()
+        fl.migrate('ranker', 'r1')        # priced, logged, zero-retrace
+    """
+
+    def __init__(self, name='fleet'):
+        self.name = str(name)
+        self._ilock = threading.RLock()
+        self._replicas = collections.OrderedDict()
+        self._placements = {}      # tenant -> replica name (the route)
+        self._base = {}            # tenant -> as-registered placement
+        self._classes = {}         # tenant -> slo_class
+        self._registrations = {}   # tenant -> add_program args
+        self._protect = {}         # objective name -> protected class
+        self._shed = {}            # class -> reason (active policy)
+        self._deferred = {}        # tenant -> pre-defer close_wait_s
+        self._last_move = 0.0
+        self._closed = False
+        with _lock:
+            _live.add(self)
+
+    # -- replicas ------------------------------------------------------
+    def add_replica(self, name, srv):
+        """Join one ``ServingExecutor`` to the fleet."""
+        with self._ilock:
+            if name in self._replicas:
+                raise ValueError('replica %r already joined' % name)
+            self._replicas[str(name)] = srv
+        monitor.set_gauge('fleet/replicas', len(self._replicas))
+        return srv
+
+    def replicas(self):
+        with self._ilock:
+            return dict(self._replicas)
+
+    def replica(self, name):
+        return self._replicas[name]
+
+    # -- router --------------------------------------------------------
+    def signals(self):
+        """Per-replica load signals — the same quantities the rank-0
+        aggregator scrapes from every replica's ``/metrics.json``:
+        queue depth, resident tenants, batch share, summed per-tenant
+        live-HBM residency (memviz census) — plus the score the
+        router orders replicas by (lower = preferred)."""
+        residency = _tenant_residency()
+        try:
+            from . import memviz
+            budget = memviz.budget_bytes()
+        except Exception:
+            budget = None
+        out = {}
+        with self._ilock:
+            items = list(self._replicas.items())
+            placements = dict(self._placements)
+        for rname, srv in items:
+            try:
+                rep = srv.resident_report()
+            except Exception:
+                rep = {'tenants': []}
+            queue = sum(int(t.get('queue_depth') or 0)
+                        for t in rep['tenants'])
+            tenants = [t['tenant'] for t in rep['tenants']]
+            hbm = sum(float(residency.get(t, 0.0)) for t in tenants)
+            hbm_util = (hbm / budget) if budget else 0.0
+            out[rname] = {
+                'queue_depth': queue,
+                'tenants': len(tenants),
+                'resident_bytes': hbm,
+                'hbm_utilization': round(hbm_util, 6),
+                'score': round(_W_QUEUE * queue
+                               + _W_TENANTS * len(tenants)
+                               + _W_HBM * hbm_util, 6),
+                'placed': sorted(t for t, r in placements.items()
+                                 if r == rname),
+            }
+        return out
+
+    def _choose_replica(self, exclude=()):
+        """(replica name, signals): lowest score wins, join order
+        breaks ties (deterministic placement)."""
+        sig = self.signals()
+        best = None
+        for rname in self._replicas:
+            if rname in exclude:
+                continue
+            s = sig[rname]['score']
+            if best is None or s < sig[best]['score']:
+                best = rname
+        return best, sig
+
+    def register_tenant(self, name, program, feed_names, fetch_list,
+                        scope=None, slo_class='interactive',
+                        replica=None, now=None, **kwargs):
+        """Place tenant `name` on a replica (router-scored unless
+        `replica` pins it) and make it resident there.  The placement
+        is STICKY: submits route here until a migration flips it.
+        Frozen (``FLAGS_fleet=0``) the router's choice is logged as an
+        intent and the static first replica is used."""
+        if not self._replicas:
+            raise RuntimeError('fleet has no replicas')
+        frozen = not enabled()
+        static = next(iter(self._replicas))
+        chosen, sig = self._choose_replica()
+        if replica is not None:
+            rname = str(replica)
+            why = 'pinned'
+        elif frozen:
+            rname = static
+            why = 'frozen_static'
+        else:
+            rname = chosen
+            why = 'scored'
+        srv = self._replicas[rname]
+        tenant = srv.add_program(name, program, feed_names, fetch_list,
+                                 scope=scope, slo_class=slo_class,
+                                 **kwargs)
+        with self._ilock:
+            self._placements[name] = rname
+            self._base[name] = rname
+            self._classes[name] = str(slo_class)
+            self._registrations[name] = {
+                'program': program,
+                'feed_names': tuple(feed_names),
+                'fetch_list': list(fetch_list),
+                'scope': tenant.scope,
+                'slo_class': str(slo_class),
+                'kwargs': dict(kwargs),
+            }
+        monitor.add('fleet/placements')
+        _decide('place',
+                {'tenant': name, 'replica': rname, 'why': why},
+                acted=not frozen or rname == static, frozen=frozen,
+                now=now, scored_choice=chosen, signals=sig,
+                slo_class=str(slo_class))
+        return tenant
+
+    def submit(self, tenant, feed, deadline_s=None):
+        """Route one request to the tenant's placed replica (sticky).
+        Raises KeyError for a tenant the fleet never placed (or
+        evicted)."""
+        rname = self._placements.get(tenant)
+        if rname is None:
+            raise KeyError('tenant %r is not placed on any replica '
+                           '(placed: %r)'
+                           % (tenant, sorted(self._placements)))
+        monitor.add('fleet/routed_requests')
+        return self._replicas[rname].submit(tenant, feed,
+                                            deadline_s=deadline_s)
+
+    def infer(self, tenant, feed, timeout=None):
+        """Blocking convenience: submit + result."""
+        return self.submit(tenant, feed).result(timeout)
+
+    def placement(self, tenant=None):
+        """The route table ({tenant: replica}, or one tenant's)."""
+        with self._ilock:
+            if tenant is not None:
+                return self._placements.get(tenant)
+            return dict(self._placements)
+
+    def warmup(self, wait=True):
+        """Warm every replica's resident ladder (zero-retrace serving
+        from the first request, fleet-wide)."""
+        for srv in self.replicas().values():
+            srv.warmup(wait=wait)
+        return self
+
+    # -- class policy --------------------------------------------------
+    def protect_class(self, slo_class, objective):
+        """Map a declared ``fluid.slo`` objective (by name) to the SLO
+        class it protects: while that objective fires, the OTHER
+        classes are shed/deferred instead of both degrading."""
+        with self._ilock:
+            self._protect[str(objective)] = str(slo_class)
+        return str(objective)
+
+    def _firing_objectives(self):
+        try:
+            from . import slo
+            return {o['name'] for o in slo.objectives()
+                    if o['state'] == 'firing'}
+        except Exception:
+            return set()
+
+    def _class_loop(self, now, frozen):
+        """Shed/defer the non-protected classes while a protecting
+        objective fires; restore on resolution."""
+        with self._ilock:
+            protect = dict(self._protect)
+            classes = set(self._classes.values())
+            active = dict(self._shed)
+        if not protect:
+            return
+        firing = self._firing_objectives()
+        want = {}
+        for obj, cls in protect.items():
+            if obj not in firing:
+                continue
+            for other in sorted(classes - {cls}):
+                want.setdefault(
+                    other, 'objective %s firing on class %s'
+                    % (obj, cls))
+        mode = str(get_flag('FLAGS_fleet_shed_mode', 'shed')
+                   or 'shed')
+        for cls, reason in sorted(want.items()):
+            if cls in active:
+                continue
+            info = {'slo_class': cls, 'mode': mode, 'reason': reason,
+                    'firing': sorted(firing)}
+            if frozen:
+                _decide('class_shed', {'class': cls, 'mode': mode},
+                        acted=False, frozen=True, now=now, **info)
+                continue
+            self._apply_class_policy(cls, reason, mode)
+            monitor.add('fleet/class_shed')
+            _decide('class_shed', {'class': cls, 'mode': mode},
+                    acted=True, now=now,
+                    expected_gain='protected class keeps its latency; '
+                                  'this class fails fast instead of '
+                                  'queueing behind the incident',
+                    **info)
+        for cls in sorted(active):
+            if cls in want:
+                continue
+            info = {'slo_class': cls, 'was': active[cls]}
+            if frozen:
+                _decide('class_restore', {'class': cls}, acted=False,
+                        frozen=True, now=now, **info)
+                continue
+            self._restore_class_policy(cls)
+            monitor.add('fleet/class_restored')
+            _decide('class_restore', {'class': cls}, acted=True,
+                    now=now, **info)
+
+    def _apply_class_policy(self, cls, reason, mode):
+        with self._ilock:
+            self._shed[cls] = reason
+            replicas = list(self._replicas.values())
+        for srv in replicas:
+            if mode == 'defer':
+                wait = float(get_flag(
+                    'FLAGS_fleet_defer_close_wait_s', 0.02) or 0.02)
+                for tname in srv.tenants_of_class(cls):
+                    with self._ilock:
+                        if tname not in self._deferred:
+                            self._deferred[tname] = \
+                                srv._tenants[tname].close_wait_s
+                    srv.set_close_wait(tname, wait)
+            else:
+                srv.set_class_shed(cls, reason)
+
+    def _restore_class_policy(self, cls):
+        with self._ilock:
+            self._shed.pop(cls, None)
+            replicas = list(self._replicas.values())
+        for srv in replicas:
+            srv.clear_class_shed(cls)
+            for tname in srv.tenants_of_class(cls):
+                with self._ilock:
+                    prev = self._deferred.pop(tname, None)
+                srv.set_close_wait(tname, prev)
+
+    # -- priced eviction / migration -----------------------------------
+    def price_move(self, tenant):
+        """The priced two sides of removing `tenant` from its replica:
+        live-HBM residency freed (memviz census) vs the re-warmup wall
+        a return would cost through the persistent compile cache.
+        ``cost_per_byte`` orders candidates (lower = cheaper to
+        evict)."""
+        residency = float(_tenant_residency().get(tenant, 0.0))
+        rewarm = _rewarmup_estimate_s()
+        return {
+            'tenant': tenant,
+            'residency_bytes': residency,
+            'rewarmup_s': round(rewarm, 6),
+            'cost_per_byte': rewarm / max(residency, 1.0),
+        }
+
+    def evict(self, tenant=None, replica=None, why='churn', now=None):
+        """Evict one tenant: `tenant` names it explicitly, else the
+        CHEAPEST candidate on `replica` (or fleet-wide) by priced
+        cost-per-byte-freed.  The whole candidate table lands in the
+        decision log — every eviction is matched to a priced decision.
+        Returns the evicted tenant name (None when frozen or no
+        candidate)."""
+        frozen = not enabled()
+        with self._ilock:
+            if tenant is not None:
+                candidates = [tenant] if tenant in self._placements \
+                    else []
+            elif replica is not None:
+                candidates = [t for t, r in self._placements.items()
+                              if r == replica]
+            else:
+                candidates = list(self._placements)
+        if not candidates:
+            return None
+        table = [self.price_move(t) for t in sorted(candidates)]
+        pick = min(table, key=lambda p: p['cost_per_byte'])
+        info = {'why': why, 'candidates': table,
+                'replica': self._placements.get(pick['tenant'])}
+        if frozen:
+            _decide('evict', {'tenant': pick['tenant']}, acted=False,
+                    frozen=True, now=now, priced=pick, **info)
+            return None
+        rname = self._placements[pick['tenant']]
+        self._replicas[rname].remove_program(pick['tenant'],
+                                             drain=True)
+        with self._ilock:
+            self._placements.pop(pick['tenant'], None)
+            self._classes.pop(pick['tenant'], None)
+        monitor.add('fleet/evictions')
+        _decide('evict', {'tenant': pick['tenant']}, acted=True,
+                now=now, priced=pick,
+                expected_gain='%d residency bytes freed for a ~%.3fs '
+                              're-warm return'
+                              % (pick['residency_bytes'],
+                                 pick['rewarmup_s']),
+                **info)
+        return pick['tenant']
+
+    def migrate(self, tenant, to_replica=None, why='manual', now=None,
+                _force=False):
+        """Move `tenant` to `to_replica` (router-scored when None):
+        register + pre-warm its WHOLE ladder on the target through the
+        persistent compile cache (the source keeps serving meanwhile),
+        flip the route, then drain and evict the source copy.  The
+        move is priced (residency moved vs measured warmup wall) and
+        logged; a migrated tenant's post-warmup traffic must not
+        retrace (the acceptance contract ``tests/test_fleet.py``
+        holds).  Returns the target replica name, or None when frozen
+        or a no-op."""
+        with self._ilock:
+            src = self._placements.get(tenant)
+            reg = self._registrations.get(tenant)
+        if src is None or reg is None:
+            raise KeyError('tenant %r is not placed' % tenant)
+        frozen = not enabled() and not _force
+        if to_replica is None:
+            to_replica, sig = self._choose_replica(exclude=(src,))
+        else:
+            to_replica, sig = str(to_replica), self.signals()
+        if to_replica is None or to_replica == src:
+            return None
+        price = self.price_move(tenant)
+        info = {'tenant': tenant, 'from': src, 'to': to_replica,
+                'why': why, 'signals': sig}
+        if frozen:
+            _decide('migrate', {'tenant': tenant, 'to': to_replica},
+                    acted=False, frozen=True, now=now, priced=price,
+                    **info)
+            return None
+        target = self._replicas[to_replica]
+        target.add_program(tenant, reg['program'], reg['feed_names'],
+                           reg['fetch_list'], scope=reg['scope'],
+                           slo_class=reg['slo_class'],
+                           **reg['kwargs'])
+        warm_wall = target.warmup_tenant(tenant, wait=True)
+        with self._ilock:
+            # route flip: new submits land on the warmed target while
+            # the source drains what it already admitted
+            self._placements[tenant] = to_replica
+        self._replicas[src].remove_program(tenant, drain=True)
+        with self._ilock:
+            self._last_move = time.time() if now is None \
+                else float(now)
+        monitor.add('fleet/migrations')
+        _decide('migrate', {'tenant': tenant, 'to': to_replica},
+                acted=True, now=now,
+                priced=dict(price,
+                            measured_warmup_s=round(warm_wall, 6)),
+                expected_gain='tenant leaves the congested replica '
+                              'warm: first target request hits the '
+                              'pre-warmed AOT ladder',
+                **info)
+        return to_replica
+
+    def _balance_loop(self, now, frozen):
+        """One migration per settle window when replica queue depths
+        diverge past ``FLAGS_fleet_imbalance_depth``: the busiest
+        tenant on the deepest replica moves to the shallowest."""
+        if len(self._replicas) < 2:
+            return
+        gap_min = int(get_flag('FLAGS_fleet_imbalance_depth', 8) or 8)
+        sig = self.signals()
+        ordered = sorted(sig, key=lambda r: sig[r]['queue_depth'])
+        cold, hot = ordered[0], ordered[-1]
+        gap = sig[hot]['queue_depth'] - sig[cold]['queue_depth']
+        if gap < gap_min:
+            return
+        interval = float(get_flag('FLAGS_fleet_interval_s', 1.0)
+                         or 1.0)
+        with self._ilock:
+            if now - self._last_move < 4 * interval:
+                return                    # let the last move settle
+            placements = dict(self._placements)
+        hot_srv = self._replicas[hot]
+        try:
+            tenants = hot_srv.resident_report()['tenants']
+        except Exception:
+            return
+        busiest = None
+        for t in tenants:
+            if placements.get(t['tenant']) != hot:
+                continue
+            d = int(t.get('queue_depth') or 0)
+            if busiest is None or d > busiest[1]:
+                busiest = (t['tenant'], d)
+        if busiest is None:
+            return
+        self.migrate(busiest[0], to_replica=cold,
+                     why='queue_imbalance gap=%d' % gap, now=now)
+
+    def _pressure_loop(self, now, frozen):
+        """Memviz budget pressure: a degraded utilization evicts the
+        cheapest tenant fleet-wide (priced) — churn beyond the LRU
+        caps instead of an OOM."""
+        try:
+            from . import memviz
+            pressure = memviz.memory_pressure()
+        except Exception:
+            return
+        if not pressure or not pressure.get('degraded'):
+            return
+        self.evict(why='memory_pressure util=%.3f'
+                   % pressure['utilization'], now=now)
+
+    # -- control loop --------------------------------------------------
+    def tick(self, now=None):
+        """One pass of the class-policy, queue-balance and memory-
+        pressure loops (unconditional — module ``maybe_tick`` is the
+        cadence-gated form)."""
+        now = time.time() if now is None else float(now)
+        frozen = not enabled()
+        monitor.add('fleet/ticks')
+        self._class_loop(now, frozen)
+        self._balance_loop(now, frozen)
+        self._pressure_loop(now, frozen)
+        return now
+
+    # -- revert / lifecycle --------------------------------------------
+    def revert(self, now=None):
+        """One call back to the as-registered posture: every migrated
+        tenant returns to its base replica (pre-warmed — the restored
+        route keeps the zero-retrace contract), class sheds clear and
+        deferred close waits restore.  Works even frozen — revert IS
+        the escape hatch."""
+        now = time.time() if now is None else float(now)
+        restored = {'migrations': 0, 'classes': 0}
+        with self._ilock:
+            moved = [(t, b) for t, b in self._base.items()
+                     if t in self._placements
+                     and self._placements[t] != b]
+            shed = list(self._shed)
+        for t, base in moved:
+            if self.migrate(t, to_replica=base, why='revert', now=now,
+                            _force=True) is not None:
+                restored['migrations'] += 1
+        for cls in shed:
+            self._restore_class_policy(cls)
+            restored['classes'] += 1
+        monitor.add('fleet/reverts')
+        _decide('revert', restored, acted=True, now=now)
+        return restored
+
+    def close(self):
+        """Deregister from the live set (replicas are the caller's to
+        close — a fleet is a routing layer, not an owner)."""
+        self._closed = True
+        with _lock:
+            _live.discard(self)
+
+    # -- surface -------------------------------------------------------
+    def fleet_report(self):
+        """This fleet's /statusz body: replicas with their router
+        signals, the route table, classes, active class policy —
+        everything JSON-able."""
+        with self._ilock:
+            placements = dict(self._placements)
+            base = dict(self._base)
+            classes = dict(self._classes)
+            shed = dict(self._shed)
+            protect = dict(self._protect)
+        return {
+            'name': self.name,
+            'replicas': self.signals(),
+            'placements': placements,
+            'base_placements': base,
+            'classes': classes,
+            'protected': protect,
+            'class_shed': shed,
+            'admit_p99': _admit_p99()[0],
+        }
+
+
+# ------------------------------------------------------------- ticking
+def maybe_tick(now=None):
+    """The sampling-cadence hook (``timeseries.sample``): one weak-set
+    read when no fleet exists, interval-throttled by
+    ``FLAGS_fleet_interval_s`` otherwise.  Never raises."""
+    if not _live:
+        return False
+    now = time.time() if now is None else float(now)
+    interval = float(get_flag('FLAGS_fleet_interval_s', 1.0) or 1.0)
+    if now - _state['last_tick'] < interval:
+        return False
+    with _lock:
+        _state['last_tick'] = now
+        _state['ticks'] += 1
+    ok = False
+    for f in live_fleets():
+        try:
+            f.tick(now=now)
+            ok = True
+        except Exception:
+            monitor.add('fleet/tick_errors')
+    return ok
+
+
+def revert(now=None):
+    """Module-level one-call revert over every live fleet."""
+    return [f.revert(now=now) for f in live_fleets()]
+
+
+def reset():
+    """Test isolation hook (mirrors monitor.reset): drops the decision
+    log and deregisters every fleet."""
+    with _lock:
+        del _decisions[:]
+        _seq[0] = 0
+        _state.update(last_tick=0.0, ticks=0)
+        for f in list(_live):
+            f._closed = True
+        _live.clear()
+
+
+# ------------------------------------------------------------- surface
+def report():
+    """The /statusz 'fleet' section: freeze state, every live fleet's
+    body and the newest decisions — everything JSON-able."""
+    with _lock:
+        decs = list(_decisions)[-50:]
+        total = _seq[0]
+        ticks = _state['ticks']
+    return {
+        'enabled': enabled(),
+        'ticks': ticks,
+        'fleets': [f.fleet_report() for f in live_fleets()],
+        'decisions_total': total,
+        'decisions': decs,
+    }

@@ -330,6 +330,17 @@ def statusz():
             autopilot_section = rep
     except Exception:
         pass
+    # serving fleet (fluid.fleet): per-replica router signals, the
+    # route table, class policy and the priced decision trail —
+    # rendered once a fleet exists or has decided anything
+    fleet_section = None
+    try:
+        from . import fleet
+        rep = fleet.report()
+        if rep.get('fleets') or rep.get('decisions_total'):
+            fleet_section = rep
+    except Exception:
+        pass
     # Pallas kernel library (ops/pallas/common.py): per-kernel fused
     # vs dense dispatch tallies, the LAST decision with its reason
     # (flag_off / off_tpu / below_floor / ...) and the documented
@@ -366,6 +377,7 @@ def statusz():
         'timeseries': timeseries_section,
         'slo': slo_section,
         'autopilot': autopilot_section,
+        'fleet': fleet_section,
         'pallas': pallas_section,
         'job': job_section,
         'flags': _all_flags(),

@@ -565,6 +565,7 @@ def _run_segment_parallel(executor, seg, feed, scope, mesh, ndev, fetched,
         fp = compile_cache.fingerprint(
             seg.ops,
             (_mesh_fingerprint_key(mesh), repr(in_shardings),
+             tuple(sorted(seg.output_names)),
              comms_plan.digest(), _ashard.digest(),
              auto_plan.digest() if auto_plan is not None else None),
             _lowering_flag_items(False, False),

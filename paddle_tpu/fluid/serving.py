@@ -218,10 +218,11 @@ class _Tenant(object):
                  'pending', 'warmed', 'requests', 'batches', 'rows',
                  'retraces', 'cache_hit_batches', 'pad_rows', 'errors',
                  'base_ladder', 'bucket_hits', 'natural_miss_hits',
-                 'close_wait_s')
+                 'close_wait_s', 'slo_class')
 
     def __init__(self, name, program, scope, feed_names, fetch_names,
-                 feed_specs, mask_specs, ladder, fingerprint):
+                 feed_specs, mask_specs, ladder, fingerprint,
+                 slo_class='interactive'):
         self.name = name
         self.program = program
         self.scope = scope
@@ -250,6 +251,9 @@ class _Tenant(object):
         self.bucket_hits = {}
         self.natural_miss_hits = {}
         self.close_wait_s = None
+        # priority/SLO class (fluid.fleet): requests of a shed class
+        # fail fast while the protected class keeps its latency
+        self.slo_class = str(slo_class)
 
     def report(self):
         return {
@@ -272,6 +276,7 @@ class _Tenant(object):
                 str(k): v
                 for k, v in sorted(self.natural_miss_hits.items())},
             'close_wait_s': self.close_wait_s,
+            'slo_class': self.slo_class,
         }
 
 
@@ -303,6 +308,11 @@ class ServingExecutor(object):
         self._tenants = {}
         self._rr = []        # tenant round-robin order
         self._rr_next = 0
+        # per-SLO-class shed latch (fluid.fleet's class policy leg):
+        # {slo_class: reason}.  While a class is latched, submit() for
+        # its tenants fails fast (``serving/shed_class``) — a firing
+        # objective on one class sheds the OTHER instead of both.
+        self._class_shed = {}
         self._cond = threading.Condition()
         self._thread = None
         self._stopping = False
@@ -325,7 +335,8 @@ class ServingExecutor(object):
 
     # -- registration --------------------------------------------------
     def add_program(self, name, program, feed_names, fetch_list,
-                    scope=None, feed_specs=None, bucket_ladder=None):
+                    scope=None, feed_specs=None, bucket_ladder=None,
+                    slo_class='interactive'):
         """Make `program` resident as tenant `name`.
 
         `scope` must already hold the program's parameters (run the
@@ -334,7 +345,10 @@ class ServingExecutor(object):
         (per-row shape, dtype) for feeds whose declared var shape has
         dynamic non-batch dims; everything else is derived from the
         program's var declarations.  `bucket_ladder` overrides the
-        power-of-two row ladder (default: up to ``max_batch``)."""
+        power-of-two row ladder (default: up to ``max_batch``).
+        `slo_class` tags the tenant's priority class (e.g.
+        ``'interactive'`` vs ``'batch'``) — the fleet's class policy
+        sheds/defers by this tag when an objective fires."""
         from . import framework as _fw
         if name in self._tenants:
             raise ValueError('tenant %r already registered' % name)
@@ -403,7 +417,7 @@ class ServingExecutor(object):
             block.ops, (), (), donate=False, purpose='serving-id')[:16]
         tenant = _Tenant(name, program, scope or core.Scope(),
                          feed_names, fetch_names, specs, mask_specs,
-                         ladder, fp)
+                         ladder, fp, slo_class=slo_class)
         with self._cond:
             self._tenants[name] = tenant
             self._rr.append(name)
@@ -458,6 +472,37 @@ class ServingExecutor(object):
                              name='pt_serving_warmup').start()
         return self
 
+    def warmup_tenant(self, name, wait=True, timeout=None):
+        """Pre-compile ONE tenant's whole bucket ladder (the fleet
+        migration's pre-warm leg: the target replica warms just the
+        arriving tenant through the persistent compile cache before
+        the route flips, so migrated traffic keeps the zero-retrace
+        contract).  Returns the measured warmup wall in seconds
+        (wait=True) or 0.0 (wait=False)."""
+        t = self._tenants[name]
+        t0 = _time.perf_counter()
+        results = []
+        for bucket in t.ladder:
+            results.append(self._exe.warmup(
+                t.program,
+                feed_shapes=self._bucket_feed_shapes(t, bucket),
+                fetch_list=t.fetch_names, scope=t.scope))
+            monitor.add('serving/warmup_buckets')
+
+        def finish():
+            for res in results:
+                res.wait(timeout)
+            t.warmed = True
+            wall = _time.perf_counter() - t0
+            monitor.observe('serving/warmup_seconds', wall)
+            return wall
+
+        if wait:
+            return finish()
+        threading.Thread(target=finish, daemon=True,
+                         name='pt_serving_warmup_tenant').start()
+        return 0.0
+
     @property
     def ready(self):
         """True when every registered tenant finished warmup."""
@@ -474,9 +519,12 @@ class ServingExecutor(object):
         time: a request still queued when its deadline passes is shed
         — completed exceptionally with ``DeadlineExpired``
         (``serving/shed_expired``) instead of padded into a batch and
-        dispatched.  While the replica is degraded (supervisor
-        recovery), every submit completes exceptionally with
-        ``ServingDegraded`` immediately."""
+        dispatched; an ALREADY-expired deadline (``deadline_s <= 0``)
+        is shed at admission, before it can queue.  While the replica
+        is degraded (supervisor recovery), every submit completes
+        exceptionally with ``ServingDegraded`` immediately — and so
+        do requests of a shed SLO class (``serving/shed_class``, the
+        fleet's class policy)."""
         from concurrent.futures import Future
         if _degraded_reason is not None:
             # shed, don't queue: a mid-recovery backend answering
@@ -491,6 +539,27 @@ class ServingExecutor(object):
         if t is None:
             raise KeyError('unknown tenant %r (resident: %r)'
                            % (tenant, sorted(self._tenants)))
+        shed_reason = self._class_shed.get(t.slo_class)
+        if shed_reason is not None:
+            # class-based shedding (the fleet's priority leg): a
+            # firing objective on the protected class sheds THIS class
+            # while the protected one keeps serving
+            monitor.add('serving/shed_class')
+            fut = Future()
+            fut.set_exception(ServingDegraded(
+                'class %r shed: %s' % (t.slo_class, shed_reason)))
+            return fut
+        if deadline_s is not None and float(deadline_s) <= 0:
+            # admission-time expiry: a deadline that has already
+            # passed must fail fast HERE, not queue behind live work
+            # only to be shed at batch close
+            monitor.add('serving/shed_expired')
+            fut = Future()
+            fut.set_exception(DeadlineExpired(
+                'request for %r submitted with non-positive deadline '
+                '%.3fs: already expired at admission'
+                % (tenant, float(deadline_s))))
+            return fut
         missing = [n for n in t.feed_names if n not in feed]
         if missing:
             raise ValueError('request for %r missing feeds %r'
@@ -542,14 +611,21 @@ class ServingExecutor(object):
         adapted ``close_wait_s`` a sub-capacity batch keeps queueing
         while its oldest request is younger than the wait.  0 closes
         the window now — the static (no deadline) behavior, a batch
-        already at bucket capacity, or an aged-out oldest request."""
+        already at bucket capacity, an aged-out oldest request, or a
+        queued request whose submit deadline would pass inside the
+        hold (deadline-AWARE closing: coalescing for occupancy must
+        never turn a meetable deadline into a shed)."""
         wait = t.close_wait_s
         if not wait or not t.pending:
             return 0.0
         rows = sum(req.rows for req in t.pending)
         if rows >= t.ladder[-1]:
             return 0.0
-        remaining = wait - (_time.perf_counter() - t.pending[0].t_admit)
+        now = _time.perf_counter()
+        remaining = wait - (now - t.pending[0].t_admit)
+        for req in t.pending:
+            if req.deadline is not None:
+                remaining = min(remaining, req.deadline - now)
         return remaining if remaining > 0 else 0.0
 
     def _take_batch(self, wait_s):
@@ -800,6 +876,80 @@ class ServingExecutor(object):
         t.close_wait_s = float(wait_s) if wait_s else None
         return t.close_wait_s
 
+    # -- SLO-class policy (fluid.fleet) --------------------------------
+    def set_class_shed(self, slo_class, reason):
+        """Latch one SLO class into shed: every submit() for a tenant
+        of this class fails fast with ``ServingDegraded``
+        (``serving/shed_class``) until ``clear_class_shed`` — the
+        fleet's 'shed the batch class, protect the interactive one'
+        move.  Already-queued requests of the class still serve (they
+        were admitted under the old policy)."""
+        with self._cond:
+            self._class_shed[str(slo_class)] = str(reason)
+        monitor.set_gauge('serving/class_shed', len(self._class_shed))
+
+    def clear_class_shed(self, slo_class=None):
+        """Clear one class's shed latch (or all with None)."""
+        with self._cond:
+            if slo_class is None:
+                self._class_shed.clear()
+            else:
+                self._class_shed.pop(str(slo_class), None)
+        monitor.set_gauge('serving/class_shed', len(self._class_shed))
+
+    def class_shed(self):
+        """{slo_class: reason} snapshot of the shed latches."""
+        with self._cond:
+            return dict(self._class_shed)
+
+    def tenants_of_class(self, slo_class):
+        """Resident tenant names carrying `slo_class` (the fleet's
+        defer leg iterates these to widen close waits)."""
+        return [t.name for t in self._tenant_list()
+                if t.slo_class == str(slo_class)]
+
+    # -- eviction (fluid.fleet churn policy) ---------------------------
+    def remove_program(self, name, drain=True, timeout=30.0):
+        """Evict tenant `name`: stop admitting (unknown-tenant errors
+        from now on), optionally drain its queued requests through the
+        dispatcher, then drop it from the registry so its scope's
+        device residency is releasable (memviz stops attributing it
+        once the caller drops its own references).  The fleet prices
+        this against the re-warmup wall a return would cost through
+        the persistent compile cache.  Counted
+        ``serving/tenant_evicted``."""
+        with self._cond:
+            t = self._tenants.get(name)
+            if t is None:
+                raise KeyError('unknown tenant %r' % name)
+            if not drain:
+                while t.pending:
+                    _deliver(t.pending.popleft().future,
+                             exc=RuntimeError(
+                                 'tenant %r evicted' % name))
+        if drain:
+            deadline = _time.perf_counter() + float(timeout)
+            while True:
+                with self._cond:
+                    if not t.pending:
+                        break
+                    self._cond.notify()
+                if _time.perf_counter() > deadline:
+                    raise RuntimeError(
+                        'tenant %r drain timed out with %d queued'
+                        % (name, len(t.pending)))
+                _time.sleep(0.002)
+        with self._cond:
+            self._tenants.pop(name, None)
+            if name in self._rr:
+                self._rr.remove(name)
+                self._rr_next = self._rr_next % max(1, len(self._rr))
+        monitor.add('serving/tenant_evicted')
+        monitor.set_gauge('serving/resident_programs',
+                          len(self._tenants))
+        monitor.set_gauge('serving/queue_depth/%s' % name, 0.0)
+        return t
+
     # -- lifecycle / status --------------------------------------------
     def stop(self, drain=True):
         """Stop the dispatcher.  `drain=True` serves queued requests
@@ -838,6 +988,7 @@ class ServingExecutor(object):
             'ready': all(t.warmed for t in tenants),
             'max_batch': self.max_batch,
             'tenants': [t.report() for t in tenants],
+            'class_shed': self.class_shed(),
             'compile_plane': compile_cache.plane().stats(),
         }
 

@@ -132,6 +132,13 @@ def sample(step=None, now=None):
         autopilot.maybe_tick(now=now)
     except Exception:
         monitor.add('autopilot/tick_errors')
+    # the serving fleet's class/balance/pressure loops ride here too
+    # (one weak-set read when no fleet exists)
+    try:
+        from . import fleet
+        fleet.maybe_tick(now=now)
+    except Exception:
+        monitor.add('fleet/tick_errors')
 
 
 def job_sample(rank, state, now=None):

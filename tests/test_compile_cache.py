@@ -471,3 +471,28 @@ def test_lru_cache_semantics():
     for i in range(100):
         unbounded[i] = i
     assert len(unbounded) == 100
+
+
+def test_fetch_set_keys_executable_identity(plane_dir):
+    """The check_grad two-fetch pattern with the plane ACTIVE: the
+    same program planned for the analytic-grad fetch set and then for
+    the loss fetch set shares its op list between the two segments,
+    but each exports DIFFERENT vars.  The fingerprint folds the
+    segment's output_names in, so the second plan compiles its own
+    executable instead of taking a content-addressed hit on the
+    first's (which returns the wrong vars — 'fetch var not
+    produced')."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from op_test import OpTest
+    ot = OpTest()
+    ot.grad_atol = ot.grad_rtol = 2e-2
+    ot.check_grad(
+        'sum',
+        {'X': [('x0', np.random.RandomState(7).rand(3, 4)
+                .astype('float32')),
+               ('x1', np.random.RandomState(8).rand(3, 4)
+                .astype('float32'))]},
+        attrs={}, out_slot='Out')
+    # and the distinct executables both landed in the store
+    assert len(_seg_entries(plane_dir)) >= 2

@@ -571,6 +571,40 @@ def test_serving_sheds_expired_requests_instead_of_dispatching():
         srv.close()
 
 
+def test_serving_admission_rejects_expired_deadline():
+    """A non-positive deadline fails fast AT ADMISSION — the request
+    never queues, never reaches the dispatcher."""
+    from paddle_tpu.fluid import serving
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    from paddle_tpu.fluid import unique_name
+    with unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = layers.data('x', shape=[4], dtype='float32')
+            out = layers.fc(x, 4)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+    srv = serving.ServingExecutor(max_batch=8, executor=exe)
+    try:
+        srv.add_program('t', main, ['x'], [out], scope=scope)
+        feed = {'x': np.ones((2, 4), 'float32')}
+        for dl in (0.0, -1.0):
+            fut = srv.submit('t', feed, deadline_s=dl)
+            assert fut.done()          # failed at admission, no queue
+            with pytest.raises(serving.DeadlineExpired):
+                fut.result(timeout=0)
+        assert monitor.counter_value('serving/shed_expired') == 2
+        # nothing was admitted: the tenant queue never saw them
+        assert len(srv._tenants['t'].pending) == 0
+        # a live deadline still serves
+        res = srv.submit('t', feed, deadline_s=60.0).result(timeout=30)
+        assert res[0].shape == (2, 4)
+    finally:
+        srv.close()
+
+
 def test_serving_degraded_sheds_and_flips_readiness():
     from paddle_tpu.fluid import serving
     main, startup = fluid.Program(), fluid.Program()

@@ -1,0 +1,224 @@
+"""Fleet-plane gate: a live multi-replica fleet under a real skewed-
+tenant soak must route, migrate and observe correctly (the
+fluid.fleet analog of check_serving.py's single-replica gate).
+
+Runs one in-process sequence:
+
+  1. TWO ServingExecutor replicas behind one Fleet, three tenants
+     (router-scored placement must spread them), full-ladder warmup;
+  2. a two-thread SKEWED soak (~70% of traffic on one hot tenant,
+     mixed row counts) through ``fleet.submit`` — sticky routing
+     (placements unchanged), zero post-warmup retraces, every request
+     served by its placed replica;
+  3. a priced migration of the hot tenant mid-soak-shape traffic:
+     bitwise-equal results on the target, zero retraces after the
+     pre-warm, the decision log carries the price;
+  4. router decisions observable over HTTP: ``/statusz`` must carry
+     the ``fleet`` section (replicas, placements, decision trail) and
+     the merged ``/metrics`` must pass the fluid.health prom_lint;
+  5. disabled-path budget: with no live fleet, ``fleet.maybe_tick``
+     must cost one weak-set read (10k ticks under a wall budget) and
+     leave no ``fleet/*`` counters behind.
+
+Run from `make check` (CPU: JAX_PLATFORMS=cpu).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+SOAK_REQUESTS_PER_THREAD = 24
+DISABLED_TICKS = 10000
+DISABLED_BUDGET_S = 0.5
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode('utf-8')
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode('utf-8')
+
+
+def main():
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import (fleet, health, layers, memviz,
+                                  monitor, serving)
+
+    failures = []
+
+    def build(width, seed):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main_p, startup):
+            x = layers.data('x', shape=[16], dtype='float32')
+            h = layers.fc(x, width, act='relu')
+            y = layers.fc(h, 10, act='softmax')
+        return main_p, startup, y
+
+    # -- 5 (first: needs a clean registry). disabled-path budget ------
+    t0 = time.perf_counter()
+    for _ in range(DISABLED_TICKS):
+        fleet.maybe_tick()
+    wall = time.perf_counter() - t0
+    if wall > DISABLED_BUDGET_S:
+        failures.append('no-fleet maybe_tick cost %.3fs for %d calls '
+                        '(budget %.1fs): the disabled plane must be '
+                        'one weak-set read'
+                        % (wall, DISABLED_TICKS, DISABLED_BUDGET_S))
+    if monitor.counter_value('fleet/ticks'):
+        failures.append('no-fleet maybe_tick left fleet/ticks = %g'
+                        % monitor.counter_value('fleet/ticks'))
+
+    # -- 1. two replicas, three tenants, scored spread ----------------
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    fl = fleet.Fleet()
+    for i in range(2):
+        fl.add_replica('r%d' % i,
+                       serving.ServingExecutor(max_batch=8,
+                                               executor=exe))
+    tenants = {}
+    for name, (w, s, cls) in (('hot', (32, 11, 'interactive')),
+                              ('warm', (48, 12, 'interactive')),
+                              ('cold', (24, 13, 'batch'))):
+        mp, sp, y = build(w, s)
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(sp)
+        tenants[name] = (mp, sc, y)
+        fl.register_tenant(name, mp, ['x'], [y], scope=sc,
+                           slo_class=cls)
+    placed = fl.placement()
+    if set(placed.values()) != {'r0', 'r1'}:
+        failures.append('router packed every tenant onto %r (want a '
+                        'spread across both replicas)'
+                        % sorted(set(placed.values())))
+    fl.warmup(wait=True)
+    memviz.live_census()      # the pricing input for leg 3
+
+    # -- 2. skewed two-thread soak: sticky, zero-retrace --------------
+    lowered0 = monitor.counter_value('executor/segments_lowered')
+    results = {}
+    errors = []
+
+    def feeder(tid):
+        rng = np.random.RandomState(100 + tid)
+        for i in range(SOAK_REQUESTS_PER_THREAD):
+            # ~70% of traffic on the hot tenant — the skew the router
+            # and balance loop exist for
+            name = ('hot', 'hot', 'hot', 'warm', 'hot',
+                    'cold', 'hot', 'hot', 'warm', 'hot')[i % 10]
+            rows = (1, 3, 2, 7, 4)[i % 5]
+            xv = rng.randn(rows, 16).astype('float32')
+            try:
+                out, = fl.submit(name, {'x': xv}).result(120)
+                results[(tid, i)] = (name, xv, np.asarray(out))
+            except Exception as e:  # noqa: BLE001
+                errors.append('feeder %d req %d: %s' % (tid, i, e))
+
+    threads = [threading.Thread(target=feeder, args=(tid,))
+               for tid in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    if errors:
+        failures.append('soak errors: %s' % '; '.join(errors[:3]))
+    if len(results) != 2 * SOAK_REQUESTS_PER_THREAD:
+        failures.append('soak served %d/%d requests'
+                        % (len(results),
+                           2 * SOAK_REQUESTS_PER_THREAD))
+    lowered_soak = monitor.counter_value(
+        'executor/segments_lowered') - lowered0
+    if lowered_soak:
+        failures.append('fleet soak retraced: %g segments lowered '
+                        'after warmup' % lowered_soak)
+    if fl.placement() != placed:
+        failures.append('soak moved placements %r -> %r (stickiness)'
+                        % (placed, fl.placement()))
+    routed = monitor.counter_value('fleet/routed_requests')
+    if routed != 2 * SOAK_REQUESTS_PER_THREAD:
+        failures.append('fleet/routed_requests %g != %d'
+                        % (routed, 2 * SOAK_REQUESTS_PER_THREAD))
+
+    # -- 3. priced migration of the hot tenant ------------------------
+    rng = np.random.RandomState(7)
+    xv = rng.randn(3, 16).astype('float32')
+    before = np.asarray(fl.submit('hot', {'x': xv}).result(120)[0])
+    src = fl.placement('hot')
+    tgt = fl.migrate('hot', why='check_fleet')
+    if tgt is None or tgt == src:
+        failures.append('migration returned %r (from %r)' % (tgt, src))
+    lowered_mig = monitor.counter_value('executor/segments_lowered')
+    after = np.asarray(fl.submit('hot', {'x': xv}).result(120)[0])
+    if not np.array_equal(before, after):
+        failures.append('post-migration result differs bitwise')
+    if monitor.counter_value('executor/segments_lowered') != \
+            lowered_mig:
+        failures.append('post-migration submit retraced')
+    migs = [d for d in fleet.decisions() if d['kind'] == 'migrate'
+            and d['acted']]
+    if not migs:
+        failures.append('no acted migrate decision in the log')
+    else:
+        priced = migs[-1]['info'].get('priced') or {}
+        if 'residency_bytes' not in priced or \
+                'measured_warmup_s' not in priced:
+            failures.append('migrate decision not priced: %r' % priced)
+
+    # -- 4. decisions over HTTP + lint-clean /metrics -----------------
+    srv = health.serve(port=0)
+    try:
+        code, text = _get(srv.url + '/statusz')
+        sec = (json.loads(text) or {}).get('fleet') if code == 200 \
+            else None
+        if code != 200 or not sec:
+            failures.append('/statusz fleet section missing '
+                            '(HTTP %s)' % code)
+        else:
+            body = (sec.get('fleets') or [{}])[0]
+            if set(body.get('replicas', {})) != {'r0', 'r1'}:
+                failures.append('/statusz fleet replicas %r'
+                                % sorted(body.get('replicas', {})))
+            if not sec.get('decisions'):
+                failures.append('/statusz fleet carries no decisions')
+            kinds = {d['kind'] for d in sec.get('decisions', ())}
+            if 'place' not in kinds or 'migrate' not in kinds:
+                failures.append('/statusz fleet decision kinds %r '
+                                'missing place/migrate'
+                                % sorted(kinds))
+        code, text = _get(srv.url + '/metrics')
+        problems = health.prom_lint(text)
+        if code != 200:
+            failures.append('/metrics HTTP %s' % code)
+        if problems:
+            failures.append('/metrics lint: %s'
+                            % '; '.join(problems[:5]))
+    finally:
+        srv.stop()
+
+    for s in fl.replicas().values():
+        s.close()
+    fl.close()
+    print('fleet soak: %d requests over 2 replicas, placements %s, '
+          '%g retraces, %d decisions'
+          % (len(results), placed, lowered_soak,
+             len(fleet.decisions())))
+    if failures:
+        for f in failures:
+            print('FAIL  ' + f)
+        return 1
+    print('fleet plane: OK')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -383,6 +383,27 @@ REQUIRED = [
     ('paddle_tpu/fluid/serving.py', 'serving/bucket_prewarmed'),
     ('paddle_tpu/fluid/serving.py', 'serving/pad_waste_ratio'),
     ('paddle_tpu/fluid/serving.py', 'serving/close_wait_holds'),
+    # serving fleet (fluid/fleet.py): the cross-replica router's
+    # decision log, sticky routing, class policy and priced
+    # eviction/migration accounting — tools/check_fleet.py closes the
+    # loop against a live two-replica skewed soak
+    ('paddle_tpu/fluid/fleet.py', 'fleet/decisions'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/decision/'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/frozen_intents'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/routed_requests'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/placements'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/migrations'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/evictions'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/reverts'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/ticks'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/class_shed'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/class_restored'),
+    ('paddle_tpu/fluid/fleet.py', 'fleet/replicas'),
+    ('paddle_tpu/fluid/timeseries.py', 'fleet/tick_errors'),
+    ('paddle_tpu/fluid/serving.py', 'serving/shed_class'),
+    ('paddle_tpu/fluid/serving.py', 'serving/tenant_evicted'),
+    ('paddle_tpu/fluid/serving.py', 'serving/warmup_buckets'),
+    ('paddle_tpu/fluid/health.py', "'fleet':"),
 ]
 
 
