@@ -96,7 +96,12 @@ bench:
 # serving fleet must route a skewed-tenant soak across two live
 # replicas sticky and retrace-free, land a priced migration bitwise-
 # equal, surface its decisions over HTTP, and cost one weak-set read
-# when no fleet exists
+# when no fleet exists; and the op-cost attribution plane must replay
+# a warmed LeNet into per-instance rows whose segment sums agree with
+# the step report's dispatch wall within 10%, emit a schema-valid
+# op_worklist.json naming >= 3 ranked candidates with the warmed adam
+# run cross-referenced to pallas/fused_optimizer, serve /statusz
+# op_costs + /opprof live, and cost one flag read per step when off
 check:
 	python tools/check_stat_coverage.py
 	python tools/staticcheck.py
@@ -108,6 +113,7 @@ check:
 	JAX_PLATFORMS=cpu python tools/check_serving.py
 	JAX_PLATFORMS=cpu python tools/check_comms.py
 	JAX_PLATFORMS=cpu python tools/check_memviz.py
+	JAX_PLATFORMS=cpu python tools/check_opprof.py
 	JAX_PLATFORMS=cpu python tools/check_autoshard.py
 	JAX_PLATFORMS=cpu python tools/check_elastic.py
 	JAX_PLATFORMS=cpu python tools/check_supervisor.py

@@ -1543,6 +1543,74 @@ def bench_memviz_overhead(depth=4, width=64, batch=32, steps=60,
                 **_monitor_fields())
 
 
+def bench_opprof_overhead(depth=4, width=64, batch=32, steps=60,
+                          warmup=8):
+    """FLAGS_opprof on/off A/B on one small MLP: the BENCH JSON
+    records the per-step cost of the op-cost attribution plane
+    (snapshot-step survivable copies + the synchronous dispatch that
+    measures the segment wall) AND enforces the 'costs one flag read
+    when off' claim — the off posture must record zero segment
+    snapshots (tools/check_opprof.py gates the counter budgets; this
+    publishes the wall-clock trajectory so a snapshot path that
+    starts leaking into non-snapshot steps is visible).  The flag is
+    fingerprint-neutral, so both postures share one program +
+    executor — flipping it mid-run causes zero retraces."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import monitor, opprof
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[width], dtype='float32')
+        h = x
+        for _ in range(depth):
+            h = fluid.layers.fc(h, size=width, act='relu')
+        loss = fluid.layers.reduce_mean(fluid.layers.square(h))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    feed = {'x': jax.device_put(np.ones((batch, width), 'float32'))}
+
+    def timed(flag_on):
+        # the flag gates only the snapshot/instance-naming plane
+        # (never the plan or the fingerprint), so both postures share
+        # one program + executor
+        fluid.flags.set_flags({'FLAGS_opprof': flag_on})
+        try:
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.XLAPlace(0))
+                exe.run(startup)
+                for _ in range(warmup):
+                    exe.run(main, feed=feed, fetch_list=[])
+                pname = main.all_parameters()[0].name
+                jax.block_until_ready(scope.find_var(pname))
+                t0 = time.time()
+                for _ in range(steps):
+                    exe.run(main, feed=feed, fetch_list=[])
+                    jax.block_until_ready(scope.find_var(pname))
+                return (time.time() - t0) / steps
+        finally:
+            fluid.flags.set_flags({'FLAGS_opprof': False})
+
+    opprof.reset()
+    off_s = timed(False)
+    snaps_off = monitor.counter_value('opprof/snapshots')
+    on_s = timed(True)
+    snaps_on = monitor.counter_value('opprof/snapshots') - snaps_off
+    return dict({'metric': 'opprof_overhead_us_per_step_d%d' % depth,
+                 'value': round((on_s - off_s) * 1e6, 1),
+                 'unit': 'us/step',
+                 'opprof_overhead': {
+                     'off_us_per_step': round(off_s * 1e6, 1),
+                     'on_us_per_step': round(on_s * 1e6, 1),
+                     'overhead_pct': round(
+                         100.0 * (on_s - off_s) / max(off_s, 1e-12),
+                         1),
+                     'snapshots_recorded_off': snaps_off,
+                     'snapshots_recorded_on': snaps_on}},
+                **_monitor_fields())
+
+
 def bench_parallel(batch=256, width=256, steps=30, warmup=5,
                    skew_seconds=20.0):
     """Collective-job bench (BENCH_comms.json): a GradAllReduce MLP
@@ -2367,6 +2435,7 @@ def _skew_job_fields(run_for):
 SMOKE_BENCHES = (('dispatch', {}),
                  ('health_overhead', {}),
                  ('memviz_overhead', {}),
+                 ('opprof_overhead', {}),
                  ('lenet', {'batch': 64, 'steps': 30}))
 
 

@@ -29,6 +29,7 @@ from . import faultinject as _finject
 from . import framework
 from . import memviz as _memviz
 from . import monitor
+from . import opprof as _opprof
 from . import supervisor as _sup
 from . import timeseries as _tseries
 from . import trace as _trace
@@ -444,8 +445,14 @@ def _lower_fused_opt_run(run, env, step, prefer_test):
                 raise err from e
     ctx = registry.LowerCtx(step, run[0].attrs.get('__op_seed__', 0),
                             prefer_test)
+    # instance provenance (FLAGS_opprof): the fused run anchors its
+    # scope at the first member's block index, so a device capture
+    # still resolves the launch to a specific op desc.  Trace-time
+    # only, and never part of the segment fingerprint.
+    scope_name = (_opprof.op_scope(run[0], fused_type)
+                  if _opprof.instancing() else fused_type)
     try:
-        with jax.named_scope(fused_type):
+        with jax.named_scope(scope_name):
             outs = opdef.run(ctx, ins, dict(run[0].attrs))
     except Exception as e:
         _add_note(e, 'while lowering a fused run of %d %s ops (%s)'
@@ -471,12 +478,19 @@ def _lower_ops(ops, env, step, prefer_test):
                     'conditional_block': _lower_conditional_block,
                     'while_grad': _lower_while_grad,
                     'conditional_block_grad': _lower_conditional_block_grad}
+    # instance-suffixed scope names (FLAGS_opprof): read once per
+    # lowering walk — lowerings run at trace time, never per step.
+    # Scope names do not enter compile_cache.fingerprint (it hashes
+    # op descs + specs + lowering flags), so this flag is
+    # fingerprint-neutral: flipping it causes zero retraces.
+    inst = _opprof.instancing()
     i = 0
     while i < len(ops):
         op = ops[i]
         cf = CF_LOWERINGS.get(op.type)
         if cf is not None:
-            with jax.named_scope(op.type):
+            with jax.named_scope(_opprof.op_scope(op) if inst
+                                 else op.type):
                 cf(op, env, step, prefer_test)
             i += 1
             continue
@@ -504,8 +518,11 @@ def _lower_ops(ops, env, step, prefer_test):
             # per-op trace attribution: the reference wraps every op run
             # in a profiler RecordEvent (framework/operator.cc:170); here
             # the scope name flows into XLA op metadata so Perfetto
-            # traces and HLO dumps read as fluid op names
-            with jax.named_scope(op.type):
+            # traces and HLO dumps read as fluid op names — with the
+            # '#<block-index>' instance suffix under FLAGS_opprof, so
+            # two fc layers stay distinguishable in a capture
+            with jax.named_scope(_opprof.op_scope(op) if inst
+                                 else op.type):
                 outs = opdef.run(ctx, ins, op.attrs)
         except Exception as e:
             # enforce-style error context (reference: PADDLE_ENFORCE +
@@ -1311,6 +1328,7 @@ class Executor(object):
     def __init__(self, place=None):
         self.place = place or core.XLAPlace(0)
         self._step = 0
+        self._opprof_step = False
         # FLAGS_status_port: the status/metrics HTTP plane starts with
         # the first executor (no-op when the flag is 0 or a server is
         # already up)
@@ -2139,6 +2157,11 @@ class Executor(object):
         gate) and the flag-gated live-memory sampler ride here, so
         BOTH per-step entry points (Executor.run, CompiledPipeline)
         are covered.  Disabled memviz cost: one flag read per step."""
+        # op-cost snapshot decision for this step (fluid.opprof): one
+        # flag read when FLAGS_opprof is off — the memviz deal; both
+        # per-step entry points (Executor.run, CompiledPipeline) pass
+        # through here
+        self._opprof_step = _opprof.want_snapshot(self._step)
         with _memviz.program_scope(_memviz.program_label(program)):
             out = self._run_plan_inner(program, plan, feed,
                                        fetch_names, scope,
@@ -2315,6 +2338,18 @@ class Executor(object):
             with _trace.span('nan_snapshot'):
                 replay = ({n: _survivable_copy(v)
                            for n, v in state.items()}, dict(data))
+        opprof_snap = None
+        opprof_wall = None
+        if self._opprof_step:
+            # op-cost replay snapshot (fluid.opprof): same survivable-
+            # copy rule as the nan path — the donated state buffers
+            # are gone after the step; reuse a live nan snapshot
+            # instead of copying twice
+            if replay is not None:
+                opprof_snap = (dict(replay[0]), dict(data))
+            else:
+                opprof_snap = ({n: _survivable_copy(v)
+                                for n, v in state.items()}, dict(data))
         prev_params = None
         hp = None
         if health_on:
@@ -2463,6 +2498,21 @@ class Executor(object):
                                 len(seg.ops),
                                 ','.join(sorted(seg.output_names)[:3])),
                             step_timeout, step=self._step)
+                elif opprof_snap is not None and not first_run:
+                    # opprof snapshot step: park the sync INSIDE the
+                    # dispatch span so the measured wall — the eager-
+                    # replay normalization target — is this segment's
+                    # synchronous device time, and step_report's
+                    # dispatch phase carries the same number the
+                    # attribution sums are checked against.  Costs the
+                    # dispatch/compute overlap on snapshot steps only
+                    # (an opt-in profiling posture).
+                    with _trace.span('dispatch'):
+                        t_sync0 = _time_mod.perf_counter()
+                        out = _call(compiled)
+                        jax.block_until_ready(out)
+                        opprof_wall = (_time_mod.perf_counter() -
+                                       t_sync0)
                 else:
                     with _trace.span('compile' if first_run
                                      else 'dispatch'):
@@ -2507,6 +2557,13 @@ class Executor(object):
                               'steps) dumped to %s'
                               % (len(_trace.steps()), dump))
             raise
+        if opprof_snap is not None and opprof_wall is not None:
+            _opprof.note_segment(
+                _memviz.current_program(),
+                '%dops:%s' % (len(seg.ops),
+                              ','.join(sorted(seg.output_names)[:3])),
+                seg.ops, opprof_snap[0], opprof_snap[1], self._step,
+                seg.prefer_test, opprof_wall)
         if check_nan:
             self._check_nan_inf(out, seg=seg, replay=replay)
         if health_on and hp is not None and hp[0]:

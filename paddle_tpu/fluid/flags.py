@@ -187,6 +187,21 @@ _DEFAULTS = {
     # rate limits for the detector and OOM-incident flight dumps
     'FLAGS_memviz_dump_interval_s': 60.0,
     'FLAGS_memviz_oom_interval_s': 30.0,
+    # op-level cost attribution plane (fluid/opprof.py): FLAGS_opprof
+    # turns on (a) instance-suffixed per-op scope names
+    # ('<type>#<block-index>', trace-time only, fingerprint-neutral —
+    # flipping it retraces nothing) so device captures resolve to a
+    # specific op desc, and (b) the per-step replay-snapshot sampler:
+    # on snapshot steps the executor stashes each warmed segment's
+    # bound inputs + measured synchronous wall for the on-demand
+    # eager replay profiler (/opprof, tools/op_costs.py).  Off (the
+    # default) the executor pays one flag read per step (bench.py
+    # --smoke opprof_overhead proves it).
+    'FLAGS_opprof': False,
+    # snapshot cadence: stash replay inputs every N'th step (snapshot
+    # steps sync the dispatch to measure the segment wall, losing
+    # overlap, so they are thinned by default)
+    'FLAGS_opprof_snapshot_steps': 16,
     # auto-sharding planner (parallel/plan.py): with the flag on, an
     # UNANNOTATED CompiledProgram (no with_mesh / with_param_shardings)
     # is planned automatically — regex rule -> PartitionSpec matching

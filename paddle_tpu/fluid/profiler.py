@@ -32,6 +32,7 @@ stop_trace() + tools/timeline.py remain the raw Perfetto capture.
 
 import contextlib
 import os
+import re
 
 import jax
 
@@ -103,7 +104,26 @@ def _registered_op_types():
     return set(registry._REGISTRY)
 
 
-def attribute_trace_events(events, op_types=None):
+def _resolve_component(comp, op_types, per_instance):
+    """One scope-path component -> attribution name or None.  Strips
+    transform wrappers (transpose(jvp(relu))) and, in per-instance
+    mode, resolves '<type>#<idx>' instance suffixes (the FLAGS_opprof
+    scope names) to the full instance name."""
+    base = comp
+    while '(' in base and base.endswith(')'):
+        base = base[base.index('(') + 1:-1]
+    for cand in (comp, base):
+        if cand in op_types:
+            return cand
+        if per_instance and '#' in cand:
+            typ = cand.rsplit('#', 1)[0]
+            if typ in op_types:
+                return cand
+    return None
+
+
+def attribute_trace_events(events, op_types=None, per_instance=False,
+                           with_stats=False):
     """Map device-trace kernel events back to fluid op types.
 
     `events` are chrome-trace events (trace.json 'traceEvents').  Each
@@ -116,51 +136,93 @@ def attribute_trace_events(events, op_types=None):
     'unattributed/<hlo name>'.  Returns {name: [calls, total_s, max_s,
     min_s]}.
 
+    `per_instance=True` (the fluid.opprof mode) resolves the
+    '<type>#<block-index>' instance scopes FLAGS_opprof emits, and
+    splits FUSED kernel time across constituent ops: a fusion event
+    whose tf_op carries multiple ';'/','-separated source paths has
+    its duration divided equally among them, with the shares of
+    unresolvable constituents filed under the honest
+    'unattributed/<hlo name>' bucket rather than inflating the ops
+    that did match.
+
     Tolerant by contract: real captures contain malformed rows (counter
     events without dur, instant events, non-string tf_op metadata,
     null fields) — those are skipped or zero-timed, never raised on,
-    so one odd event cannot lose a whole profile."""
+    so one odd event cannot lose a whole profile.  `with_stats=True`
+    returns (recs, {'events', 'attributed', 'dropped'}) so skipped
+    rows are COUNTED, not silently eaten.
+
+    Both positive and negative lookups are cached per tf_op string
+    (a capture repeats each unattributed scope on every step; without
+    the negative cache every repeat re-splits the path)."""
     op_types = op_types or _registered_op_types()
     recs = {}
-    cache = {}
+    cache = {}   # tf_op -> tuple(resolved names) | () for negative
+    n_events = n_attr = dropped = 0
+
+    def _fold(name, sec, calls=1):
+        rec = recs.get(name)
+        if rec is None:
+            recs[name] = [calls, sec, sec, sec]
+        else:
+            rec[0] += calls
+            rec[1] += sec
+            rec[2] = max(rec[2], sec)
+            rec[3] = min(rec[3], sec)
+
     for e in events:
-        if not isinstance(e, dict) or e.get('ph') != 'X':
+        if not isinstance(e, dict):
+            dropped += 1
             continue
+        if e.get('ph') != 'X':
+            continue   # counter/instant/metadata rows are filtered by
+        n_events += 1  # design, not malformed
         args = e.get('args') or {}
         tf_op = args.get('tf_op') if isinstance(args, dict) else None
         if not tf_op or not isinstance(tf_op, str):
+            dropped += 1
             continue
-        name = cache.get(tf_op)
-        if name is None:
-            for comp in tf_op.split('/'):
-                # strip transform wrappers: transpose(jvp(relu)) etc.
-                base = comp
-                while '(' in base and base.endswith(')'):
-                    base = base[base.index('(') + 1:-1]
-                if comp in op_types:
-                    name = comp
-                    break
-                if base in op_types:
-                    name = base
-                    break
-            if name is not None:
-                cache[tf_op] = name
-        if name is None:
-            # per-HLO-name bucket; NOT cached on tf_op — distinct
-            # kernels can share a scope path
-            name = 'unattributed/' + str(e.get('name', '?')).split('.')[0]
         try:
             sec = float(e.get('dur') or 0) * 1e-6
         except (TypeError, ValueError):
             sec = 0.0
-        rec = recs.get(name)
-        if rec is None:
-            recs[name] = [1, sec, sec, sec]
-        else:
-            rec[0] += 1
-            rec[1] += sec
-            rec[2] = max(rec[2], sec)
-            rec[3] = min(rec[3], sec)
+        hit = cache.get(tf_op)
+        if hit is None:
+            if per_instance:
+                # fusion events carry multiple source paths; each path
+                # resolves (or not) independently
+                paths = [p for p in re.split('[;,]', tf_op) if p]
+            else:
+                paths = [tf_op]
+            resolved = []
+            for p in paths:
+                name = None
+                for comp in p.split('/'):
+                    name = _resolve_component(comp, op_types,
+                                              per_instance)
+                    if name is not None:
+                        break
+                resolved.append(name)
+            hit = tuple(resolved)
+            cache[tf_op] = hit   # negative ((None,)*n) cached too
+        matched = [n for n in hit if n is not None]
+        if not matched:
+            # per-HLO-name bucket: distinct kernels share a scope
+            # path, so the bucket keys on the event name instead
+            _fold('unattributed/' +
+                  str(e.get('name', '?')).split('.')[0], sec)
+            continue
+        n_attr += 1
+        share = sec / len(hit)
+        leftover = share * (len(hit) - len(matched))
+        for name in matched:
+            _fold(name, share)
+        if leftover > 0:
+            _fold('unattributed/' +
+                  str(e.get('name', '?')).split('.')[0], leftover)
+    if with_stats:
+        return recs, {'events': n_events, 'attributed': n_attr,
+                      'dropped': dropped}
     return recs
 
 
@@ -274,7 +336,14 @@ def stop_profiler(sorted_key='total', profile_path=None):
             # capture keeps recording (and buffering) forever
             host_cap = trace_mod.detach_capture()
         device_events = _load_trace_events(_prof_trace_dir)
-        _records.update(attribute_trace_events(device_events))
+        recs, stats = attribute_trace_events(device_events,
+                                             with_stats=True)
+        _records.update(recs)
+        if stats['dropped']:
+            # malformed capture rows are counted, not silently eaten
+            from . import monitor as _monitor
+            _monitor.add('profiler/dropped_events',
+                         float(stats['dropped']))
         shutil.rmtree(_prof_trace_dir, ignore_errors=True)
         _prof_trace_dir = None
     _mode = 'Serial'

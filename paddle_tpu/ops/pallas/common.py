@@ -44,21 +44,42 @@ _FALLBACK_REASONS = ('flag_off', 'off_tpu', 'below_floor',
                      'vmem_over_budget', 'dtype', 'layout')
 
 
-def register_kernel(name, dense_fallback, has_vjp=False, doc=''):
+def register_kernel(name, dense_fallback, has_vjp=False, doc='',
+                    op_types=()):
     """Declare a kernel in the library.  ``dense_fallback`` names the
     dense JAX reference the dispatch layer falls back to (a function
     path string — documentation + check_kernels assertion, not a
-    callable, so registration never imports lowering code)."""
+    callable, so registration never imports lowering code).
+    ``op_types`` names the fluid op types the kernel's fused launch
+    subsumes — the coverage metadata ``fluid.opprof.kernel_worklist``
+    cross-references to mark candidate op runs already served by an
+    existing kernel."""
     if not dense_fallback:
         raise ValueError('pallas kernel %r must declare its dense '
                          'fallback' % (name,))
     KERNELS[name] = {'dense_fallback': dense_fallback,
-                     'has_vjp': bool(has_vjp), 'doc': doc}
+                     'has_vjp': bool(has_vjp), 'doc': doc,
+                     'op_types': tuple(op_types)}
     return name
 
 
 def kernels():
     return dict(KERNELS)
+
+
+def covering_kernel(op_types):
+    """Name of the registered kernel whose declared ``op_types``
+    coverage subsumes every type in `op_types`, or None — the
+    worklist's 'already fused' cross-reference.  Deterministic: first
+    match in sorted registry order."""
+    ts = set(op_types)
+    if not ts:
+        return None
+    for name in sorted(KERNELS):
+        cover = set(KERNELS[name].get('op_types') or ())
+        if cover and ts <= cover:
+            return name
+    return None
 
 
 def on_tpu():
@@ -185,6 +206,8 @@ def report():
         ent = {'dense_fallback': info['dense_fallback'],
                'has_vjp': info['has_vjp'],
                'dispatch_fused': fused, 'dispatch_dense': dense}
+        if info.get('op_types'):
+            ent['op_types'] = list(info['op_types'])
         if last:
             ent['last'] = dict(last)
         fb = {}

@@ -38,13 +38,15 @@ common.register_kernel(
     'embedding_lookup',
     dense_fallback='jnp.take row gather (ops.tensor_ops.lookup_table_v2)',
     has_vjp=True,
-    doc='scalar-prefetch row gather; sorted scatter-add backward')
+    doc='scalar-prefetch row gather; sorted scatter-add backward',
+    op_types=('lookup_table', 'lookup_table_v2'))
 
 common.register_kernel(
     'embedding_update',
     dense_fallback='dense scatter-add + ops.optimizer_ops.adagrad',
     has_vjp=False,
-    doc='sorted-run adagrad update over only the touched rows')
+    doc='sorted-run adagrad update over only the touched rows',
+    op_types=('adagrad',))
 
 
 def _dense_lookup(w, ids, padding_idx):

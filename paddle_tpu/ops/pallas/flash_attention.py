@@ -55,7 +55,8 @@ _common.register_kernel(
     'flash_attention',
     dense_fallback='ops.pallas.flash_attention._dense_path',
     has_vjp=True,
-    doc='streamed softmax(QK)V; dispatches dense below min_seq')
+    doc='streamed softmax(QK)V; dispatches dense below min_seq',
+    op_types=('matmul', 'scale', 'softmax', 'dropout'))
 
 
 def _dropout_keep(seed, g, qpos, kpos, keep_threshold):

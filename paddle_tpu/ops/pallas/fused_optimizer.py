@@ -49,7 +49,9 @@ common.register_kernel(
     dense_fallback='ops.optimizer_ops.{adam,adamw,lamb} per-tensor loop',
     has_vjp=False,
     doc='one launch updating a whole run of same-hyper optimizer ops '
-        'over flattened parameter slabs (lamb trust ratio in-kernel)')
+        'over flattened parameter slabs (lamb trust ratio in-kernel)',
+    op_types=('adam', 'adamw', 'lamb', 'fused_adam', 'fused_adamw',
+              'fused_lamb'))
 
 
 def _pack(tensors):

@@ -36,7 +36,8 @@ common.register_kernel(
     dense_fallback='ops.collective_ops._quant_allreduce dense arm',
     has_vjp=False,
     doc='block-scaled int8 quantize / dequant+reduce+requant tiles '
-        'around the quantized allreduce wire phases')
+        'around the quantized allreduce wire phases',
+    op_types=('c_allreduce_sum', 'c_allreduce_fused'))
 
 
 def fused_available():

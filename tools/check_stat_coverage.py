@@ -404,6 +404,26 @@ REQUIRED = [
     ('paddle_tpu/fluid/serving.py', 'serving/tenant_evicted'),
     ('paddle_tpu/fluid/serving.py', 'serving/warmup_buckets'),
     ('paddle_tpu/fluid/health.py', "'fleet':"),
+    # op-cost attribution plane (fluid/opprof.py): segment snapshots +
+    # eager replays, the attributed-vs-unattributed ms honesty split,
+    # capture event consumption with the dropped-row counter, and the
+    # ranked kernel-worklist gauge — tools/check_opprof.py closes the
+    # loop against a warmed LeNet with the 10% step-report agreement
+    # band
+    ('paddle_tpu/fluid/opprof.py', 'opprof/snapshots'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/replays'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/instances'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/attributed_ms_total'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/unattributed_ms_total'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/capture_events'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/dropped_events'),
+    ('paddle_tpu/fluid/opprof.py', 'opprof/worklist_candidates'),
+    ('paddle_tpu/fluid/executor.py', '_opprof.want_snapshot'),
+    ('paddle_tpu/fluid/executor.py', '_opprof.note_segment'),
+    ('paddle_tpu/fluid/profiler.py', 'profiler/dropped_events'),
+    ('paddle_tpu/fluid/health.py', "'op_costs':"),
+    ('tools/stat_summary.py', 'opprof/worklist_candidates'),
+    ('bench.py', 'opprof_overhead'),
 ]
 
 

@@ -1,0 +1,155 @@
+"""Per-instance op cost table + ranked kernel worklist (fluid.opprof).
+
+Two modes:
+
+* ``--url http://host:port`` scrapes a live job's ``/opprof`` endpoint
+  (the health server replays its stashed snapshots server-side and
+  returns report + worklist) and renders the tables — the operator's
+  "where do this job's milliseconds go, by op?" one-liner.
+* default (no --url): a self-contained demonstration run — LeNet +
+  Adam through Executor.warmup with ``FLAGS_opprof`` on at snapshot
+  cadence 1, eager replay of every stashed segment, the normalized
+  per-instance table, and the worklist written to ``--out``
+  (default op_worklist.json — the ROADMAP item 5 artifact).
+
+Usage:
+  python tools/op_costs.py [--steps N] [--out op_worklist.json]
+  python tools/op_costs.py --url http://host:port [--out ...]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def render(rep, worklist, out=None):
+    out = out if out is not None else sys.stdout
+    out.write('%-26s %-22s %-10s %9s %9s %6s %10s\n'
+              % ('instance', 'segment', 'layer', 'ms/step', 'raw_ms',
+                 'calls', 'bytes'))
+    for c in rep.get('top', []):
+        out.write('%-26s %-22s %-10s %9.4f %9s %6d %10d\n'
+                  % (c['instance'], c['segment'][:22],
+                     c.get('layer') or '-', c['ms_per_step'],
+                     '%.4f' % c['raw_ms'] if 'raw_ms' in c else '-',
+                     c['calls'], c.get('bytes_per_step', 0)))
+    unatt = rep.get('unattributed_ms')
+    if unatt:
+        out.write('unattributed: %.4f ms/step (honest remainder)\n'
+                  % unatt)
+    by_type = rep.get('by_type') or {}
+    if by_type:
+        out.write('by type:  %s\n' % ', '.join(
+            '%s=%.3fms' % (t, v['ms_per_step'])
+            for t, v in sorted(by_type.items(),
+                               key=lambda kv: -kv[1]['ms_per_step'])[:8]))
+    by_layer = rep.get('by_layer') or {}
+    if by_layer:
+        out.write('by layer: %s\n' % ', '.join(
+            '%s=%.3fms' % (l, v)
+            for l, v in sorted(by_layer.items(),
+                               key=lambda kv: -kv[1])[:8]))
+    if worklist:
+        out.write('\nkernel worklist (contiguous same-type runs by '
+                  'attributable cost):\n')
+        for r in worklist:
+            out.write('  #%d %-14s x%-3d %9.4f ms/step %12d B  '
+                      '%s%s\n'
+                      % (r['rank'], r['op_type'], len(r['ops']),
+                         r['ms_per_step'], r['bytes_per_step'],
+                         r['segment'][:24],
+                         '  [covered by pallas/%s]' % r['covered_by']
+                         if r.get('covered_by') else ''))
+
+
+def scrape(url, out_path):
+    import urllib.request
+    with urllib.request.urlopen('%s/opprof' % url.rstrip('/'),
+                                timeout=30) as resp:
+        doc = json.loads(resp.read().decode('utf-8'))
+    rep = doc.get('report') or {}
+    worklist = doc.get('worklist') or []
+    replayed = doc.get('replayed')
+    if replayed:
+        print('replayed %d stashed segment(s) server-side' %
+              len(replayed))
+    if doc.get('replay_error'):
+        print('replay error (capture rows only): %s'
+          % doc['replay_error'])
+    render(rep, worklist)
+    if out_path:
+        with open(out_path, 'w') as f:
+            json.dump({'version': 1, 'generated_by': 'fluid.opprof',
+                       'candidates': worklist,
+                       'by_type': rep.get('by_type'),
+                       'by_layer': rep.get('by_layer'),
+                       'segments': rep.get('segments')},
+                      f, indent=2, sort_keys=True)
+        print('kernel worklist written to %s' % out_path)
+    return 0
+
+
+def demo(steps, out_path):
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import opprof
+    from paddle_tpu import models
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_p, startup):
+        feeds, pred, loss, acc = models.lenet.build()
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.rand(64, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (64, 1)).astype('int64')}
+
+    fluid.set_flags({'FLAGS_opprof': True,
+                     'FLAGS_opprof_snapshot_steps': 1})
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.XLAPlace(0))
+            exe.run(startup)
+            exe.warmup(main_p,
+                       feed_shapes={'img': ((64, 1, 28, 28), 'float32'),
+                                    'label': ((64, 1), 'int64')},
+                       fetch_list=[loss], wait=True)
+            for _ in range(max(steps, 1)):
+                exe.run(main_p, feed=feed, fetch_list=[loss])
+            done = opprof.replay_all()
+            print('replayed %d segment snapshot(s): %s\n'
+                  % (len(done), ', '.join(
+                      '%s=%s' % kv for kv in sorted(done.items()))))
+            rep = opprof.report()
+            worklist = opprof.kernel_worklist()
+            render(rep, worklist)
+            if out_path:
+                opprof.write_worklist(out_path)
+                print('kernel worklist written to %s' % out_path)
+    finally:
+        fluid.set_flags({'FLAGS_opprof': False,
+                         'FLAGS_opprof_snapshot_steps': 16})
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--url', default=None,
+                    help='scrape a live job: http://host:port of its '
+                         'fluid.health status server (/opprof)')
+    ap.add_argument('--steps', type=int, default=4,
+                    help='demo mode: training steps before replay')
+    ap.add_argument('--out', default='op_worklist.json',
+                    help="worklist artifact path ('' skips writing)")
+    args = ap.parse_args(argv)
+    if args.url:
+        return scrape(args.url, args.out)
+    return demo(args.steps, args.out)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -50,6 +50,7 @@ LOCK_MODULES = [
     'paddle_tpu/fluid/slo.py',
     'paddle_tpu/fluid/autopilot.py',
     'paddle_tpu/fluid/fleet.py',
+    'paddle_tpu/fluid/opprof.py',
 ]
 # documented GIL-discipline exemption: registries with NO lock at all
 # (the lint fails if a lock ever appears there half-wired)
